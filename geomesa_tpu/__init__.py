@@ -1,0 +1,26 @@
+"""geomesa-tpu: a TPU-native spatio-temporal indexing & analytics framework.
+
+A from-scratch re-design of GeoMesa's capability surface (see SURVEY.md) for
+TPU hardware: features live in an HBM-resident columnar table; space-filling
+curve indexes, CQL-style filters and aggregations run as vmapped / pjit-sharded
+XLA kernels; query *planning* (filter splitting, index selection, range
+decomposition) stays host-side Python, mirroring GeoMesa's split between
+planning (client) and scanning (server), where "server" here is the TPU.
+
+Layer map (mirrors reference layers in SURVEY.md §1):
+  - ``geomesa_tpu.curves``    ≙ geomesa-z3 (+ the external sfcurve lib)
+  - ``geomesa_tpu.features``  ≙ geomesa-utils SimpleFeatureTypes + geomesa-features + geomesa-arrow
+  - ``geomesa_tpu.filter``    ≙ geomesa-filter
+  - ``geomesa_tpu.index``     ≙ geomesa-index-api (key spaces, planner, scans)
+  - ``geomesa_tpu.aggregates``≙ index iterators (density/bin/stats/arrow scans)
+  - ``geomesa_tpu.stats``     ≙ geomesa-utils stats + index stats
+  - ``geomesa_tpu.parallel``  ≙ backend scan fan-out + geomesa-spark (mesh sharding, joins)
+  - ``geomesa_tpu.convert``   ≙ geomesa-convert
+  - ``geomesa_tpu.tools``     ≙ geomesa-tools CLI
+  - ``geomesa_tpu.datastore`` ≙ GeoMesaDataStore / DataStoreFinder entry point
+"""
+
+__version__ = "0.1.0"
+
+from geomesa_tpu.features.sft import SimpleFeatureType  # noqa: F401
+from geomesa_tpu.datastore import DataStoreFinder  # noqa: F401

@@ -1,0 +1,26 @@
+"""Space-filling-curve layer (≙ reference geomesa-z3 + external sfcurve-zorder).
+
+Unlike the reference, which delegates the Morton bit-interleave and the
+quad/octree range-cover to the external ``sfcurve`` library
+(/root/reference/geomesa-z3/pom.xml:21-22), everything here is self-contained:
+
+  - ``zorder``     — vectorized Morton spread/interleave/deinterleave (numpy + jax)
+  - ``normalize``  — BitNormalizedDimension semantics (floor-normalize, +0.5 denormalize)
+  - ``binnedtime`` — TimePeriod / BinnedTime epoch binning
+  - ``sfc``        — Z2SFC / Z3SFC index/invert/ranges
+  - ``ranges``     — host-side z-range cover (BFS quad/octree decomposition + merge)
+  - ``xz``         — XZ2SFC / XZ3SFC for geometries with extent (Böhm et al. XZ-ordering)
+"""
+
+from geomesa_tpu.curves.normalize import BitNormalizedDimension, NormalizedLat, NormalizedLon, NormalizedTime
+from geomesa_tpu.curves.binnedtime import TimePeriod, BinnedTime, max_offset, time_to_binned_time, binned_time_to_millis
+from geomesa_tpu.curves.sfc import Z2SFC, Z3SFC
+from geomesa_tpu.curves.xz import XZ2SFC, XZ3SFC
+from geomesa_tpu.curves.ranges import IndexRange, zranges_2d, zranges_3d, merge_ranges
+
+__all__ = [
+    "BitNormalizedDimension", "NormalizedLat", "NormalizedLon", "NormalizedTime",
+    "TimePeriod", "BinnedTime", "max_offset", "time_to_binned_time", "binned_time_to_millis",
+    "Z2SFC", "Z3SFC", "XZ2SFC", "XZ3SFC",
+    "IndexRange", "zranges_2d", "zranges_3d", "merge_ranges",
+]

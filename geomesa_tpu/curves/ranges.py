@@ -1,0 +1,138 @@
+"""Host-side z-range cover: decompose integer query boxes into Morton ranges.
+
+The reference gets this from the external sfcurve library (``Z2.zranges`` /
+``Z3.zranges``, used at /root/reference/geomesa-z3/.../Z2SFC.scala:52 and
+Z3SFC.scala:61). This is a from-scratch implementation of the same idea: a
+breadth-first quad/octree traversal that emits a z-interval for each tree cell
+fully contained in (or, at the recursion budget, overlapping) any query box,
+then sort-merges adjacent intervals.
+
+This code is branchy and recursive by nature, so it stays on the host (plain
+Python/numpy) — it produces at most ``max_ranges`` ranges (default mirrors the
+reference's ``geomesa.scan.ranges.target`` = 2000, QueryProperties.scala:22),
+which then parameterize the device scan kernels.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+from geomesa_tpu.curves import zorder
+
+
+@dataclass(frozen=True)
+class IndexRange:
+    """Inclusive z-interval [lower, upper]; ``contained`` means every z in the
+    interval satisfies the query box (no further filtering needed)."""
+
+    lower: int
+    upper: int
+    contained: bool = False
+
+
+def merge_ranges(ranges: List[IndexRange]) -> List[IndexRange]:
+    """Sort and merge adjacent/overlapping ranges (sfcurve/XZ2SFC merge rule:
+    merge when lower <= current.upper + 1; merged range is contained only if
+    both inputs were)."""
+    if not ranges:
+        return []
+    ranges = sorted(ranges, key=lambda r: (r.lower, r.upper))
+    out: List[IndexRange] = []
+    cur = ranges[0]
+    for r in ranges[1:]:
+        if r.lower <= cur.upper + 1:
+            cur = IndexRange(cur.lower, max(cur.upper, r.upper), cur.contained and r.contained)
+        else:
+            out.append(cur)
+            cur = r
+    out.append(cur)
+    return out
+
+
+def _zranges(
+    boxes: Sequence[Sequence[Tuple[int, int]]],
+    bits: int,
+    dims: int,
+    max_ranges: int,
+    max_levels: int,
+) -> List[IndexRange]:
+    """Generic D-dimensional Morton cover.
+
+    boxes: per-box, per-dim inclusive int bounds [(lo, hi), ...] in normalized
+    int space. Returns merged inclusive z ranges covering the union of boxes.
+    """
+    if not boxes:
+        return []
+    interleave = {2: lambda c: int(zorder.z2_encode(c[0], c[1])),
+                  3: lambda c: int(zorder.z3_encode(c[0], c[1], c[2]))}[dims]
+
+    max_levels = min(max_levels, bits)
+    out: List[IndexRange] = []
+
+    def emit(prefix: Tuple[int, ...], level: int, contained: bool) -> None:
+        shift = bits - level
+        lo = tuple(p << shift for p in prefix)
+        zlo = interleave(lo)
+        zhi = zlo + (1 << (dims * shift)) - 1
+        out.append(IndexRange(zlo, zhi, contained))
+
+    def classify(prefix: Tuple[int, ...], level: int) -> int:
+        """2 = contained in some box, 1 = overlaps some box, 0 = disjoint."""
+        shift = bits - level
+        cell = [(p << shift, ((p + 1) << shift) - 1) for p in prefix]
+        overlapped = False
+        for box in boxes:
+            inside = True
+            touches = True
+            for (clo, chi), (blo, bhi) in zip(cell, box):
+                if not (blo <= clo and chi <= bhi):
+                    inside = False
+                if chi < blo or bhi < clo:
+                    touches = False
+                    break
+            if inside:
+                return 2
+            if touches:
+                overlapped = True
+        return 1 if overlapped else 0
+
+    # BFS, level by level; when the budget is hit, flush remaining cells as
+    # overlapping (coarse) ranges — same spirit as sfcurve's maxRanges stop.
+    queue: deque = deque([(tuple([0] * dims), 0)])
+    while queue:
+        prefix, level = queue.popleft()
+        status = classify(prefix, level)
+        if status == 0:
+            continue
+        if status == 2 or level >= max_levels or (len(out) + len(queue)) >= max_ranges:
+            emit(prefix, level, status == 2)
+            continue
+        for child in range(1 << dims):
+            child_prefix = tuple((p << 1) | ((child >> d) & 1) for d, p in enumerate(prefix))
+            queue.append((child_prefix, level + 1))
+
+    return merge_ranges(out)
+
+
+def zranges_2d(
+    boxes: Sequence[Tuple[int, int, int, int]],
+    bits: int = 31,
+    max_ranges: int = 2000,
+    max_levels: int = 64,
+) -> List[IndexRange]:
+    """2-D cover. boxes = (xlo, ylo, xhi, yhi) inclusive normalized ints."""
+    reshaped = [((xlo, xhi), (ylo, yhi)) for xlo, ylo, xhi, yhi in boxes]
+    return _zranges(reshaped, bits, 2, max_ranges, max_levels)
+
+
+def zranges_3d(
+    boxes: Sequence[Tuple[int, int, int, int, int, int]],
+    bits: int = 21,
+    max_ranges: int = 2000,
+    max_levels: int = 64,
+) -> List[IndexRange]:
+    """3-D cover. boxes = (xlo, ylo, tlo, xhi, yhi, thi) inclusive ints."""
+    reshaped = [((xlo, xhi), (ylo, yhi), (tlo, thi)) for xlo, ylo, tlo, xhi, yhi, thi in boxes]
+    return _zranges(reshaped, bits, 3, max_ranges, max_levels)

@@ -1,0 +1,28 @@
+"""DataStore facade — the framework entry point.
+
+≙ reference GeoTools ``DataStoreFinder`` + ``GeoMesaDataStore``
+(/root/reference/geomesa-index-api/.../geotools/GeoMesaDataStore.scala:49).
+Round-1 surface: an in-process registry of named stores; ``create_schema`` /
+``get_writer`` / ``get_query_runner`` land as the index layer comes up.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class DataStoreFinder:
+    """Registry of datastore factories, keyed by params (SPI-equivalent)."""
+
+    _factories: Dict[str, type] = {}
+
+    @classmethod
+    def register(cls, name: str, factory: type) -> None:
+        cls._factories[name] = factory
+
+    @classmethod
+    def get_data_store(cls, **params):
+        for name, factory in cls._factories.items():
+            if factory.can_process(params):
+                return factory.create(params)
+        raise ValueError(f"No datastore factory for params {sorted(params)}")

@@ -1,0 +1,15 @@
+"""Feature layer: schemas (SimpleFeatureType), the columnar device-resident
+feature table, geometry encodings, and Arrow interchange.
+
+≙ reference geomesa-utils SimpleFeatureTypes + geomesa-features (serialization)
++ geomesa-arrow (columnar). Where GeoMesa serializes features row-wise with
+Kryo for KV storage (KryoFeatureSerializer.scala:42), a TPU-native design keeps
+features *columnar* from the start: structure-of-arrays jnp buffers, strings
+dictionary-encoded, geometries as fixed-width coords (points) or padded
+coordinate buffers with offsets (lines/polygons).
+"""
+
+from geomesa_tpu.features.sft import AttributeSpec, SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+
+__all__ = ["AttributeSpec", "SimpleFeatureType", "FeatureTable"]

@@ -1,0 +1,269 @@
+"""Columnar geometry storage (GeoArrow-style nested offsets) + WKT codec.
+
+Where the reference serializes geometries row-wise as TWKB/WKB byte blobs
+(/root/reference/geomesa-features/.../TwkbSerialization.scala), a TPU-native
+layout keeps all coordinates in one flat (M, 2) float64 buffer with three
+levels of offsets — geometry → part → ring → coords — so device kernels see
+dense arrays and per-feature bounding boxes are precomputed columns:
+
+  - Point:            1 part, 1 ring, 1 coord
+  - LineString:       1 part, 1 ring (the line), k coords
+  - Polygon:          1 part, r rings (shell + holes)
+  - MultiPoint:       p parts, each 1 ring / 1 coord
+  - MultiLineString:  p parts, each 1 ring
+  - MultiPolygon:     p parts, each r_i rings
+
+The bbox columns (xmin/ymin/xmax/ymax) are what the XZ index and bbox filters
+consume; exact predicates walk the ragged buffers host- or device-side.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# geometry type codes (WKB-compatible numbering)
+POINT, LINESTRING, POLYGON = 1, 2, 3
+MULTIPOINT, MULTILINESTRING, MULTIPOLYGON = 4, 5, 6
+
+TYPE_NAMES = {
+    POINT: "Point", LINESTRING: "LineString", POLYGON: "Polygon",
+    MULTIPOINT: "MultiPoint", MULTILINESTRING: "MultiLineString",
+    MULTIPOLYGON: "MultiPolygon",
+}
+NAME_TYPES = {v: k for k, v in TYPE_NAMES.items()}
+
+
+@dataclass
+class GeometryArray:
+    """Columnar geometry collection of length N."""
+
+    type_codes: np.ndarray    # (N,) int8
+    geom_offsets: np.ndarray  # (N+1,) int64 -> parts
+    part_offsets: np.ndarray  # (P+1,) int64 -> rings
+    ring_offsets: np.ndarray  # (R+1,) int64 -> coords
+    coords: np.ndarray        # (M, 2) float64
+
+    def __len__(self) -> int:
+        return len(self.type_codes)
+
+    def __post_init__(self):
+        self.type_codes = np.asarray(self.type_codes, dtype=np.int8)
+        self.geom_offsets = np.asarray(self.geom_offsets, dtype=np.int64)
+        self.part_offsets = np.asarray(self.part_offsets, dtype=np.int64)
+        self.ring_offsets = np.asarray(self.ring_offsets, dtype=np.int64)
+        self.coords = np.asarray(self.coords, dtype=np.float64).reshape(-1, 2)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def points(cls, x, y) -> "GeometryArray":
+        """Fast path for pure point collections."""
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        n = len(x)
+        ar = np.arange(n + 1, dtype=np.int64)
+        return cls(
+            np.full(n, POINT, dtype=np.int8), ar, ar.copy(), ar.copy(),
+            np.stack([x, y], axis=1),
+        )
+
+    @classmethod
+    def from_shapes(cls, shapes: Sequence[Tuple[int, list]]) -> "GeometryArray":
+        """Build from (type_code, nested-coordinate-list) pairs.
+
+        Nesting per type: Point [x, y]; LineString [[x,y],...];
+        Polygon [ring, ...] where ring = [[x,y],...]; Multi* = list of members.
+        """
+        type_codes, geom_off, part_off, ring_off = [], [0], [0], [0]
+        coord_chunks: List[np.ndarray] = []
+        n_parts = n_rings = n_coords = 0
+
+        def add_ring(ring_coords):
+            nonlocal n_coords, n_rings
+            arr = np.asarray(ring_coords, dtype=np.float64).reshape(-1, 2)
+            coord_chunks.append(arr)
+            n_coords += len(arr)
+            ring_off.append(n_coords)
+            n_rings += 1
+
+        def add_part(rings: Iterable) -> None:
+            nonlocal n_parts
+            for ring in rings:
+                add_ring(ring)
+            n_parts += 1
+            part_off.append(n_rings)
+
+        for code, data in shapes:
+            type_codes.append(code)
+            if code == POINT:
+                add_part([[data]])
+            elif code == LINESTRING:
+                add_part([data])
+            elif code == POLYGON:
+                add_part(data)
+            elif code == MULTIPOINT:
+                for pt in data:
+                    add_part([[pt]])
+            elif code == MULTILINESTRING:
+                for line in data:
+                    add_part([line])
+            elif code == MULTIPOLYGON:
+                for poly in data:
+                    add_part(poly)
+            else:
+                raise ValueError(f"Unsupported geometry type code {code}")
+            geom_off.append(n_parts)
+
+        coords = np.concatenate(coord_chunks, axis=0) if coord_chunks else np.zeros((0, 2))
+        return cls(np.array(type_codes), geom_off, part_off, ring_off, coords)
+
+    @classmethod
+    def from_wkt(cls, wkts: Sequence[str]) -> "GeometryArray":
+        return cls.from_shapes([parse_wkt(w) for w in wkts])
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def is_points(self) -> bool:
+        return bool(np.all(self.type_codes == POINT))
+
+    def point_xy(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(x, y) arrays for pure-point collections."""
+        if not self.is_points:
+            raise ValueError("Not a pure point collection")
+        return self.coords[:, 0], self.coords[:, 1]
+
+    def bboxes(self) -> np.ndarray:
+        """(N, 4) per-feature [xmin, ymin, xmax, ymax].
+
+        Features own contiguous coordinate slices by construction, so
+        ``reduceat`` over the per-feature start offsets reduces exactly each
+        feature's coords (the last segment runs to the end of the buffer).
+        """
+        n = len(self)
+        out = np.empty((n, 4), dtype=np.float64)
+        if n == 0:
+            return out
+        starts = self.ring_offsets[self.part_offsets[self.geom_offsets[:-1]]]
+        out[:, 0] = np.minimum.reduceat(self.coords[:, 0], starts)
+        out[:, 1] = np.minimum.reduceat(self.coords[:, 1], starts)
+        out[:, 2] = np.maximum.reduceat(self.coords[:, 0], starts)
+        out[:, 3] = np.maximum.reduceat(self.coords[:, 1], starts)
+        return out
+
+    def feature_coords(self, i: int) -> np.ndarray:
+        s = self.ring_offsets[self.part_offsets[self.geom_offsets[i]]]
+        e = self.ring_offsets[self.part_offsets[self.geom_offsets[i + 1]]]
+        return self.coords[s:e]
+
+    def take(self, idx: np.ndarray) -> "GeometryArray":
+        """Gather a subset (host-side)."""
+        shapes = [self.shape(i) for i in np.asarray(idx, dtype=np.int64)]
+        return GeometryArray.from_shapes(shapes)
+
+    def shape(self, i: int):
+        """(type_code, nested lists) for feature i (inverse of from_shapes)."""
+        code = int(self.type_codes[i])
+        parts = []
+        for p in range(self.geom_offsets[i], self.geom_offsets[i + 1]):
+            rings = []
+            for r in range(self.part_offsets[p], self.part_offsets[p + 1]):
+                s, e = self.ring_offsets[r], self.ring_offsets[r + 1]
+                rings.append(self.coords[s:e].tolist())
+            parts.append(rings)
+        if code == POINT:
+            return code, parts[0][0][0]
+        if code == LINESTRING:
+            return code, parts[0][0]
+        if code == POLYGON:
+            return code, parts[0]
+        if code == MULTIPOINT:
+            return code, [p[0][0] for p in parts]
+        if code == MULTILINESTRING:
+            return code, [p[0] for p in parts]
+        return code, parts
+
+    def wkt(self, i: int) -> str:
+        return write_wkt(*self.shape(i))
+
+
+# ---------------------------------------------------------------------------
+# WKT codec (host-side interchange; no JTS dependency)
+# ---------------------------------------------------------------------------
+
+_WKT_RE = re.compile(r"^\s*(\w+)\s*(EMPTY|\(.*\))\s*$", re.IGNORECASE | re.DOTALL)
+
+
+def _parse_coord_seq(body: str) -> list:
+    return [[float(t) for t in pair.split()[:2]] for pair in body.split(",")]
+
+
+def _split_groups(body: str) -> List[str]:
+    """Split '(...),(...),...' at top level parens."""
+    groups, depth, start = [], 0, None
+    for i, ch in enumerate(body):
+        if ch == "(":
+            if depth == 0:
+                start = i + 1
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                groups.append(body[start:i])
+    return groups
+
+
+def parse_wkt(wkt: str) -> Tuple[int, list]:
+    m = _WKT_RE.match(wkt)
+    if not m:
+        raise ValueError(f"Invalid WKT: {wkt[:80]}")
+    name = m.group(1).upper()
+    body = m.group(2)
+    if body.upper() == "EMPTY":
+        raise ValueError("EMPTY geometries not supported")
+    inner = body[1:-1].strip()
+    if name == "POINT":
+        return POINT, _parse_coord_seq(inner)[0]
+    if name == "LINESTRING":
+        return LINESTRING, _parse_coord_seq(inner)
+    if name == "POLYGON":
+        return POLYGON, [_parse_coord_seq(g) for g in _split_groups(inner)]
+    if name == "MULTIPOINT":
+        if "(" in inner:
+            return MULTIPOINT, [_parse_coord_seq(g)[0] for g in _split_groups(inner)]
+        return MULTIPOINT, _parse_coord_seq(inner)
+    if name == "MULTILINESTRING":
+        return MULTILINESTRING, [_parse_coord_seq(g) for g in _split_groups(inner)]
+    if name == "MULTIPOLYGON":
+        polys = []
+        for poly_body in _split_groups(inner):
+            polys.append([_parse_coord_seq(g) for g in _split_groups(poly_body)])
+        return MULTIPOLYGON, polys
+    raise ValueError(f"Unsupported WKT type: {name}")
+
+
+def _fmt_coords(coords: list) -> str:
+    return ", ".join(f"{x:g} {y:g}" for x, y in coords)
+
+
+def write_wkt(code: int, data: list) -> str:
+    if code == POINT:
+        return f"POINT ({data[0]:g} {data[1]:g})"
+    if code == LINESTRING:
+        return f"LINESTRING ({_fmt_coords(data)})"
+    if code == POLYGON:
+        rings = ", ".join(f"({_fmt_coords(r)})" for r in data)
+        return f"POLYGON ({rings})"
+    if code == MULTIPOINT:
+        return f"MULTIPOINT ({_fmt_coords(data)})"
+    if code == MULTILINESTRING:
+        lines = ", ".join(f"({_fmt_coords(l)})" for l in data)
+        return f"MULTILINESTRING ({lines})"
+    if code == MULTIPOLYGON:
+        polys = ", ".join("(" + ", ".join(f"({_fmt_coords(r)})" for r in p) + ")" for p in data)
+        return f"MULTIPOLYGON ({polys})"
+    raise ValueError(f"Unsupported type code {code}")

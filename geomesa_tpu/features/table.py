@@ -1,0 +1,173 @@
+"""FeatureTable: the columnar, device-mappable feature collection.
+
+≙ the value side of the reference's storage (KryoFeatureSerializer +
+WritableFeature, SURVEY.md §2.3/§2.4) — but columnar-native. A table holds,
+per attribute, a host numpy column (the durable copy) and lazily materialized
+jax device arrays for the kernel-visible projection:
+
+  - numeric/date/bool columns: stored as-is (dates = int64 epoch millis)
+  - strings: dictionary codes (int32) + host-side vocab (the Arrow-dictionary
+    pattern the reference uses in ArrowDictionary.scala)
+  - geometries: GeometryArray; device projection = per-feature bbox (f32×4)
+    + point coords; full ragged coords ship for exact predicates
+
+Feature IDs are host-side (used by the id index and for result hydration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.sft import SimpleFeatureType
+
+
+@dataclass
+class StringColumn:
+    codes: np.ndarray           # (N,) int32 indices into vocab
+    vocab: List[str]
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def decode(self, idx) -> List[str]:
+        return [self.vocab[c] for c in self.codes[idx]]
+
+    @classmethod
+    def encode(cls, values: Sequence[str]) -> "StringColumn":
+        vocab, inverse = np.unique(np.asarray(values, dtype=object), return_inverse=True)
+        return cls(inverse.astype(np.int32), [str(v) for v in vocab])
+
+
+@dataclass
+class FeatureTable:
+    sft: SimpleFeatureType
+    fids: np.ndarray                                # (N,) object (str)
+    columns: Dict[str, object] = field(default_factory=dict)
+    # columns values: np.ndarray | StringColumn | GeometryArray
+
+    def __len__(self) -> int:
+        return len(self.fids)
+
+    @classmethod
+    def build(
+        cls,
+        sft: SimpleFeatureType,
+        data: Dict[str, object],
+        fids: Optional[Sequence[str]] = None,
+    ) -> "FeatureTable":
+        """data: attribute name → column values.
+
+        Geometries may be a GeometryArray, a list of WKT strings, or for Point
+        attributes a (x, y) array tuple. Strings encode to dictionaries.
+        """
+        columns: Dict[str, object] = {}
+        n = None
+        for attr in sft.attributes:
+            if attr.name not in data:
+                raise KeyError(f"Missing column {attr.name}")
+            raw = data[attr.name]
+            if attr.is_geometry:
+                if isinstance(raw, GeometryArray):
+                    col = raw
+                elif isinstance(raw, tuple) and len(raw) == 2:
+                    col = GeometryArray.points(raw[0], raw[1])
+                else:
+                    col = GeometryArray.from_wkt(list(raw))
+            elif attr.type_name == "String":
+                col = raw if isinstance(raw, StringColumn) else StringColumn.encode(raw)
+            elif attr.type_name == "Date":
+                arr = np.asarray(raw)
+                if arr.dtype.kind == "M":
+                    arr = arr.astype("datetime64[ms]").astype(np.int64)
+                elif arr.dtype.kind in "OU":
+                    arr = np.array(raw, dtype="datetime64[ms]").astype(np.int64)
+                col = arr.astype(np.int64)
+            else:
+                col = np.asarray(raw, dtype=attr.binding)
+            m = len(col)
+            if n is None:
+                n = m
+            elif n != m:
+                raise ValueError(f"Column {attr.name} length {m} != {n}")
+            columns[attr.name] = col
+        n = n or 0
+        if fids is None:
+            fids = np.array([str(i) for i in range(n)], dtype=object)
+        else:
+            fids = np.asarray(fids, dtype=object)
+            if len(fids) != n:
+                raise ValueError("fids length mismatch")
+        return cls(sft, fids, columns)
+
+    # -- access -------------------------------------------------------------
+
+    def column(self, name: str):
+        return self.columns[name]
+
+    def geometry(self, name: Optional[str] = None) -> GeometryArray:
+        attr = self.sft.attribute(name) if name else self.sft.geometry_attribute
+        if attr is None:
+            raise ValueError("No geometry attribute")
+        return self.columns[attr.name]
+
+    def dtg(self) -> Optional[np.ndarray]:
+        attr = self.sft.dtg_attribute
+        return self.columns[attr.name] if attr else None
+
+    def take(self, idx: np.ndarray) -> "FeatureTable":
+        """Host-side row gather (result hydration)."""
+        idx = np.asarray(idx, dtype=np.int64)
+        cols: Dict[str, object] = {}
+        for name, col in self.columns.items():
+            if isinstance(col, GeometryArray):
+                cols[name] = col.take(idx)
+            elif isinstance(col, StringColumn):
+                cols[name] = StringColumn(col.codes[idx], col.vocab)
+            else:
+                cols[name] = col[idx]
+        return FeatureTable(self.sft, self.fids[idx], cols)
+
+    def to_dicts(self) -> List[dict]:
+        """Materialize as a list of {attr: value} dicts (tests / export)."""
+        out = []
+        geom_names = {a.name for a in self.sft.attributes if a.is_geometry}
+        for i in range(len(self)):
+            row = {"__fid__": self.fids[i]}
+            for name, col in self.columns.items():
+                if isinstance(col, GeometryArray):
+                    row[name] = col.wkt(i)
+                elif isinstance(col, StringColumn):
+                    row[name] = col.vocab[col.codes[i]]
+                else:
+                    row[name] = col[i].item()
+            out.append(row)
+        return out
+
+    @staticmethod
+    def concat(tables: Sequence["FeatureTable"]) -> "FeatureTable":
+        """Concatenate tables sharing a schema (ingest batching / live layer)."""
+        if not tables:
+            raise ValueError("No tables")
+        sft = tables[0].sft
+        fids = np.concatenate([t.fids for t in tables])
+        cols: Dict[str, object] = {}
+        for attr in sft.attributes:
+            parts = [t.columns[attr.name] for t in tables]
+            first = parts[0]
+            if isinstance(first, GeometryArray):
+                shapes = []
+                for p in parts:
+                    shapes.extend(p.shape(i) for i in range(len(p)))
+                cols[attr.name] = GeometryArray.from_shapes(shapes)
+            elif isinstance(first, StringColumn):
+                values = []
+                for p in parts:
+                    values.extend(p.vocab[c] for c in p.codes)
+                cols[attr.name] = StringColumn.encode(values)
+            else:
+                cols[attr.name] = np.concatenate(parts)
+        return FeatureTable(sft, fids, cols)

@@ -1,0 +1,13 @@
+"""Test harness config: force JAX onto CPU with 8 virtual devices so the
+multi-chip sharding paths compile and run without TPU hardware (the pattern
+recommended in SURVEY.md §4: XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

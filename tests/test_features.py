@@ -1,0 +1,114 @@
+"""Feature layer: SFT spec parsing, geometry arrays, columnar tables."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.geometry import (
+    GeometryArray, MULTIPOLYGON, POINT, POLYGON, parse_wkt, write_wkt,
+)
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+
+
+class TestSFT:
+    def test_parse_spec(self):
+        sft = SimpleFeatureType.from_spec(
+            "gdelt", "name:String,age:Int,dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval=week")
+        assert [a.name for a in sft.attributes] == ["name", "age", "dtg", "geom"]
+        assert sft.geometry_attribute.name == "geom"
+        assert sft.geometry_attribute.options == {"srid": "4326"}
+        assert sft.dtg_attribute.name == "dtg"
+        assert sft.z3_interval == "week"
+        assert sft.xz_precision == 12
+
+    def test_roundtrip_spec(self):
+        spec = "name:String,*geom:Point:srid=4326;geomesa.indices=z3"
+        sft = SimpleFeatureType.from_spec("t", spec)
+        sft2 = SimpleFeatureType.from_spec("t", sft.to_spec())
+        assert sft2.to_spec() == sft.to_spec()
+        assert sft.configured_indices == ["z3"]
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            SimpleFeatureType.from_spec("t", "a:Widget")
+
+
+class TestGeometry:
+    def test_wkt_roundtrip(self):
+        wkts = [
+            "POINT (30 10)",
+            "LINESTRING (30 10, 10 30, 40 40)",
+            "POLYGON ((30 10, 40 40, 20 40, 10 20, 30 10))",
+            "POLYGON ((35 10, 45 45, 15 40, 10 20, 35 10), (20 30, 35 35, 30 20, 20 30))",
+            "MULTIPOINT (10 40, 40 30, 20 20, 30 10)",
+            "MULTILINESTRING ((10 10, 20 20, 10 40), (40 40, 30 30, 40 20, 30 10))",
+            "MULTIPOLYGON (((30 20, 45 40, 10 40, 30 20)), ((15 5, 40 10, 10 20, 5 10, 15 5)))",
+        ]
+        arr = GeometryArray.from_wkt(wkts)
+        assert len(arr) == len(wkts)
+        for i, w in enumerate(wkts):
+            assert parse_wkt(arr.wkt(i)) == parse_wkt(w)
+
+    def test_points_fast_path(self):
+        arr = GeometryArray.points([1.0, 2.0], [3.0, 4.0])
+        assert arr.is_points
+        x, y = arr.point_xy()
+        np.testing.assert_array_equal(x, [1.0, 2.0])
+        bb = arr.bboxes()
+        np.testing.assert_array_equal(bb[0], [1.0, 3.0, 1.0, 3.0])
+
+    def test_bboxes(self):
+        arr = GeometryArray.from_wkt([
+            "POLYGON ((0 0, 10 0, 10 5, 0 5, 0 0))",
+            "LINESTRING (-3 -4, 7 8)",
+            "POINT (1 2)",
+        ])
+        bb = arr.bboxes()
+        np.testing.assert_array_equal(bb[0], [0, 0, 10, 5])
+        np.testing.assert_array_equal(bb[1], [-3, -4, 7, 8])
+        np.testing.assert_array_equal(bb[2], [1, 2, 1, 2])
+
+    def test_take(self):
+        arr = GeometryArray.from_wkt(["POINT (1 1)", "POINT (2 2)", "LINESTRING (0 0, 1 1)"])
+        sub = arr.take(np.array([2, 0]))
+        assert sub.wkt(0).startswith("LINESTRING")
+        assert sub.wkt(1) == "POINT (1 1)"
+
+
+class TestFeatureTable:
+    def _table(self):
+        sft = SimpleFeatureType.from_spec("t", "name:String,age:Int,dtg:Date,*geom:Point")
+        return FeatureTable.build(sft, {
+            "name": ["alice", "bob", "alice"],
+            "age": [30, 40, 50],
+            "dtg": ["2020-01-01T00:00:00", "2020-01-02T12:00:00", "2020-01-03T06:30:00"],
+            "geom": (np.array([10.0, 20.0, 30.0]), np.array([-5.0, 0.0, 5.0])),
+        }, fids=["a", "b", "c"])
+
+    def test_build_and_access(self):
+        t = self._table()
+        assert len(t) == 3
+        assert isinstance(t.column("name"), StringColumn)
+        assert t.column("age").dtype == np.int32
+        assert t.dtg()[0] == np.datetime64("2020-01-01", "ms").astype(np.int64)
+        x, y = t.geometry().point_xy()
+        np.testing.assert_array_equal(x, [10.0, 20.0, 30.0])
+
+    def test_take_and_dicts(self):
+        t = self._table()
+        sub = t.take(np.array([1]))
+        rows = sub.to_dicts()
+        assert rows[0]["name"] == "bob"
+        assert rows[0]["geom"] == "POINT (20 0)"
+        assert rows[0]["__fid__"] == "b"
+
+    def test_concat(self):
+        t = self._table()
+        both = FeatureTable.concat([t, t])
+        assert len(both) == 6
+        assert both.to_dicts()[3]["name"] == "alice"
+
+    def test_length_mismatch_rejected(self):
+        sft = SimpleFeatureType.from_spec("t", "age:Int,*geom:Point")
+        with pytest.raises(ValueError):
+            FeatureTable.build(sft, {"age": [1, 2], "geom": (np.array([1.0]), np.array([2.0]))})
