@@ -1,0 +1,25 @@
+"""Filter layer (≙ reference geomesa-filter, SURVEY.md §2.2).
+
+A small CQL/ECQL subset compiles to a typed predicate IR:
+
+  - ``ir``       — predicate nodes (BBox, Intersects, During, Cmp, And/Or/Not…)
+  - ``parser``   — ECQL text → IR
+  - ``evaluate`` — host numpy evaluation (the brute-force / fallback path)
+  - ``extract``  — FilterHelper-equivalents: pull bboxes/intervals for planning
+  - ``compile``  — IR → jax mask kernel over device columns (the push-down
+                   path, ≙ HBase filters / Accumulo iterators)
+"""
+
+from geomesa_tpu.filter.ir import (
+    And, BBox, Cmp, Contains, During, Dwithin, Exclude, FidFilter, Include,
+    Intersects, Not, Or, Within, Filter,
+)
+from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.filter.evaluate import evaluate
+from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
+
+__all__ = [
+    "And", "BBox", "Cmp", "Contains", "During", "Dwithin", "Exclude",
+    "FidFilter", "Include", "Intersects", "Not", "Or", "Within", "Filter",
+    "parse_ecql", "evaluate", "extract_bboxes", "extract_intervals",
+]

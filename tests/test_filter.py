@@ -1,0 +1,184 @@
+"""Filter layer tests: ECQL parsing, numpy evaluation, planning extraction."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import (
+    BBox, Cmp, During, Intersects, evaluate, extract_bboxes, extract_intervals,
+    parse_ecql,
+)
+from geomesa_tpu.filter import ir
+
+RNG = np.random.default_rng(7)
+
+
+def point_table(n=200):
+    sft = SimpleFeatureType.from_spec("t", "name:String,age:Int,dtg:Date,*geom:Point")
+    x = RNG.uniform(-180, 180, n)
+    y = RNG.uniform(-90, 90, n)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + RNG.integers(0, 30 * 86400000, n)
+    names = RNG.choice(["a", "b", "c"], n)
+    ages = RNG.integers(0, 100, n).astype(np.int32)
+    return FeatureTable.build(sft, {"name": names, "age": ages, "dtg": dtg, "geom": (x, y)})
+
+
+class TestParser:
+    def test_bbox(self):
+        f = parse_ecql("BBOX(geom, -10, -20, 30, 40)")
+        assert f == BBox("geom", -10, -20, 30, 40)
+
+    def test_during(self):
+        f = parse_ecql("dtg DURING 2020-01-01T00:00:00Z/2020-01-02T00:00:00Z")
+        assert isinstance(f, During)
+        assert f.lo == np.datetime64("2020-01-01", "ms").astype(np.int64)
+        assert not f.lo_inclusive
+
+    def test_and_or_not_precedence(self):
+        f = parse_ecql("age > 5 AND age < 10 OR NOT name = 'x'")
+        assert isinstance(f, ir.Or)
+        assert isinstance(f.children[0], ir.And)
+        assert isinstance(f.children[1], ir.Not)
+
+    def test_intersects(self):
+        f = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))")
+        assert isinstance(f, Intersects)
+        assert f.geometry[0] == 3
+
+    def test_fid_in(self):
+        f = parse_ecql("IN ('a', 'b')")
+        assert f == ir.FidFilter(("a", "b"))
+
+    def test_attr_in(self):
+        f = parse_ecql("name IN ('a', 'b')")
+        assert f == ir.In("name", ("a", "b"))
+
+    def test_cmp_ops(self):
+        assert parse_ecql("age >= 5") == Cmp(">=", "age", 5)
+        assert parse_ecql("name = 'bob'") == Cmp("=", "name", "bob")
+        assert parse_ecql("age <> 3") == Cmp("<>", "age", 3)
+
+    def test_include_exclude(self):
+        assert isinstance(parse_ecql("INCLUDE"), ir.Include)
+        assert isinstance(parse_ecql(""), ir.Include)
+        assert isinstance(parse_ecql("EXCLUDE"), ir.Exclude)
+
+    def test_dwithin(self):
+        f = parse_ecql("DWITHIN(geom, POINT (1 2), 0.5, degrees)")
+        assert isinstance(f, ir.Dwithin)
+        assert f.distance == 0.5
+
+    def test_garbage_raises(self):
+        with pytest.raises(ValueError):
+            parse_ecql("FOO BAR(")
+
+
+class TestEvaluate:
+    def test_bbox_points(self):
+        t = point_table()
+        mask = evaluate(parse_ecql("BBOX(geom, 0, 0, 90, 45)"), t)
+        x, y = t.geometry().point_xy()
+        expected = (x >= 0) & (x <= 90) & (y >= 0) & (y <= 45)
+        np.testing.assert_array_equal(mask, expected)
+
+    def test_during(self):
+        t = point_table()
+        f = parse_ecql("dtg DURING 2020-01-05T00:00:00Z/2020-01-10T00:00:00Z")
+        dtg = t.column("dtg")
+        lo = np.datetime64("2020-01-05", "ms").astype(np.int64)
+        hi = np.datetime64("2020-01-10", "ms").astype(np.int64)
+        np.testing.assert_array_equal(evaluate(f, t), (dtg > lo) & (dtg < hi))
+
+    def test_combined(self):
+        t = point_table()
+        f = parse_ecql("BBOX(geom, -90, -45, 90, 45) AND age > 50 AND name = 'a'")
+        mask = evaluate(f, t)
+        x, y = t.geometry().point_xy()
+        names = np.array(t.column("name").decode(np.arange(len(t))))
+        expected = (x >= -90) & (x <= 90) & (y >= -45) & (y <= 45) \
+            & (t.column("age") > 50) & (names == "a")
+        np.testing.assert_array_equal(mask, expected)
+
+    def test_point_in_polygon_triangle(self):
+        sft = SimpleFeatureType.from_spec("t", "*geom:Point")
+        t = FeatureTable.build(sft, {"geom": (np.array([1.0, 5.0, 2.0]), np.array([1.0, 5.0, 0.5]))})
+        f = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 4 0, 0 4, 0 0)))")
+        np.testing.assert_array_equal(evaluate(f, t), [True, False, True])
+
+    def test_polygon_with_hole(self):
+        sft = SimpleFeatureType.from_spec("t", "*geom:Point")
+        t = FeatureTable.build(sft, {"geom": (np.array([5.0, 1.0]), np.array([5.0, 1.0]))})
+        f = parse_ecql(
+            "INTERSECTS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4)))")
+        np.testing.assert_array_equal(evaluate(f, t), [False, True])
+
+    def test_intersects_lines(self):
+        sft = SimpleFeatureType.from_spec("t", "*geom:LineString")
+        t = FeatureTable.build(sft, {"geom": [
+            "LINESTRING (0 0, 10 10)",        # crosses polygon
+            "LINESTRING (20 20, 30 30)",      # outside
+            "LINESTRING (-5 5, 15 5)",        # crosses through
+        ]})
+        f = parse_ecql("INTERSECTS(geom, POLYGON ((2 2, 8 2, 8 8, 2 8, 2 2)))")
+        np.testing.assert_array_equal(evaluate(f, t), [True, False, True])
+
+    def test_within(self):
+        sft = SimpleFeatureType.from_spec("t", "*geom:LineString")
+        t = FeatureTable.build(sft, {"geom": [
+            "LINESTRING (3 3, 4 4)",
+            "LINESTRING (3 3, 20 20)",
+        ]})
+        f = parse_ecql("WITHIN(geom, POLYGON ((2 2, 8 2, 8 8, 2 8, 2 2)))")
+        np.testing.assert_array_equal(evaluate(f, t), [True, False])
+
+    def test_dwithin_points(self):
+        sft = SimpleFeatureType.from_spec("t", "*geom:Point")
+        t = FeatureTable.build(sft, {"geom": (np.array([0.0, 3.0]), np.array([0.0, 0.0]))})
+        f = parse_ecql("DWITHIN(geom, LINESTRING (1 -1, 1 1), 1.5, degrees)")
+        np.testing.assert_array_equal(evaluate(f, t), [True, False])
+
+    def test_fid_filter(self):
+        t = point_table(10)
+        mask = evaluate(ir.FidFilter(("3", "7")), t)
+        assert list(np.nonzero(mask)[0]) == [3, 7]
+
+
+class TestExtract:
+    def test_bbox_and_interval(self):
+        f = parse_ecql(
+            "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-01T00:00:00Z/2020-01-02T00:00:00Z")
+        ext = extract_bboxes(f, "geom")
+        assert ext.boxes == ((-10.0, -10.0, 10.0, 10.0),)
+        assert ext.exact
+        iv = extract_intervals(f, "dtg")
+        lo = np.datetime64("2020-01-01", "ms").astype(np.int64)
+        hi = np.datetime64("2020-01-02", "ms").astype(np.int64)
+        assert iv.intervals == ((lo + 1, hi - 1),)
+        assert iv.exact
+
+    def test_intersection_of_boxes(self):
+        f = parse_ecql("BBOX(geom, -10, -10, 10, 10) AND BBOX(geom, 0, 0, 20, 20)")
+        ext = extract_bboxes(f, "geom")
+        assert ext.boxes == ((0.0, 0.0, 10.0, 10.0),)
+
+    def test_or_union(self):
+        f = parse_ecql("BBOX(geom, 0, 0, 1, 1) OR BBOX(geom, 5, 5, 6, 6)")
+        ext = extract_bboxes(f, "geom")
+        assert len(ext.boxes) == 2
+
+    def test_polygon_intersects_inexact_unless_rect(self):
+        tri = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 4 0, 0 4, 0 0)))")
+        assert not extract_bboxes(tri, "geom").exact
+        rect = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0)))")
+        assert extract_bboxes(rect, "geom").exact
+
+    def test_unconstrained(self):
+        f = parse_ecql("age > 5")
+        assert extract_bboxes(f, "geom").unconstrained
+        assert extract_intervals(f, "dtg").unconstrained
+
+    def test_no_spatial_in_or_branch(self):
+        f = parse_ecql("BBOX(geom, 0, 0, 1, 1) OR age > 5")
+        assert extract_bboxes(f, "geom").unconstrained
