@@ -1,28 +1,202 @@
 """DataStore facade — the framework entry point.
 
-≙ reference GeoTools ``DataStoreFinder`` + ``GeoMesaDataStore``
-(/root/reference/geomesa-index-api/.../geotools/GeoMesaDataStore.scala:49).
-Round-1 surface: an in-process registry of named stores; ``create_schema`` /
-``get_writer`` / ``get_query_runner`` land as the index layer comes up.
+≙ GeoTools ``DataStoreFinder`` + ``GeoMesaDataStore``
+(/root/reference/geomesa-index-api/.../geotools/GeoMesaDataStore.scala:49,
+MetadataBackedDataStore.scala:123). The TPU store keeps GeoMesa's lifecycle:
+
+  create_schema(sft)     — register the type, decide its indexes
+  get_writer(type)       — batch feature writer (append); indexes build on
+                           flush (bulk sort ≙ bulk ingest; incremental deltas
+                           arrive with the live/streaming layer)
+  query/count/explain    — plan + execute through QueryPlanner
+
+Backends are factories keyed by params, mirroring the DataStoreFactorySpi
+registry; the in-memory/TPU store registers as ``tpu`` (the moral slot of the
+reference's in-memory CQEngine store — and the perf comparison target).
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import ir
+from geomesa_tpu.index.api import QueryResult
+from geomesa_tpu.index.planner import QueryPlanner
+from geomesa_tpu.index.spatial import INDEX_CLASSES, FullScanIndex
+
+_INDEX_BY_NAME = {c.name: c for c in INDEX_CLASSES}
+
+
+class FeatureWriter:
+    """Batch appender (≙ GeoMesaFeatureWriter append mode). Collects rows
+    host-side; ``flush`` builds the columnar table and (re)builds indexes —
+    the precompute-all-mutations-then-write atomicity discipline
+    (IndexAdapter.scala:139-150) becomes build-then-swap."""
+
+    def __init__(self, store: "TpuDataStore", type_name: str):
+        self.store = store
+        self.type_name = type_name
+        self.sft = store.schemas[type_name]
+        self._rows: List[dict] = []
+        self._fids: List[Optional[str]] = []
+
+    def write(self, fid: Optional[str] = None, **attributes) -> str:
+        missing = [a.name for a in self.sft.attributes if a.name not in attributes]
+        if missing:
+            raise ValueError(f"Missing attributes {missing}")
+        self._rows.append(attributes)
+        if fid is None:
+            fid = f"{self.type_name}.{self.store._fid_counter(self.type_name)}"
+        self._fids.append(fid)
+        return fid
+
+    def flush(self) -> None:
+        if not self._rows:
+            return
+        data: Dict[str, list] = {a.name: [] for a in self.sft.attributes}
+        for row in self._rows:
+            for a in self.sft.attributes:
+                data[a.name].append(row[a.name])
+        cols: Dict[str, object] = {}
+        for a in self.sft.attributes:
+            if a.is_geometry:
+                vals = data[a.name]
+                if vals and isinstance(vals[0], (tuple, list)) and len(vals[0]) == 2 \
+                        and isinstance(vals[0][0], (int, float)):
+                    xy = np.asarray(vals, dtype=np.float64)
+                    cols[a.name] = GeometryArray.points(xy[:, 0], xy[:, 1])
+                else:
+                    cols[a.name] = GeometryArray.from_wkt(vals)
+            else:
+                cols[a.name] = data[a.name]
+        batch = FeatureTable.build(self.sft, cols, fids=self._fids)
+        self.store._append(self.type_name, batch)
+        self._rows, self._fids = [], []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self.flush()
+
+
+class TpuDataStore:
+    """In-process TPU-backed datastore."""
+
+    def __init__(self, params: Optional[dict] = None):
+        self.params = params or {}
+        self.schemas: Dict[str, SimpleFeatureType] = {}
+        self.tables: Dict[str, FeatureTable] = {}
+        self.planners: Dict[str, QueryPlanner] = {}
+        self._counters: Dict[str, int] = {}
+
+    # -- factory SPI --------------------------------------------------------
+
+    @classmethod
+    def can_process(cls, params: dict) -> bool:
+        return params.get("backend", "tpu") == "tpu"
+
+    @classmethod
+    def create(cls, params: dict) -> "TpuDataStore":
+        return cls(params)
+
+    # -- schema lifecycle ---------------------------------------------------
+
+    def create_schema(self, sft: Union[SimpleFeatureType, str],
+                      spec: Optional[str] = None) -> SimpleFeatureType:
+        if isinstance(sft, str):
+            sft = SimpleFeatureType.from_spec(sft, spec or "")
+        if sft.name in self.schemas:
+            raise ValueError(f"Schema {sft.name} already exists")
+        self.schemas[sft.name] = sft
+        self.tables[sft.name] = None
+        return sft
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self.schemas[type_name]
+
+    def get_type_names(self) -> List[str]:
+        return list(self.schemas)
+
+    def remove_schema(self, type_name: str) -> None:
+        for d in (self.schemas, self.tables, self.planners):
+            d.pop(type_name, None)
+
+    # -- writes -------------------------------------------------------------
+
+    def get_writer(self, type_name: str) -> FeatureWriter:
+        if type_name not in self.schemas:
+            raise KeyError(type_name)
+        return FeatureWriter(self, type_name)
+
+    def load(self, type_name: str, table: FeatureTable) -> None:
+        """Bulk load a prebuilt columnar table (the fast ingest path)."""
+        self._append(type_name, table)
+
+    def _append(self, type_name: str, batch: FeatureTable) -> None:
+        current = self.tables.get(type_name)
+        table = batch if current is None else FeatureTable.concat([current, batch])
+        self.tables[type_name] = table
+        self._rebuild_indexes(type_name)
+
+    def _rebuild_indexes(self, type_name: str) -> None:
+        sft = self.schemas[type_name]
+        table = self.tables[type_name]
+        names = sft.configured_indices
+        indexes: List[object] = []
+        for c in INDEX_CLASSES:
+            if names is not None and c.name not in names:
+                continue
+            if c.supports(sft):
+                indexes.append(c(sft, table))
+                break  # one primary spatial index (others on demand later)
+        indexes.append(FullScanIndex(sft, table))
+        self.planners[type_name] = QueryPlanner(sft, table, indexes)
+
+    def _fid_counter(self, type_name: str) -> int:
+        c = self._counters.get(type_name, 0)
+        self._counters[type_name] = c + 1
+        return c
+
+    # -- queries ------------------------------------------------------------
+
+    def planner(self, type_name: str) -> QueryPlanner:
+        if type_name not in self.planners:
+            if self.tables.get(type_name) is None:
+                raise ValueError(f"No data written to {type_name}")
+        return self.planners[type_name]
+
+    def query(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE") -> QueryResult:
+        return self.planner(type_name).query(f)
+
+    def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE") -> int:
+        return self.planner(type_name).count(f)
+
+    def explain(self, type_name: str, f: Union[str, ir.Filter]) -> dict:
+        return self.planner(type_name).explain(f)
 
 
 class DataStoreFinder:
-    """Registry of datastore factories, keyed by params (SPI-equivalent)."""
+    """Registry of datastore factories, keyed by params (SPI-equivalent,
+    ≙ META-INF/services DataStoreFactorySpi discovery)."""
 
-    _factories: Dict[str, type] = {}
+    _factories: List[type] = [TpuDataStore]
 
     @classmethod
-    def register(cls, name: str, factory: type) -> None:
-        cls._factories[name] = factory
+    def register(cls, factory: type) -> None:
+        if factory not in cls._factories:
+            cls._factories.append(factory)
 
     @classmethod
     def get_data_store(cls, **params):
-        for name, factory in cls._factories.items():
+        for factory in cls._factories:
             if factory.can_process(params):
                 return factory.create(params)
         raise ValueError(f"No datastore factory for params {sorted(params)}")

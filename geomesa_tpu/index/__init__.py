@@ -1,0 +1,19 @@
+"""Index core (≙ reference geomesa-index-api, SURVEY.md §2.4).
+
+GeoMesa's architecture separates pure key math, host planning, and
+data-parallel scan+filter; this package keeps that split TPU-natively:
+
+  - ``device``    — DeviceTable: the HBM-resident columnar projection of a
+                    FeatureTable in index-sorted order (the "server-side data")
+  - ``scan``      — jitted mask kernels (≙ Z3Filter/Z2Filter push-down filters
+                    + CqlTransformFilter residual evaluation)
+  - ``z2/z3/xz2/xz3/attribute/ids`` — index implementations (key encode, sort,
+                    range planning) (≙ index.index.* key spaces)
+  - ``planner``   — FilterSplitter / StrategyDecider / QueryPlanner
+  - ``api``       — shared plan/result datatypes
+"""
+
+from geomesa_tpu.index.api import IndexScanPlan, QueryResult
+from geomesa_tpu.index.planner import QueryPlanner
+
+__all__ = ["IndexScanPlan", "QueryResult", "QueryPlanner"]

@@ -1,0 +1,49 @@
+"""Shared index/plan/result datatypes (≙ reference index.api package:
+QueryStrategy/FilterStrategy/QueryPlan, api/package.scala:221-291)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import ir
+
+
+@dataclass
+class IndexScanPlan:
+    """One executable strategy: device primary params + residual split.
+
+    ≙ QueryStrategy (api/GeoMesaFeatureIndex.getQueryStrategy:248): the index
+    chosen, its primary key-space constraints (here: padded int box / time
+    window arrays), and the filter remainder split between device and host.
+    """
+
+    index: object                                  # BaseIndex
+    primary_kind: str                              # "point_boxes"|"bbox_overlap"|"none"
+    boxes_loose: Optional[np.ndarray] = None       # (B,4) int32
+    boxes_strict: Optional[np.ndarray] = None      # (B,4) int32 interior cells
+    windows: Optional[np.ndarray] = None           # (T,4) int32 exact bin/off
+    spatial_filter: Optional[ir.Filter] = None     # exact spatial nodes (refine)
+    spatial_exact: bool = True                     # extraction == predicate?
+    residual_device: Optional[tuple] = None        # (key, params, fn)
+    residual_host: Optional[ir.Filter] = None
+    full_filter: Optional[ir.Filter] = None        # original, for fallbacks
+    cost: float = 0.0
+    empty: bool = False                            # provably no results
+    explain: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class QueryResult:
+    """Materialized query output (≙ the reader side of QueryPlanner.runQuery)."""
+
+    indices: np.ndarray          # row indices into the master FeatureTable
+    table: FeatureTable          # hydrated rows (post filter/transform)
+    plan: Optional[IndexScanPlan] = None
+
+    @property
+    def count(self) -> int:
+        return len(self.indices)

@@ -1,0 +1,114 @@
+"""DeviceTable: the HBM-resident columnar projection the scan kernels read.
+
+≙ the data a GeoMesa region/tablet server holds for one index table: rows in
+index-key order with the serialized values (SURVEY.md §3.2 step 4). Here the
+"rows" are structure-of-arrays jnp buffers in index-sorted order:
+
+  - ``xi``/``yi``  int32 31-bit normalized coords (Z2SFC resolution — exact to
+                   ~2 cm; the canonical device coordinates for box tests)
+  - ``xf``/``yf``  float32 raw coords (aggregations, joins, density)
+  - ``bin``/``off`` int32 exact binned time (period bin + integer offset in
+                   period units — ms/s/min, exactly representable)
+  - bbox columns (extent geometries): f32 xmin/ymin/xmax/ymax
+  - attribute columns: numeric as int32/f32; strings as dictionary codes;
+                   dates additionally as (bin, off) when they are the primary
+                   temporal axis
+
+Only numeric-representable projections live on device; exact f64 coordinates
+and ragged geometry buffers stay host-side for refinement (the reference's
+full-filter path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.curves.binnedtime import TimePeriod, time_to_binned_time
+from geomesa_tpu.curves.normalize import NormalizedLat, NormalizedLon
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+
+LON31 = NormalizedLon(31)
+LAT31 = NormalizedLat(31)
+
+
+@dataclass
+class DeviceTable:
+    """Device-resident columns for one index, in index-sorted row order."""
+
+    n: int
+    columns: Dict[str, jnp.ndarray] = field(default_factory=dict)
+
+    def __getitem__(self, name: str) -> jnp.ndarray:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    @classmethod
+    def build(
+        cls,
+        table: FeatureTable,
+        perm: np.ndarray,
+        period: Optional[TimePeriod] = None,
+    ) -> "DeviceTable":
+        """Project ``table`` rows (reordered by ``perm``) onto the device.
+
+        period: when set, the default dtg column is decomposed into exact
+        (bin, off) int32 pairs for temporal predicates.
+        """
+        n = len(perm)
+        cols: Dict[str, jnp.ndarray] = {}
+
+        geom_attr = table.sft.geometry_attribute
+        if geom_attr is not None:
+            garr: GeometryArray = table.columns[geom_attr.name]
+            if garr.is_points:
+                x, y = garr.point_xy()
+                x, y = x[perm], y[perm]
+                cols["xi"] = jnp.asarray(LON31.normalize(x), dtype=jnp.int32)
+                cols["yi"] = jnp.asarray(LAT31.normalize(y), dtype=jnp.int32)
+                cols["xf"] = jnp.asarray(x, dtype=jnp.float32)
+                cols["yf"] = jnp.asarray(y, dtype=jnp.float32)
+            else:
+                bb = garr.bboxes()[perm]
+                cols["bxmin"] = jnp.asarray(bb[:, 0], dtype=jnp.float32)
+                cols["bymin"] = jnp.asarray(bb[:, 1], dtype=jnp.float32)
+                cols["bxmax"] = jnp.asarray(bb[:, 2], dtype=jnp.float32)
+                cols["bymax"] = jnp.asarray(bb[:, 3], dtype=jnp.float32)
+                # int31-normalized bbox for exact-ish box tests
+                cols["bxmin_i"] = jnp.asarray(LON31.normalize(bb[:, 0]), dtype=jnp.int32)
+                cols["bymin_i"] = jnp.asarray(LAT31.normalize(bb[:, 1]), dtype=jnp.int32)
+                cols["bxmax_i"] = jnp.asarray(LON31.normalize(bb[:, 2]), dtype=jnp.int32)
+                cols["bymax_i"] = jnp.asarray(LAT31.normalize(bb[:, 3]), dtype=jnp.int32)
+
+        dtg_attr = table.sft.dtg_attribute
+        if dtg_attr is not None and period is not None:
+            ms = np.asarray(table.columns[dtg_attr.name], dtype=np.int64)[perm]
+            bins, offs = time_to_binned_time(ms, period)
+            cols["bin"] = jnp.asarray(bins, dtype=jnp.int32)
+            cols["off"] = jnp.asarray(offs, dtype=jnp.int32)
+
+        for attr in table.sft.attributes:
+            if attr.is_geometry:
+                continue
+            raw = table.columns[attr.name]
+            if isinstance(raw, StringColumn):
+                cols[attr.name] = jnp.asarray(raw.codes[perm], dtype=jnp.int32)
+            elif attr.type_name == "Date":
+                # seconds resolution on device; exact ms compare via (bin,off)
+                # when this is the primary dtg, else host refine
+                cols[attr.name] = jnp.asarray(
+                    np.asarray(raw, dtype=np.int64)[perm] // 1000, dtype=jnp.int32)
+            elif attr.type_name == "Long":
+                cols[attr.name] = jnp.asarray(
+                    np.asarray(raw)[perm].astype(np.float64), dtype=jnp.float32)
+            elif attr.type_name == "Double":
+                cols[attr.name] = jnp.asarray(np.asarray(raw)[perm], dtype=jnp.float32)
+            else:
+                cols[attr.name] = jnp.asarray(np.asarray(raw)[perm])
+        return cls(n, cols)

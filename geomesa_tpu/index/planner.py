@@ -1,0 +1,163 @@
+"""Query planning & execution (≙ reference index.planning package:
+QueryPlanner.scala:36, FilterSplitter, StrategyDecider).
+
+Flow (mirrors call stack SURVEY.md §3.3):
+  1. parse/normalize the filter
+  2. ask each index for a strategy + heuristic cost; pick the cheapest
+     (CostBasedStrategyDecider:140-168 moral equivalent — stats integration
+     arrives with the stats subsystem)
+  3. execute: fused device mask scan → (count | nonzero-select) → host
+     boundary/residual refinement → hydrate rows
+
+Exactness: results are always exact. The device scan is a superset prune;
+definite matches come from strict (cell-interior) masks, and only the
+boundary band re-evaluates in f64 on the host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter.evaluate import evaluate as _evaluate
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.index.api import IndexScanPlan, QueryResult
+
+_SELECT_CAP = 1 << 16
+
+
+class QueryPlanner:
+    """Planner + executor for one feature type."""
+
+    def __init__(self, sft, table: FeatureTable, indexes: List[object]):
+        self.sft = sft
+        self.table = table
+        self.indexes = indexes
+        self._fid_map: Optional[Dict[str, int]] = None
+
+    # -- fid lookup (≙ IdIndex direct row lookup) ---------------------------
+
+    @property
+    def fid_map(self) -> Dict[str, int]:
+        if self._fid_map is None:
+            self._fid_map = {fid: i for i, fid in enumerate(self.table.fids)}
+        return self._fid_map
+
+    # -- planning -----------------------------------------------------------
+
+    def plan(self, f: Union[str, ir.Filter]) -> IndexScanPlan:
+        if isinstance(f, str):
+            f = parse_ecql(f)
+        if isinstance(f, ir.FidFilter):
+            return IndexScanPlan(None, "fid", full_filter=f, cost=0.5,
+                                 explain={"index": "id", "fids": f.fids})
+        if not self.indexes:
+            raise ValueError(f"No indexes for {self.sft.name}")
+        plans = [p for p in (idx.plan(f) for idx in self.indexes) if p is not None]
+        return min(plans, key=lambda p: p.cost)
+
+    def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:
+        """Hierarchical plan description (≙ Explainer / CLI explain)."""
+        plan = self.plan(f)
+        out = dict(plan.explain)
+        out.update({
+            "type": self.sft.name,
+            "strategy": plan.primary_kind,
+            "cost": plan.cost,
+            "empty": plan.empty,
+            "n_boxes": 0 if plan.boxes_loose is None else len(plan.boxes_loose),
+            "n_windows": 0 if plan.windows is None else len(plan.windows),
+        })
+        return out
+
+    # -- execution ----------------------------------------------------------
+
+    def count(self, f: Union[str, ir.Filter]) -> int:
+        plan = self.plan(f)
+        if plan.empty:
+            return 0
+        if plan.primary_kind == "fid":
+            return len(self._fid_rows(plan.full_filter))
+        if self._device_exact(plan):
+            if plan.boxes_strict is not None and plan.spatial_filter is not None:
+                definite = plan.index.kernels.count(
+                    plan.primary_kind, plan.boxes_strict, plan.windows,
+                    plan.residual_device)
+                band = self._band_rows(plan)
+                return definite + len(self._refine(plan, band, band_only=True))
+            return plan.index.kernels.count(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device)
+        return len(self.select_indices(f if isinstance(f, ir.Filter) else parse_ecql(f)))
+
+    def select_indices(self, f: Union[str, ir.Filter]) -> np.ndarray:
+        """Matching row indices (ascending) into the master table."""
+        plan = self.plan(f)
+        if plan.empty:
+            return np.empty(0, dtype=np.int64)
+        if plan.primary_kind == "fid":
+            return self._fid_rows(plan.full_filter)
+        if self._device_exact(plan) and plan.boxes_strict is not None \
+                and plan.spatial_filter is not None:
+            idx, _ = plan.index.kernels.select(
+                plan.primary_kind, plan.boxes_strict, plan.windows,
+                plan.residual_device, _SELECT_CAP)
+            definite = plan.index.perm[idx]
+            band = self._refine(plan, self._band_rows(plan), band_only=True)
+            return np.sort(np.concatenate([definite, band]))
+        # loose candidates -> host refine
+        idx, _ = plan.index.kernels.select(
+            plan.primary_kind, plan.boxes_loose, plan.windows,
+            plan.residual_device, _SELECT_CAP)
+        rows = plan.index.perm[idx]
+        if self._device_exact(plan):
+            return np.sort(rows)
+        return np.sort(self._refine(plan, rows, band_only=False))
+
+    def query(self, f: Union[str, ir.Filter]) -> QueryResult:
+        plan = self.plan(f)
+        rows = self.select_indices(f)
+        return QueryResult(rows, self.table.take(rows), plan)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _fid_rows(self, f: ir.FidFilter) -> np.ndarray:
+        rows = [self.fid_map[fid] for fid in f.fids if fid in self.fid_map]
+        return np.array(sorted(rows), dtype=np.int64)
+
+    @staticmethod
+    def _device_exact(plan: IndexScanPlan) -> bool:
+        """True when the device mask + (optional) band refine produce exact
+        results without a full host pass over candidates."""
+        if plan.residual_host is not None:
+            return False
+        if plan.spatial_filter is None:
+            return True
+        return plan.spatial_exact and plan.boxes_strict is not None
+
+    def _band_rows(self, plan: IndexScanPlan) -> np.ndarray:
+        """Rows in the loose∖strict boundary band (original table order)."""
+        stacked = np.stack([plan.boxes_loose, plan.boxes_strict])
+        idx, _ = plan.index.kernels.select(
+            plan.primary_kind + "_band", stacked, plan.windows,
+            plan.residual_device, _SELECT_CAP)
+        return plan.index.perm[idx]
+
+    def _refine(self, plan: IndexScanPlan, rows: np.ndarray, band_only: bool) -> np.ndarray:
+        """Host f64 re-evaluation of candidates (≙ full-filter path)."""
+        if len(rows) == 0:
+            return rows
+        sub = self.table.take(rows)
+        if band_only:
+            needed = plan.spatial_filter
+        else:
+            parts = [p for p in (plan.spatial_filter, plan.residual_host) if p is not None]
+            needed = ir.and_filters(parts) if parts else None
+        if needed is None:
+            return rows
+        mask = _evaluate(needed, sub)
+        return rows[mask]

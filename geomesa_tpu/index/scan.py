@@ -1,0 +1,349 @@
+"""Jitted scan kernels: the TPU equivalent of GeoMesa's server-side filters.
+
+≙ the push-down compute contract of SURVEY.md §2.4: ``Z3Filter.inBounds``
+(decode z, int box tests — filters/Z3Filter.scala:25-61) plus the residual
+CQL evaluation of ``FilterTransformIterator``/``CqlTransformFilter``. Instead
+of per-KV decode, the columns are already decoded int32 planes; a scan is one
+fused elementwise mask over N rows (bandwidth-bound on HBM), followed by
+count / nonzero-compaction / aggregation.
+
+Shape discipline: queries pad their box/window lists to fixed sizes (powers of
+two) so XLA compiles one kernel per (primary_kind, n_boxes, n_windows,
+residual_structure) — constants ride in arrays, so new query *values* never
+recompile.
+
+Exactness contract (mirrors the reference's contained-vs-overlapping ranges +
+useFullFilter, Z3IndexKeySpace.scala:235-249):
+  - ``strict`` masks use cell-interior bounds → every hit is a definite match
+    (like rows in a *contained* range: no further filtering)
+  - ``loose`` masks use cell-covering bounds → superset of matches; rows in
+    loose∖strict are the boundary band the host refines in f64
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.filter import ir
+
+# -- primary spatial/temporal masks -----------------------------------------
+
+
+def _point_box_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """Any-box containment for point layers. boxes (B,4) int32
+    [xlo, xhi, ylo, yhi] in 31-bit normalized space; empty boxes xlo>xhi."""
+    xi = cols["xi"][:, None]
+    yi = cols["yi"][:, None]
+    m = (
+        (xi >= boxes[None, :, 0]) & (xi <= boxes[None, :, 1])
+        & (yi >= boxes[None, :, 2]) & (yi <= boxes[None, :, 3])
+    )
+    return jnp.any(m, axis=1)
+
+
+def _bbox_overlap_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """Any-box envelope-overlap for extent layers (loose bbox semantics)."""
+    m = (
+        (cols["bxmin_i"][:, None] <= boxes[None, :, 1])
+        & (cols["bxmax_i"][:, None] >= boxes[None, :, 0])
+        & (cols["bymin_i"][:, None] <= boxes[None, :, 3])
+        & (cols["bymax_i"][:, None] >= boxes[None, :, 2])
+    )
+    return jnp.any(m, axis=1)
+
+
+def _time_mask(cols, windows: jnp.ndarray) -> jnp.ndarray:
+    """Any-window (bin, off) containment (≙ Z3Filter.timeInBounds semantics,
+    exact: offsets are unnormalized period units). windows (T,4) int32
+    [bin_lo, off_lo, bin_hi, off_hi]; empty windows bin_lo>bin_hi."""
+    b = cols["bin"][:, None]
+    o = cols["off"][:, None]
+    blo, olo = windows[None, :, 0], windows[None, :, 1]
+    bhi, ohi = windows[None, :, 2], windows[None, :, 3]
+    after_lo = (b > blo) | ((b == blo) & (o >= olo))
+    before_hi = (b < bhi) | ((b == bhi) & (o <= ohi))
+    return jnp.any(after_lo & before_hi & (blo <= bhi), axis=1)
+
+
+def _point_box_band_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """Boundary band: in loose cover but not in strict interior. boxes is
+    stacked (2, B, 4): [0]=loose, [1]=strict. These are the rows the host
+    refines in f64 (≙ overlapping-range rows that hit the full filter)."""
+    return _point_box_mask(cols, boxes[0]) & ~_point_box_mask(cols, boxes[1])
+
+
+def _bbox_overlap_band_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    return _bbox_overlap_mask(cols, boxes[0]) & ~_bbox_overlap_mask(cols, boxes[1])
+
+
+PRIMARY_FNS: Dict[str, Callable] = {
+    "point_boxes": _point_box_mask,
+    "point_boxes_band": _point_box_band_mask,
+    "bbox_overlap": _bbox_overlap_mask,
+    "bbox_overlap_band": _bbox_overlap_band_mask,
+}
+
+
+# -- residual predicate compiler --------------------------------------------
+
+
+class Unsupported(Exception):
+    """Raised when a predicate subtree can't run on device."""
+
+
+# attr type names whose device columns are exact representations
+_EXACT_DEVICE_TYPES = {"Int", "Integer", "Boolean", "String", "Float"}
+
+
+def compile_residual(f: Optional[ir.Filter], sft, string_vocabs: Dict[str, list]):
+    """IR → (structure_key, params ndarray list, fn(cols, params) -> mask).
+
+    Raises Unsupported for subtrees that must stay host-side. Constants are
+    hoisted into the params list so differing query values share one compiled
+    kernel (structure_key captures only the shape of the tree).
+    """
+    if f is None:
+        return "none", [], None
+
+    params: list = []
+
+    def const(v, dtype) -> int:
+        params.append(np.asarray(v, dtype=dtype))
+        return len(params) - 1
+
+    def walk(node: ir.Filter) -> Tuple[str, Callable]:
+        if isinstance(node, ir.Include):
+            return "inc", lambda cols, p: jnp.ones(
+                next(iter(cols.values())).shape[0], dtype=bool)
+        if isinstance(node, ir.Exclude):
+            return "exc", lambda cols, p: jnp.zeros(
+                next(iter(cols.values())).shape[0], dtype=bool)
+        if isinstance(node, ir.And):
+            keys, fns = zip(*(walk(c) for c in node.children))
+            return "and(" + ",".join(keys) + ")", \
+                lambda cols, p, fns=fns: functools.reduce(
+                    jnp.logical_and, [g(cols, p) for g in fns])
+        if isinstance(node, ir.Or):
+            keys, fns = zip(*(walk(c) for c in node.children))
+            return "or(" + ",".join(keys) + ")", \
+                lambda cols, p, fns=fns: functools.reduce(
+                    jnp.logical_or, [g(cols, p) for g in fns])
+        if isinstance(node, ir.Not):
+            k, g = walk(node.child)
+            return f"not({k})", lambda cols, p, g=g: ~g(cols, p)
+        if isinstance(node, ir.Cmp):
+            attr = sft.attribute(node.attr)
+            if attr.type_name == "String":
+                if node.op not in ("=", "<>"):
+                    raise Unsupported("ordered string cmp on device")
+                vocab = string_vocabs.get(node.attr)
+                if vocab is None:
+                    raise Unsupported("no vocab")
+                try:
+                    code = vocab.index(node.value)
+                except ValueError:
+                    code = -1  # matches nothing
+                i = const(code, np.int32)
+                if node.op == "=":
+                    return f"seq:{node.attr}", lambda cols, p, i=i, a=node.attr: cols[a] == p[i]
+                return f"sne:{node.attr}", lambda cols, p, i=i, a=node.attr: cols[a] != p[i]
+            if attr.type_name not in _EXACT_DEVICE_TYPES:
+                raise Unsupported(f"{attr.type_name} cmp is inexact on device")
+            dtype = np.float32 if attr.type_name == "Float" else np.int32
+            i = const(node.value, dtype)
+            op = node.op
+            key = f"cmp{op}:{node.attr}"
+
+            def g(cols, p, i=i, a=node.attr, op=op):
+                c = cols[a]
+                v = p[i]
+                return {"=": c == v, "<>": c != v, "<": c < v,
+                        "<=": c <= v, ">": c > v, ">=": c >= v}[op]
+            return key, g
+        if isinstance(node, ir.In):
+            attr = sft.attribute(node.attr)
+            if attr.type_name == "String":
+                vocab = string_vocabs.get(node.attr)
+                if vocab is None:
+                    raise Unsupported("no vocab")
+                codes = [vocab.index(v) for v in node.values if v in vocab] or [-1]
+            elif attr.type_name in ("Int", "Integer"):
+                codes = [int(v) for v in node.values]
+            else:
+                raise Unsupported("IN on non-int/string")
+            # pad to pow2 so membership lists of similar size share kernels
+            size = max(1, 1 << (len(codes) - 1).bit_length())
+            padded = codes + [codes[-1]] * (size - len(codes))
+            i = const(padded, np.int32)
+            return f"in{size}:{node.attr}", \
+                lambda cols, p, i=i, a=node.attr: jnp.any(
+                    cols[a][:, None] == p[i][None, :], axis=1)
+        if isinstance(node, ir.During):
+            dtg = sft.dtg_attribute
+            if dtg is None or node.attr != dtg.name:
+                raise Unsupported("During on non-dtg attr")
+            # exact (bin, off) bounds computed host-side in the planner via
+            # params: [bin_lo, off_lo, bin_hi, off_hi] — see plan_residual
+            raise Unsupported("During handled by primary time windows")
+        raise Unsupported(type(node).__name__)
+
+    key, fn = walk(f)
+    return key, params, fn
+
+
+def split_residual(f: Optional[ir.Filter], sft, string_vocabs):
+    """Split a residual filter into (device_part, host_part).
+
+    AND trees split per-child; any child the device compiler rejects stays on
+    the host (≙ reference splitting between pushed-down filter and client
+    post-filter). Returns (device_ir_or_None, host_ir_or_None).
+    """
+    if f is None or isinstance(f, ir.Include):
+        return None, None
+    children = f.children if isinstance(f, ir.And) else (f,)
+    dev, host = [], []
+    for c in children:
+        try:
+            compile_residual(c, sft, string_vocabs)
+            dev.append(c)
+        except Unsupported:
+            host.append(c)
+    return (
+        ir.and_filters(dev) if dev else None,
+        ir.and_filters(host) if host else None,
+    )
+
+
+# -- fused scan entry points ------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _mask_kernel(primary_kind: str, has_time: bool, residual_key: str, n_boxes: int, n_windows: int):
+    """Build the fused mask fn for one structural signature."""
+
+    def mask(cols, boxes, windows, rparams, residual_fn):
+        m = None
+        if primary_kind != "none":
+            m = PRIMARY_FNS[primary_kind](cols, boxes)
+        if has_time:
+            tm = _time_mask(cols, windows)
+            m = tm if m is None else (m & tm)
+        if residual_fn is not None:
+            rm = residual_fn(cols, rparams)
+            m = rm if m is None else (m & rm)
+        if m is None:
+            n = next(iter(cols.values())).shape[0]
+            m = jnp.ones(n, dtype=bool)
+        return m
+
+    return mask
+
+
+class ScanKernels:
+    """Compiled-scan cache for one DeviceTable (one index)."""
+
+    def __init__(self, device_cols: Dict[str, jnp.ndarray]):
+        self.cols = device_cols
+        self._jitted: Dict[tuple, Callable] = {}
+
+    def _get(self, mode: str, primary_kind: str, has_time: bool,
+             residual_key: str, residual_fn, n_boxes: int, n_windows: int,
+             capacity: int = 0):
+        key = (mode, primary_kind, has_time, residual_key, n_boxes, n_windows, capacity)
+        if key in self._jitted:
+            return self._jitted[key]
+        mask_fn = _mask_kernel(primary_kind, has_time, residual_key, n_boxes, n_windows)
+
+        if mode == "count":
+            def run(cols, boxes, windows, rparams):
+                return jnp.sum(mask_fn(cols, boxes, windows, rparams, residual_fn))
+        elif mode == "mask":
+            def run(cols, boxes, windows, rparams):
+                return mask_fn(cols, boxes, windows, rparams, residual_fn)
+        elif mode == "select":
+            n = next(iter(self.cols.values())).shape[0]
+
+            def run(cols, boxes, windows, rparams):
+                m = mask_fn(cols, boxes, windows, rparams, residual_fn)
+                idx = jnp.nonzero(m, size=capacity, fill_value=n)[0]
+                return idx, jnp.sum(m)
+        else:
+            raise ValueError(mode)
+
+        jitted = jax.jit(run)
+        self._jitted[key] = jitted
+        return jitted
+
+    # public API ------------------------------------------------------------
+
+    def count(self, primary_kind, boxes, windows, residual) -> int:
+        fn = self._get("count", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0])
+        return int(fn(self.cols, _dev(boxes), _dev(windows),
+                      [jnp.asarray(p) for p in residual[1]] if residual else []))
+
+    def mask(self, primary_kind, boxes, windows, residual) -> jnp.ndarray:
+        fn = self._get("mask", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0])
+        return fn(self.cols, _dev(boxes), _dev(windows),
+                  [jnp.asarray(p) for p in residual[1]] if residual else [])
+
+    def select(self, primary_kind, boxes, windows, residual, capacity: int):
+        """Returns (sorted-row indices ndarray, true_count). Grows capacity
+        and retries on overflow (fixed-capacity + overflow-retry per
+        SURVEY.md §7 hard-parts)."""
+        n = next(iter(self.cols.values())).shape[0]
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        while True:
+            fn = self._get("select", primary_kind, windows is not None,
+                           residual[0] if residual else "none",
+                           residual[2] if residual else None,
+                           0 if boxes is None else boxes.shape[0],
+                           0 if windows is None else windows.shape[0],
+                           capacity)
+            idx, cnt = fn(self.cols, _dev(boxes), _dev(windows), rp)
+            cnt = int(cnt)
+            if cnt <= capacity:
+                idx = np.asarray(idx[:cnt])
+                return idx, cnt
+            capacity = 1 << int(np.ceil(np.log2(cnt)))
+
+
+def _dev(a):
+    return None if a is None else jnp.asarray(a)
+
+
+# -- padding helpers --------------------------------------------------------
+
+EMPTY_BOX = np.array([1, 0, 1, 0], dtype=np.int32)       # xlo > xhi
+EMPTY_WINDOW = np.array([1, 0, 0, 0], dtype=np.int32)    # bin_lo > bin_hi
+
+
+def pad_boxes(boxes: np.ndarray, min_size: int = 1) -> np.ndarray:
+    """Pad (B,4) int32 box array to the next power-of-two count."""
+    b = max(min_size, len(boxes))
+    size = 1 << (b - 1).bit_length()
+    out = np.tile(EMPTY_BOX, (size, 1))
+    if len(boxes):
+        out[: len(boxes)] = boxes
+    return out
+
+
+def pad_windows(windows: np.ndarray, min_size: int = 1) -> np.ndarray:
+    b = max(min_size, len(windows))
+    size = 1 << (b - 1).bit_length()
+    out = np.tile(EMPTY_WINDOW, (size, 1))
+    if len(windows):
+        out[: len(windows)] = windows
+    return out

@@ -1,0 +1,334 @@
+"""Spatial index implementations: Z2 / Z3 / XZ2 / XZ3.
+
+≙ reference index.index.{z2,z3} key spaces (Z3IndexKeySpace.scala:34 etc.).
+Each index owns a device-resident projection of the table sorted in its key
+order (epoch-major for the temporal variants — the epoch bin is the row-key
+prefix exactly as in the reference's ``[shard][epoch:2][z:8]`` layout), plus
+host-side sorted key arrays for range pruning, and produces IndexScanPlans:
+
+  - spatial constraint → padded int31 boxes, loose (cell cover) + strict
+    (cell interior) — the contained/overlapping-range distinction
+  - temporal constraint → exact (bin, offset) windows (Z3Filter.timeInBounds)
+  - leftover predicates → device residual (compiled) + host residual
+
+The scan itself is a full-table fused mask (bandwidth-bound, fast on TPU);
+the sorted layout + host key arrays enable block-range pruning (searchsorted
+over the reference-style z-range cover) which the planner can enable for
+low-selectivity queries.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curves.binnedtime import TimePeriod, max_offset, time_to_binned_time
+from geomesa_tpu.curves.normalize import NormalizedLat, NormalizedLon
+from geomesa_tpu.curves.sfc import Z2SFC, Z3SFC
+from geomesa_tpu.curves.xz import XZ2SFC, XZ3SFC
+from geomesa_tpu.curves import zorder
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+from geomesa_tpu.filter import extract, ir
+from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
+from geomesa_tpu.index.api import IndexScanPlan
+from geomesa_tpu.index.device import DeviceTable, LON31, LAT31
+from geomesa_tpu.index.scan import ScanKernels, pad_boxes, pad_windows, split_residual, compile_residual
+
+
+def _strip_handled(f: ir.Filter, geom: Optional[str], dtg: Optional[str],
+                   spatial_exact: bool) -> Tuple[Optional[ir.Filter], Optional[ir.Filter]]:
+    """Split a top-level AND into (spatial nodes, rest-residual).
+
+    Spatial nodes on ``geom`` are handled by the primary boxes (dropped from
+    the residual only when extraction is exact); temporal nodes on ``dtg``
+    are always handled exactly by the windows. OR-rooted filters keep the
+    whole filter as residual (the boxes/windows are then just a superset
+    prefilter) — the conservative analogue of the reference's DNF expansion
+    fallback (FilterSplitter.scala:61-103).
+    """
+    children = f.children if isinstance(f, ir.And) else (f,)
+    if isinstance(f, ir.Or):
+        return None, f
+    spatial: List[ir.Filter] = []
+    rest: List[ir.Filter] = []
+    for c in children:
+        if isinstance(c, (ir.BBox, ir.Intersects, ir.Contains, ir.Within, ir.Dwithin)) \
+                and (geom is None or c.attr == geom):
+            spatial.append(c)
+        elif isinstance(c, ir.During) and c.attr == dtg:
+            pass  # exact via windows
+        elif isinstance(c, ir.Cmp) and c.attr == dtg and isinstance(c.value, (int, np.integer)):
+            pass  # exact via windows
+        elif isinstance(c, ir.Or):
+            rest.append(c)  # mixed OR: conservative residual
+        else:
+            rest.append(c)
+    spatial_f = ir.and_filters(spatial) if spatial else None
+    rest_f = ir.and_filters(rest) if rest else None
+    if spatial_f is not None and not spatial_exact:
+        rest_f = ir.and_filters([spatial_f] + ([rest_f] if rest_f else []))
+    return spatial_f, rest_f
+
+
+def _boxes31(boxes, strict: bool) -> np.ndarray:
+    """User-space boxes → (B,4) int32 [xlo, xhi, ylo, yhi] in 31-bit space."""
+    out = np.empty((len(boxes), 4), dtype=np.int32)
+    for i, (xmin, ymin, xmax, ymax) in enumerate(boxes):
+        xlo, xhi = int(LON31.normalize(xmin)), int(LON31.normalize(xmax))
+        ylo, yhi = int(LAT31.normalize(ymin)), int(LAT31.normalize(ymax))
+        if strict:
+            # interior cells only: every point in them is a definite match
+            xlo, xhi, ylo, yhi = xlo + 1, xhi - 1, ylo + 1, yhi - 1
+        out[i] = (xlo, xhi, ylo, yhi)
+    return out
+
+
+class BaseSpatialIndex:
+    """Shared machinery: device table, kernels, plan construction."""
+
+    name: str = "base"
+    temporal: bool = False
+    points: bool = True
+
+    def __init__(self, sft, table: FeatureTable):
+        self.sft = sft
+        self.table = table
+        self.geom = sft.geometry_attribute.name if sft.geometry_attribute else None
+        dtg = sft.dtg_attribute
+        self.dtg = dtg.name if dtg else None
+        self.period = TimePeriod.parse(sft.z3_interval) if self.dtg else None
+        self.perm = self._sort_permutation()
+        self.device = DeviceTable.build(table, self.perm, self.period)
+        self.kernels = ScanKernels(self.device.columns)
+        self.vocabs = {
+            name: col.vocab for name, col in table.columns.items()
+            if isinstance(col, StringColumn)
+        }
+
+    # subclasses supply the key sort ----------------------------------------
+
+    def _sort_permutation(self) -> np.ndarray:
+        raise NotImplementedError
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        raise NotImplementedError
+
+    # planning ---------------------------------------------------------------
+
+    def plan(self, f: ir.Filter) -> Optional[IndexScanPlan]:
+        ext = extract_bboxes(f, self.geom) if self.geom else extract.Extraction(
+            (extract.WHOLE_WORLD,), False)
+        iv = extract_intervals(f, self.dtg) if self.dtg else None
+
+        if len(ext.boxes) == 0 or (iv is not None and len(iv.intervals) == 0):
+            return IndexScanPlan(self, "none", empty=True, full_filter=f, cost=0.0)
+
+        spatial_f, residual = _strip_handled(f, self.geom, self.dtg, ext.exact)
+        if isinstance(f, ir.Or):
+            spatial_f = None  # full filter already in residual
+
+        boxes_loose = boxes_strict = None
+        kind = "none"
+        if not ext.unconstrained:
+            kind = "point_boxes" if self.points else "bbox_overlap"
+            boxes_loose = pad_boxes(_boxes31(ext.boxes, strict=False))
+            if ext.exact and self.points:
+                boxes_strict = pad_boxes(_boxes31(ext.boxes, strict=True))
+            # extent layers: bbox overlap is loose by nature (envelope vs
+            # geometry); exact refinement goes through spatial_filter
+
+        windows = None
+        if iv is not None and not iv.unconstrained:
+            w = np.empty((len(iv.intervals), 4), dtype=np.int32)
+            for i, (lo, hi) in enumerate(iv.intervals):
+                blo, olo = time_to_binned_time(lo, self.period)
+                bhi, ohi = time_to_binned_time(hi, self.period)
+                w[i] = (int(blo), int(olo), int(bhi), int(ohi))
+            windows = pad_windows(w)
+
+        dev_res, host_res = split_residual(residual, self.sft, self.vocabs)
+        compiled = compile_residual(dev_res, self.sft, self.vocabs) if dev_res else None
+
+        # extent layers or inexact extraction must refine spatially on host
+        spatial_host_needed = (spatial_f is not None) and (not ext.exact or not self.points)
+        if spatial_host_needed and not ext.exact:
+            spatial_refine = None            # already folded into residual
+        else:
+            spatial_refine = spatial_f
+
+        cost = self._cost(ext, iv)
+        return IndexScanPlan(
+            index=self,
+            primary_kind=kind,
+            boxes_loose=boxes_loose,
+            boxes_strict=boxes_strict,
+            windows=windows,
+            spatial_filter=spatial_refine,
+            spatial_exact=ext.exact and self.points,
+            residual_device=compiled,
+            residual_host=host_res,
+            full_filter=f,
+            cost=cost,
+            explain={"index": self.name, "boxes": ext.boxes,
+                     "intervals": None if iv is None else iv.intervals,
+                     "residual_device": dev_res, "residual_host": host_res},
+        )
+
+    def _cost(self, ext, iv) -> float:
+        """Heuristic strategy cost (≙ StrategyDecider index heuristics —
+        lower is better; spatio-temporal beats spatial beats full scan)."""
+        spatial = not ext.unconstrained
+        temporal = iv is not None and not iv.unconstrained
+        if self.temporal and spatial and temporal:
+            return 1.0
+        if spatial:
+            return 2.0 if not self.temporal else 2.5
+        if temporal and self.temporal:
+            return 3.0
+        return 10.0  # full scan
+
+    # explain ---------------------------------------------------------------
+
+    def key_ranges(self, plan: IndexScanPlan, max_ranges: int = 2000):
+        """Reference-style z/xz range decomposition for this plan (explain/
+        pruning; not needed for the full-scan execution path)."""
+        raise NotImplementedError
+
+
+class Z3Index(BaseSpatialIndex):
+    """Point + time: epoch-major (bin, z3) order (≙ Z3IndexKeySpace.scala:34,
+    row layout [shard][epoch:2][z:8])."""
+
+    name = "z3"
+    temporal = True
+    points = True
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        g = sft.geometry_attribute
+        return g is not None and g.type_name == "Point" and sft.dtg_attribute is not None
+
+    def _sort_permutation(self) -> np.ndarray:
+        garr = self.table.geometry()
+        x, y = garr.point_xy()
+        ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
+        bins, offs = time_to_binned_time(ms, self.period)
+        sfc = Z3SFC.apply(self.period)
+        z = sfc.index(x, y, np.minimum(offs, int(sfc.time.max)), lenient=True)
+        self._host_bins = None  # set after sort below
+        perm = np.lexsort((z, bins))
+        self._sorted_bins = bins[perm]
+        self._sorted_z = z[perm]
+        self._sfc = sfc
+        return perm
+
+    def key_ranges(self, plan, max_ranges: int = 2000):
+        ext = extract_bboxes(plan.full_filter, self.geom)
+        iv = extract_intervals(plan.full_filter, self.dtg)
+        ranges = []
+        for lo, hi in iv.intervals[:8] if not iv.unconstrained else []:
+            blo, olo = time_to_binned_time(lo, self.period)
+            bhi, ohi = time_to_binned_time(hi, self.period)
+            for b in range(int(blo), int(bhi) + 1):
+                t0 = int(olo) if b == int(blo) else 0
+                t1 = int(ohi) if b == int(bhi) else max_offset(self.period) - 1
+                rs = self._sfc.ranges(list(ext.boxes), [(t0, t1)], max_ranges=max_ranges)
+                ranges.append((b, rs))
+        return ranges
+
+
+class Z2Index(BaseSpatialIndex):
+    """Point, no time: z2 order (≙ Z2IndexKeySpace.scala:29)."""
+
+    name = "z2"
+    temporal = False
+    points = True
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        g = sft.geometry_attribute
+        return g is not None and g.type_name == "Point"
+
+    def _sort_permutation(self) -> np.ndarray:
+        x, y = self.table.geometry().point_xy()
+        z = Z2SFC().index(x, y, lenient=True)
+        self._sorted_z = np.sort(z)
+        return np.argsort(z, kind="stable")
+
+
+class XZ3Index(BaseSpatialIndex):
+    """Extent + time: (bin, xz3) order (≙ XZ3IndexKeySpace.scala:33)."""
+
+    name = "xz3"
+    temporal = True
+    points = False
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        g = sft.geometry_attribute
+        return g is not None and g.type_name != "Point" and sft.dtg_attribute is not None
+
+    def _sort_permutation(self) -> np.ndarray:
+        bb = self.table.geometry().bboxes()
+        ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
+        bins, offs = time_to_binned_time(ms, self.period)
+        sfc = XZ3SFC.apply(self.sft.xz_precision, self.period)
+        mins = np.stack([bb[:, 0], bb[:, 1], offs.astype(np.float64)], axis=1)
+        maxs = np.stack([bb[:, 2], bb[:, 3], offs.astype(np.float64)], axis=1)
+        xz = sfc.index(mins, maxs, lenient=True)
+        perm = np.lexsort((xz, bins))
+        self._sorted_bins = bins[perm]
+        self._sorted_xz = xz[perm]
+        return perm
+
+
+class XZ2Index(BaseSpatialIndex):
+    """Extent, no time: xz2 order (≙ XZ2IndexKeySpace.scala:28)."""
+
+    name = "xz2"
+    temporal = False
+    points = False
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        g = sft.geometry_attribute
+        return g is not None and g.type_name != "Point"
+
+    def _sort_permutation(self) -> np.ndarray:
+        bb = self.table.geometry().bboxes()
+        sfc = XZ2SFC.apply(self.sft.xz_precision)
+        xz = sfc.index(bb[:, [0, 1]], bb[:, [2, 3]], lenient=True)
+        self._sorted_xz = np.sort(xz)
+        return np.argsort(xz, kind="stable")
+
+
+class FullScanIndex(BaseSpatialIndex):
+    """Natural-order fallback for schemas with no usable spatial index or
+    queries no index serves (≙ the reference's full-table-scan strategy,
+    guarded there by QueryProperties.BlockFullTableScans)."""
+
+    name = "full"
+    temporal = False
+    points = True
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        return True
+
+    def _sort_permutation(self) -> np.ndarray:
+        return np.arange(len(self.table), dtype=np.int64)
+
+    def plan(self, f: ir.Filter) -> Optional[IndexScanPlan]:
+        dev_res, host_res = split_residual(
+            f if not isinstance(f, (ir.Include,)) else None, self.sft, self.vocabs)
+        compiled = compile_residual(dev_res, self.sft, self.vocabs) if dev_res else None
+        return IndexScanPlan(
+            index=self, primary_kind="none",
+            residual_device=compiled, residual_host=host_res, full_filter=f,
+            cost=100.0, explain={"index": self.name, "residual_host": host_res},
+        )
+
+
+INDEX_CLASSES = [Z3Index, XZ3Index, Z2Index, XZ2Index]

@@ -1,0 +1,153 @@
+"""End-to-end datastore tests (≙ the reference's TestGeoMesaDataStore-based
+suites, SURVEY.md §4): full planner/index/scan stack on the jax CPU backend,
+cross-checked against brute-force numpy evaluation on random data."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStoreFinder
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import evaluate, parse_ecql
+
+RNG = np.random.default_rng(123)
+
+
+def make_point_store(n=3000):
+    ds = DataStoreFinder.get_data_store(backend="tpu")
+    sft = ds.create_schema(
+        "gdelt", "name:String,count:Int,dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    x = RNG.uniform(-180, 180, n)
+    y = RNG.uniform(-90, 90, n)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + RNG.integers(0, 60 * 86400000, n)
+    table = FeatureTable.build(sft, {
+        "name": RNG.choice(["alpha", "bravo", "charlie"], n),
+        "count": RNG.integers(0, 1000, n).astype(np.int32),
+        "dtg": dtg,
+        "geom": (x, y),
+    })
+    ds.load("gdelt", table)
+    return ds, table
+
+
+QUERIES = [
+    "BBOX(geom, -10, -10, 10, 10)",
+    "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z",
+    "BBOX(geom, 170, 80, 180, 90)",
+    "dtg DURING 2020-01-05T00:00:00Z/2020-01-06T00:00:00Z",
+    "BBOX(geom, -10, -10, 10, 10) AND count > 500",
+    "BBOX(geom, -10, -10, 10, 10) AND name = 'alpha'",
+    "INTERSECTS(geom, POLYGON ((-20 -20, 20 -20, 0 30, -20 -20)))",
+    "BBOX(geom, -10, -10, 10, 10) OR BBOX(geom, 30, 30, 50, 50)",
+    "BBOX(geom, -10, -10, 10, 10) AND count > 500 AND name IN ('alpha', 'bravo')",
+    "INCLUDE",
+    "EXCLUDE",
+    "NOT BBOX(geom, -90, -45, 90, 45)",
+    "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z AND count <= 100",
+]
+
+
+class TestPointStoreParity:
+    @pytest.fixture(scope="class")
+    def store(self):
+        return make_point_store()
+
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_count_matches_brute_force(self, store, ecql):
+        ds, table = store
+        expected = int(evaluate(parse_ecql(ecql), table).sum())
+        assert ds.count("gdelt", ecql) == expected
+
+    @pytest.mark.parametrize("ecql", QUERIES)
+    def test_select_matches_brute_force(self, store, ecql):
+        ds, table = store
+        expected = np.nonzero(evaluate(parse_ecql(ecql), table))[0]
+        got = ds.planner("gdelt").select_indices(ecql)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_z3_chosen_for_spatiotemporal(self, store):
+        ds, _ = store
+        exp = ds.explain(
+            "gdelt",
+            "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z")
+        assert exp["index"] == "z3"
+        assert exp["n_boxes"] >= 1 and exp["n_windows"] >= 1
+
+    def test_fid_query(self, store):
+        ds, table = store
+        fid = table.fids[42]
+        res = ds.query("gdelt", f"IN ('{fid}')")
+        assert res.count == 1
+        assert res.table.fids[0] == fid
+
+    def test_query_hydrates_rows(self, store):
+        ds, table = store
+        res = ds.query("gdelt", "BBOX(geom, -10, -10, 10, 10)")
+        x, y = res.table.geometry().point_xy()
+        assert np.all((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10))
+
+
+class TestWriterPath:
+    def test_writer_roundtrip(self):
+        ds = DataStoreFinder.get_data_store(backend="tpu")
+        ds.create_schema("obs", "kind:String,dtg:Date,*geom:Point")
+        with ds.get_writer("obs") as w:
+            w.write(kind="a", dtg="2021-06-01T00:00:00", geom=(1.0, 2.0))
+            w.write(kind="b", dtg="2021-06-02T00:00:00", geom=(3.0, 4.0), fid="custom")
+        assert ds.count("obs") == 2
+        res = ds.query("obs", "kind = 'b'")
+        assert list(res.table.fids) == ["custom"]
+        assert res.table.to_dicts()[0]["geom"] == "POINT (3 4)"
+
+    def test_append_batches(self):
+        ds = DataStoreFinder.get_data_store(backend="tpu")
+        ds.create_schema("obs", "kind:String,dtg:Date,*geom:Point")
+        for batch in range(3):
+            with ds.get_writer("obs") as w:
+                for i in range(5):
+                    w.write(kind=f"k{batch}", dtg="2021-06-01T00:00:00",
+                            geom=(float(batch), float(i)))
+        assert ds.count("obs") == 15
+        assert ds.count("obs", "kind = 'k1'") == 5
+
+
+class TestExtentStore:
+    @pytest.fixture(scope="class")
+    def store(self):
+        ds = DataStoreFinder.get_data_store(backend="tpu")
+        sft = ds.create_schema("roads", "name:String,dtg:Date,*geom:LineString")
+        n = 500
+        x0 = RNG.uniform(-170, 170, n)
+        y0 = RNG.uniform(-80, 80, n)
+        wkts = [
+            f"LINESTRING ({x0[i]:.6f} {y0[i]:.6f}, {x0[i]+RNG.uniform(0,3):.6f} "
+            f"{y0[i]+RNG.uniform(0,3):.6f})"
+            for i in range(n)
+        ]
+        base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+        table = FeatureTable.build(sft, {
+            "name": RNG.choice(["r1", "r2"], n),
+            "dtg": base + RNG.integers(0, 30 * 86400000, n),
+            "geom": wkts,
+        })
+        ds.load("roads", table)
+        return ds, table
+
+    @pytest.mark.parametrize("ecql", [
+        "BBOX(geom, -10, -10, 10, 10)",
+        "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-02T00:00:00Z/2020-01-20T00:00:00Z",
+        "INTERSECTS(geom, POLYGON ((-30 -30, 30 -30, 0 40, -30 -30)))",
+        "BBOX(geom, -10, -10, 10, 10) AND name = 'r1'",
+    ])
+    def test_extent_parity(self, store, ecql):
+        ds, table = store
+        expected = int(evaluate(parse_ecql(ecql), table).sum())
+        assert ds.count("roads", ecql) == expected
+
+    def test_xz3_chosen(self, store):
+        ds, _ = store
+        exp = ds.explain(
+            "roads",
+            "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-02T00:00:00Z/2020-01-20T00:00:00Z")
+        assert exp["index"] == "xz3"
