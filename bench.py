@@ -1,0 +1,93 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline (BASELINE.md config 1): GDELT-like point corpus, Z3 spatio-temporal
+bbox+time query, p50 latency on the available accelerator, vs the brute-force
+vectorized-numpy in-memory CPU store (the moral equivalent of the reference's
+GeoCQEngine in-memory datastore, BASELINE.json configs[0]).
+
+Scale via GEOMESA_TPU_BENCH_N (default 20M points; the 100M headline target
+fits a v5e chip's HBM — raise the env var on real hardware).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.features.table import FeatureTable
+    from geomesa_tpu.index.planner import QueryPlanner
+    from geomesa_tpu.index.spatial import Z3Index
+
+    n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 20_000_000))
+    reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
+    rng = np.random.default_rng(1234)
+
+    # GDELT-like synthetic corpus: clustered lon/lat over 30 days
+    centers = rng.uniform([-120, -40], [140, 60], size=(64, 2))
+    which = rng.integers(0, 64, n)
+    x = np.clip(centers[which, 0] + rng.normal(0, 8, n), -180, 180)
+    y = np.clip(centers[which, 1] + rng.normal(0, 6, n), -90, 90)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + rng.integers(0, 30 * 86400000, n)
+
+    sft = SimpleFeatureType.from_spec(
+        "gdelt", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    table = FeatureTable.build(sft, {"dtg": dtg, "geom": (x, y)})
+
+    t0 = time.perf_counter()
+    idx = Z3Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    build_s = time.perf_counter() - t0
+
+    ecql = ("BBOX(geom, -10, 30, 30, 55) AND "
+            "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+
+    # warmup (compile)
+    count = planner.count(ecql)
+    jax.block_until_ready(next(iter(idx.device.columns.values())))
+
+    lat = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        planner.count(ecql)
+        lat.append(time.perf_counter() - t0)
+    p50_ms = float(np.median(lat) * 1000)
+
+    # CPU in-memory baseline: vectorized numpy mask (GeoCQEngine moral slot)
+    lo = np.datetime64("2020-01-05", "ms").astype(np.int64)
+    hi = np.datetime64("2020-01-12", "ms").astype(np.int64)
+    cpu = []
+    for _ in range(max(3, reps // 4)):
+        t0 = time.perf_counter()
+        ref = int(np.sum((x >= -10) & (x <= 30) & (y >= 30) & (y <= 55)
+                         & (dtg > lo) & (dtg < hi)))
+        cpu.append(time.perf_counter() - t0)
+    cpu_ms = float(np.median(cpu) * 1000)
+
+    assert count == ref, f"bench correctness check failed: {count} != {ref}"
+
+    print(json.dumps({
+        "metric": "z3_bbox_time_count_p50_latency",
+        "value": round(p50_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / p50_ms, 2),
+        "detail": {
+            "n_points": n,
+            "matched": count,
+            "cpu_numpy_ms": round(cpu_ms, 3),
+            "index_build_s": round(build_s, 2),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

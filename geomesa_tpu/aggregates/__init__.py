@@ -1,0 +1,9 @@
+"""Server-side aggregations (≙ reference index.iterators, SURVEY.md §2.4):
+DensityScan → scatter-add heat maps, StatsScan → device sketch reductions,
+BinAggregatingScan → packed trajectory records. Each runs as an alternate
+reducer over the same scan mask the query planner produces, exactly how the
+reference swaps aggregating iterators in via query hints."""
+
+from geomesa_tpu.aggregates.density import DensityGrid, density
+
+__all__ = ["DensityGrid", "density"]

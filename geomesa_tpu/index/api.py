@@ -23,13 +23,10 @@ class IndexScanPlan:
 
     index: object                                  # BaseIndex
     primary_kind: str                              # "point_boxes"|"bbox_overlap"|"none"
-    boxes_loose: Optional[np.ndarray] = None       # (B,4) int32
-    boxes_strict: Optional[np.ndarray] = None      # (B,4) int32 interior cells
+    boxes_loose: Optional[np.ndarray] = None       # (B,8) int32 fp62 planes
     windows: Optional[np.ndarray] = None           # (T,4) int32 exact bin/off
-    spatial_filter: Optional[ir.Filter] = None     # exact spatial nodes (refine)
-    spatial_exact: bool = True                     # extraction == predicate?
     residual_device: Optional[tuple] = None        # (key, params, fn)
-    residual_host: Optional[ir.Filter] = None
+    residual_host: Optional[ir.Filter] = None      # host-refined remainder
     full_filter: Optional[ir.Filter] = None        # original, for fallbacks
     cost: float = 0.0
     empty: bool = False                            # provably no results

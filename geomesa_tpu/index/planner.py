@@ -82,13 +82,8 @@ class QueryPlanner:
             return 0
         if plan.primary_kind == "fid":
             return len(self._fid_rows(plan.full_filter))
-        if self._device_exact(plan):
-            if plan.boxes_strict is not None and plan.spatial_filter is not None:
-                definite = plan.index.kernels.count(
-                    plan.primary_kind, plan.boxes_strict, plan.windows,
-                    plan.residual_device)
-                band = self._band_rows(plan)
-                return definite + len(self._refine(plan, band, band_only=True))
+        if plan.residual_host is None:
+            # fully device-exact: one fused reduction, one roundtrip
             return plan.index.kernels.count(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
@@ -101,22 +96,13 @@ class QueryPlanner:
             return np.empty(0, dtype=np.int64)
         if plan.primary_kind == "fid":
             return self._fid_rows(plan.full_filter)
-        if self._device_exact(plan) and plan.boxes_strict is not None \
-                and plan.spatial_filter is not None:
-            idx, _ = plan.index.kernels.select(
-                plan.primary_kind, plan.boxes_strict, plan.windows,
-                plan.residual_device, _SELECT_CAP)
-            definite = plan.index.perm[idx]
-            band = self._refine(plan, self._band_rows(plan), band_only=True)
-            return np.sort(np.concatenate([definite, band]))
-        # loose candidates -> host refine
         idx, _ = plan.index.kernels.select(
             plan.primary_kind, plan.boxes_loose, plan.windows,
             plan.residual_device, _SELECT_CAP)
         rows = plan.index.perm[idx]
-        if self._device_exact(plan):
+        if plan.residual_host is None:
             return np.sort(rows)
-        return np.sort(self._refine(plan, rows, band_only=False))
+        return np.sort(self._refine(plan, rows))
 
     def query(self, f: Union[str, ir.Filter]) -> QueryResult:
         plan = self.plan(f)
@@ -129,35 +115,11 @@ class QueryPlanner:
         rows = [self.fid_map[fid] for fid in f.fids if fid in self.fid_map]
         return np.array(sorted(rows), dtype=np.int64)
 
-    @staticmethod
-    def _device_exact(plan: IndexScanPlan) -> bool:
-        """True when the device mask + (optional) band refine produce exact
-        results without a full host pass over candidates."""
-        if plan.residual_host is not None:
-            return False
-        if plan.spatial_filter is None:
-            return True
-        return plan.spatial_exact and plan.boxes_strict is not None
-
-    def _band_rows(self, plan: IndexScanPlan) -> np.ndarray:
-        """Rows in the loose∖strict boundary band (original table order)."""
-        stacked = np.stack([plan.boxes_loose, plan.boxes_strict])
-        idx, _ = plan.index.kernels.select(
-            plan.primary_kind + "_band", stacked, plan.windows,
-            plan.residual_device, _SELECT_CAP)
-        return plan.index.perm[idx]
-
-    def _refine(self, plan: IndexScanPlan, rows: np.ndarray, band_only: bool) -> np.ndarray:
-        """Host f64 re-evaluation of candidates (≙ full-filter path)."""
-        if len(rows) == 0:
+    def _refine(self, plan: IndexScanPlan, rows: np.ndarray) -> np.ndarray:
+        """Host f64 re-evaluation of device candidates against the residual
+        (≙ the reference's full-filter path over overlapping-range rows)."""
+        if len(rows) == 0 or plan.residual_host is None:
             return rows
         sub = self.table.take(rows)
-        if band_only:
-            needed = plan.spatial_filter
-        else:
-            parts = [p for p in (plan.spatial_filter, plan.residual_host) if p is not None]
-            needed = ir.and_filters(parts) if parts else None
-        if needed is None:
-            return rows
-        mask = _evaluate(needed, sub)
+        mask = _evaluate(plan.residual_host, sub)
         return rows[mask]

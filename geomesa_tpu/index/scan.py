@@ -34,25 +34,41 @@ from geomesa_tpu.filter import ir
 # -- primary spatial/temporal masks -----------------------------------------
 
 
+def _ge62(hi, lo, qhi, qlo):
+    """Lexicographic fixed-point (hi, lo) >= (qhi, qlo)."""
+    return (hi > qhi) | ((hi == qhi) & (lo >= qlo))
+
+
+def _le62(hi, lo, qhi, qlo):
+    return (hi < qhi) | ((hi == qhi) & (lo <= qlo))
+
+
 def _point_box_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
-    """Any-box containment for point layers. boxes (B,4) int32
-    [xlo, xhi, ylo, yhi] in 31-bit normalized space; empty boxes xlo>xhi."""
-    xi = cols["xi"][:, None]
-    yi = cols["yi"][:, None]
+    """Any-box containment for point layers — EXACT (fp62 planes).
+
+    boxes (B, 8) int32: [qxlo_hi, qxlo_lo, qxhi_hi, qxhi_lo,
+                         qylo_hi, qylo_lo, qyhi_hi, qyhi_lo].
+    Empty boxes use qlo=max/qhi=0 so nothing matches.
+    """
+    xi, xl = cols["xi"][:, None], cols["xl"][:, None]
+    yi, yl = cols["yi"][:, None], cols["yl"][:, None]
+    b = boxes[None, :, :]
     m = (
-        (xi >= boxes[None, :, 0]) & (xi <= boxes[None, :, 1])
-        & (yi >= boxes[None, :, 2]) & (yi <= boxes[None, :, 3])
+        _ge62(xi, xl, b[..., 0], b[..., 1]) & _le62(xi, xl, b[..., 2], b[..., 3])
+        & _ge62(yi, yl, b[..., 4], b[..., 5]) & _le62(yi, yl, b[..., 6], b[..., 7])
     )
     return jnp.any(m, axis=1)
 
 
 def _bbox_overlap_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
-    """Any-box envelope-overlap for extent layers (loose bbox semantics)."""
+    """Any-box envelope-overlap for extent layers — EXACT on envelopes
+    (geometry-level refinement is the spatial residual's job)."""
+    b = boxes[None, :, :]
     m = (
-        (cols["bxmin_i"][:, None] <= boxes[None, :, 1])
-        & (cols["bxmax_i"][:, None] >= boxes[None, :, 0])
-        & (cols["bymin_i"][:, None] <= boxes[None, :, 3])
-        & (cols["bymax_i"][:, None] >= boxes[None, :, 2])
+        _le62(cols["bxmin_i"][:, None], cols["bxmin_l"][:, None], b[..., 2], b[..., 3])
+        & _ge62(cols["bxmax_i"][:, None], cols["bxmax_l"][:, None], b[..., 0], b[..., 1])
+        & _le62(cols["bymin_i"][:, None], cols["bymin_l"][:, None], b[..., 6], b[..., 7])
+        & _ge62(cols["bymax_i"][:, None], cols["bymax_l"][:, None], b[..., 4], b[..., 5])
     )
     return jnp.any(m, axis=1)
 
@@ -70,22 +86,9 @@ def _time_mask(cols, windows: jnp.ndarray) -> jnp.ndarray:
     return jnp.any(after_lo & before_hi & (blo <= bhi), axis=1)
 
 
-def _point_box_band_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
-    """Boundary band: in loose cover but not in strict interior. boxes is
-    stacked (2, B, 4): [0]=loose, [1]=strict. These are the rows the host
-    refines in f64 (≙ overlapping-range rows that hit the full filter)."""
-    return _point_box_mask(cols, boxes[0]) & ~_point_box_mask(cols, boxes[1])
-
-
-def _bbox_overlap_band_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
-    return _bbox_overlap_mask(cols, boxes[0]) & ~_bbox_overlap_mask(cols, boxes[1])
-
-
 PRIMARY_FNS: Dict[str, Callable] = {
     "point_boxes": _point_box_mask,
-    "point_boxes_band": _point_box_band_mask,
     "bbox_overlap": _bbox_overlap_mask,
-    "bbox_overlap_band": _bbox_overlap_band_mask,
 }
 
 
@@ -239,6 +242,8 @@ def _mask_kernel(primary_kind: str, has_time: bool, residual_key: str, n_boxes: 
         if m is None:
             n = next(iter(cols.values())).shape[0]
             m = jnp.ones(n, dtype=bool)
+        if "__valid__" in cols:
+            m = m & cols["__valid__"]
         return m
 
     return mask
@@ -265,13 +270,18 @@ class ScanKernels:
         elif mode == "mask":
             def run(cols, boxes, windows, rparams):
                 return mask_fn(cols, boxes, windows, rparams, residual_fn)
-        elif mode == "select":
+        elif mode == "select_packed":
+            # single-roundtrip select: [count, idx...] in ONE int32 array so
+            # the host pays a single device-fetch latency (transfers/dispatch
+            # are async; only result syncs block — this matters enormously
+            # when the chip sits behind an RPC tunnel).
             n = next(iter(self.cols.values())).shape[0]
 
             def run(cols, boxes, windows, rparams):
                 m = mask_fn(cols, boxes, windows, rparams, residual_fn)
                 idx = jnp.nonzero(m, size=capacity, fill_value=n)[0]
-                return idx, jnp.sum(m)
+                return jnp.concatenate([
+                    jnp.sum(m)[None].astype(jnp.int32), idx.astype(jnp.int32)])
         else:
             raise ValueError(mode)
 
@@ -300,23 +310,21 @@ class ScanKernels:
                   [jnp.asarray(p) for p in residual[1]] if residual else [])
 
     def select(self, primary_kind, boxes, windows, residual, capacity: int):
-        """Returns (sorted-row indices ndarray, true_count). Grows capacity
-        and retries on overflow (fixed-capacity + overflow-retry per
-        SURVEY.md §7 hard-parts)."""
-        n = next(iter(self.cols.values())).shape[0]
+        """Returns (sorted-row indices ndarray, true_count) in one roundtrip.
+        Grows capacity and retries on overflow (fixed-capacity +
+        overflow-retry per SURVEY.md §7 hard-parts)."""
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
         while True:
-            fn = self._get("select", primary_kind, windows is not None,
+            fn = self._get("select_packed", primary_kind, windows is not None,
                            residual[0] if residual else "none",
                            residual[2] if residual else None,
                            0 if boxes is None else boxes.shape[0],
                            0 if windows is None else windows.shape[0],
                            capacity)
-            idx, cnt = fn(self.cols, _dev(boxes), _dev(windows), rp)
-            cnt = int(cnt)
+            out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows), rp))
+            cnt = int(out[0])
             if cnt <= capacity:
-                idx = np.asarray(idx[:cnt])
-                return idx, cnt
+                return out[1: 1 + cnt].astype(np.int64), cnt
             capacity = 1 << int(np.ceil(np.log2(cnt)))
 
 
@@ -326,12 +334,14 @@ def _dev(a):
 
 # -- padding helpers --------------------------------------------------------
 
-EMPTY_BOX = np.array([1, 0, 1, 0], dtype=np.int32)       # xlo > xhi
+_I31MAX = (1 << 31) - 1
+# fp62 empty box: lo bound = +max, hi bound = 0 — matches nothing
+EMPTY_BOX = np.array([_I31MAX, _I31MAX, 0, 0, _I31MAX, _I31MAX, 0, 0], dtype=np.int32)
 EMPTY_WINDOW = np.array([1, 0, 0, 0], dtype=np.int32)    # bin_lo > bin_hi
 
 
 def pad_boxes(boxes: np.ndarray, min_size: int = 1) -> np.ndarray:
-    """Pad (B,4) int32 box array to the next power-of-two count."""
+    """Pad (B,8) int32 fp62 box array to the next power-of-two count."""
     b = max(min_size, len(boxes))
     size = 1 << (b - 1).bit_length()
     out = np.tile(EMPTY_BOX, (size, 1))

@@ -32,55 +32,56 @@ from geomesa_tpu.features.table import FeatureTable, StringColumn
 from geomesa_tpu.filter import extract, ir
 from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
 from geomesa_tpu.index.api import IndexScanPlan
-from geomesa_tpu.index.device import DeviceTable, LON31, LAT31
+from geomesa_tpu.index.device import DeviceTable, fp62_lat, fp62_lon
 from geomesa_tpu.index.scan import ScanKernels, pad_boxes, pad_windows, split_residual, compile_residual
 
 
 def _strip_handled(f: ir.Filter, geom: Optional[str], dtg: Optional[str],
-                   spatial_exact: bool) -> Tuple[Optional[ir.Filter], Optional[ir.Filter]]:
-    """Split a top-level AND into (spatial nodes, rest-residual).
+                   points: bool) -> Optional[ir.Filter]:
+    """Residual after removing predicates the primary boxes/windows enforce
+    exactly.
 
-    Spatial nodes on ``geom`` are handled by the primary boxes (dropped from
-    the residual only when extraction is exact); temporal nodes on ``dtg``
-    are always handled exactly by the windows. OR-rooted filters keep the
-    whole filter as residual (the boxes/windows are then just a superset
-    prefilter) — the conservative analogue of the reference's DNF expansion
-    fallback (FilterSplitter.scala:61-103).
+    A spatial node drops when its box extraction IS the predicate: BBox
+    (envelope-overlap semantics, exact for points and extents alike via the
+    fp62 envelope planes) and, for point layers only, exact-extracting
+    Intersects (point/rectangle literals). Temporal nodes on ``dtg`` always
+    drop (windows are exact). OR-rooted filters keep the whole filter as
+    residual (the boxes/windows become a superset prefilter) — the
+    conservative analogue of the reference's DNF expansion fallback
+    (FilterSplitter.scala:61-103).
     """
-    children = f.children if isinstance(f, ir.And) else (f,)
     if isinstance(f, ir.Or):
-        return None, f
-    spatial: List[ir.Filter] = []
+        return f
+    children = f.children if isinstance(f, ir.And) else (f,)
     rest: List[ir.Filter] = []
     for c in children:
         if isinstance(c, (ir.BBox, ir.Intersects, ir.Contains, ir.Within, ir.Dwithin)) \
                 and (geom is None or c.attr == geom):
-            spatial.append(c)
+            if isinstance(c, ir.BBox):
+                continue  # envelope semantics: primary boxes are exact
+            if points and extract_bboxes(c, geom).exact:
+                continue  # point-in-rectangle: primary boxes are exact
+            rest.append(c)
         elif isinstance(c, ir.During) and c.attr == dtg:
-            pass  # exact via windows
+            continue  # exact via windows
         elif isinstance(c, ir.Cmp) and c.attr == dtg and isinstance(c.value, (int, np.integer)):
-            pass  # exact via windows
-        elif isinstance(c, ir.Or):
-            rest.append(c)  # mixed OR: conservative residual
+            continue  # exact via windows
         else:
             rest.append(c)
-    spatial_f = ir.and_filters(spatial) if spatial else None
-    rest_f = ir.and_filters(rest) if rest else None
-    if spatial_f is not None and not spatial_exact:
-        rest_f = ir.and_filters([spatial_f] + ([rest_f] if rest_f else []))
-    return spatial_f, rest_f
+    return ir.and_filters(rest) if rest else None
 
 
-def _boxes31(boxes, strict: bool) -> np.ndarray:
-    """User-space boxes → (B,4) int32 [xlo, xhi, ylo, yhi] in 31-bit space."""
-    out = np.empty((len(boxes), 4), dtype=np.int32)
+def _boxes_fp62(boxes) -> np.ndarray:
+    """User-space boxes → (B, 8) int32 fp62 query planes:
+    [qxlo_hi, qxlo_lo, qxhi_hi, qxhi_lo, qylo_hi, qylo_lo, qyhi_hi, qyhi_lo].
+    Device comparisons against these reproduce f64 bounds exactly (device.fp62)."""
+    out = np.empty((len(boxes), 8), dtype=np.int32)
     for i, (xmin, ymin, xmax, ymax) in enumerate(boxes):
-        xlo, xhi = int(LON31.normalize(xmin)), int(LON31.normalize(xmax))
-        ylo, yhi = int(LAT31.normalize(ymin)), int(LAT31.normalize(ymax))
-        if strict:
-            # interior cells only: every point in them is a definite match
-            xlo, xhi, ylo, yhi = xlo + 1, xhi - 1, ylo + 1, yhi - 1
-        out[i] = (xlo, xhi, ylo, yhi)
+        xlo = fp62_lon(xmin)
+        xhi = fp62_lon(xmax)
+        ylo = fp62_lat(ymin)
+        yhi = fp62_lat(ymax)
+        out[i] = (xlo[0], xlo[1], xhi[0], xhi[1], ylo[0], ylo[1], yhi[0], yhi[1])
     return out
 
 
@@ -125,19 +126,13 @@ class BaseSpatialIndex:
         if len(ext.boxes) == 0 or (iv is not None and len(iv.intervals) == 0):
             return IndexScanPlan(self, "none", empty=True, full_filter=f, cost=0.0)
 
-        spatial_f, residual = _strip_handled(f, self.geom, self.dtg, ext.exact)
-        if isinstance(f, ir.Or):
-            spatial_f = None  # full filter already in residual
+        residual = _strip_handled(f, self.geom, self.dtg, self.points)
 
-        boxes_loose = boxes_strict = None
+        boxes_loose = None
         kind = "none"
         if not ext.unconstrained:
             kind = "point_boxes" if self.points else "bbox_overlap"
-            boxes_loose = pad_boxes(_boxes31(ext.boxes, strict=False))
-            if ext.exact and self.points:
-                boxes_strict = pad_boxes(_boxes31(ext.boxes, strict=True))
-            # extent layers: bbox overlap is loose by nature (envelope vs
-            # geometry); exact refinement goes through spatial_filter
+            boxes_loose = pad_boxes(_boxes_fp62(ext.boxes))
 
         windows = None
         if iv is not None and not iv.unconstrained:
@@ -151,22 +146,12 @@ class BaseSpatialIndex:
         dev_res, host_res = split_residual(residual, self.sft, self.vocabs)
         compiled = compile_residual(dev_res, self.sft, self.vocabs) if dev_res else None
 
-        # extent layers or inexact extraction must refine spatially on host
-        spatial_host_needed = (spatial_f is not None) and (not ext.exact or not self.points)
-        if spatial_host_needed and not ext.exact:
-            spatial_refine = None            # already folded into residual
-        else:
-            spatial_refine = spatial_f
-
         cost = self._cost(ext, iv)
         return IndexScanPlan(
             index=self,
             primary_kind=kind,
             boxes_loose=boxes_loose,
-            boxes_strict=boxes_strict,
             windows=windows,
-            spatial_filter=spatial_refine,
-            spatial_exact=ext.exact and self.points,
             residual_device=compiled,
             residual_host=host_res,
             full_filter=f,

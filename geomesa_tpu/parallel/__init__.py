@@ -1,0 +1,18 @@
+"""Distributed execution over a TPU device mesh (≙ SURVEY.md §2.12).
+
+The reference parallelizes by fanning query ranges across storage servers and
+merging per-server partials (BatchScanPlan, FeatureReducer). The TPU-native
+equivalent: shard the index-sorted columnar table across devices on a ``rows``
+mesh axis (epoch-major order → devices own contiguous epoch/z slices, the
+moral of region splits), replicate query constants, and let XLA insert the
+collectives (psum for counts/stats/density merges — the FeatureReducer step —
+all_gather only for survivor-row hydration).
+
+  - ``mesh``      — mesh construction + ShardedTable
+  - ``dist``      — distributed count/density/stats query steps
+  - ``join``      — broadcast-polygon spatial join with psum hit counts
+"""
+
+from geomesa_tpu.parallel.mesh import ShardedTable, create_mesh
+
+__all__ = ["ShardedTable", "create_mesh"]

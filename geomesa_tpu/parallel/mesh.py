@@ -1,0 +1,71 @@
+"""Device mesh + row-sharded tables.
+
+Sharding model: one logical axis ``rows``. The index-sorted table (epoch-major
+for temporal indexes) is padded to a multiple of the device count and laid out
+with ``NamedSharding(P("rows"))``, so each device owns a contiguous key-range
+slice — exactly the reference's tablet/region split discipline
+(DefaultSplitter.scala:34), with even row counts standing in for the
+stats-driven split points until the stats subsystem feeds the splitter.
+
+Pad rows carry ``__valid__ = False`` and out-of-domain key values so no
+predicate can match them; the mask kernels AND the valid plane when present.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(n_devices: Optional[int] = None, axis: str = "rows") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+@dataclass
+class ShardedTable:
+    """Row-sharded device columns + replication helpers."""
+
+    mesh: Mesh
+    n: int               # true row count (pre-padding)
+    n_padded: int
+    columns: Dict[str, jnp.ndarray]
+
+    @classmethod
+    def from_host_columns(cls, mesh: Mesh, host_cols: Dict[str, np.ndarray]) -> "ShardedTable":
+        n_dev = mesh.devices.size
+        n = len(next(iter(host_cols.values())))
+        n_padded = ((n + n_dev - 1) // n_dev) * n_dev
+        sharding = NamedSharding(mesh, P("rows"))
+        cols: Dict[str, jnp.ndarray] = {}
+        for name, arr in host_cols.items():
+            arr = np.asarray(arr)
+            if n_padded != n:
+                pad_val = _pad_value(name, arr.dtype)
+                pad = np.full((n_padded - n,) + arr.shape[1:], pad_val, dtype=arr.dtype)
+                arr = np.concatenate([arr, pad])
+            cols[name] = jax.device_put(arr, sharding)
+        valid = np.zeros(n_padded, dtype=bool)
+        valid[:n] = True
+        cols["__valid__"] = jax.device_put(valid, sharding)
+        return cls(mesh, n, n_padded, cols)
+
+    def replicated(self, arr: np.ndarray) -> jnp.ndarray:
+        """Place query constants replicated on every device."""
+        return jax.device_put(np.asarray(arr), NamedSharding(self.mesh, P()))
+
+
+def _pad_value(name: str, dtype) -> object:
+    """Out-of-domain pad so padded rows fail every primary predicate."""
+    if dtype == np.bool_:
+        return False
+    if np.issubdtype(dtype, np.integer):
+        return -1 if name in ("xi", "yi", "bin", "off") else 0
+    return np.nan if np.issubdtype(dtype, np.floating) else 0

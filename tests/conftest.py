@@ -2,12 +2,18 @@
 multi-chip sharding paths compile and run without TPU hardware (the pattern
 recommended in SURVEY.md §4: XLA_FLAGS=--xla_force_host_platform_device_count=8).
 
-Must run before jax is imported anywhere.
+The container's sitecustomize imports jax at interpreter start and registers
+the axon TPU backend, so env vars set here are too late for jax's *import*;
+instead we update jax.config before any backend is initialized (pytest loads
+this conftest before test modules touch jax.devices()).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

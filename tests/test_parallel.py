@@ -1,0 +1,128 @@
+"""Distributed tests on the 8-device virtual CPU mesh: sharded scans and
+joins must match single-device / brute-force results exactly."""
+
+import jax
+import numpy as np
+import pytest
+
+from geomesa_tpu import DataStoreFinder
+from geomesa_tpu.features.geometry import parse_wkt
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import evaluate, parse_ecql
+from geomesa_tpu.filter import geom_numpy as gn
+from geomesa_tpu.parallel.dist import DistributedScan
+from geomesa_tpu.parallel.join import SpatialJoin
+from geomesa_tpu.parallel.mesh import ShardedTable, create_mesh
+
+RNG = np.random.default_rng(99)
+
+
+@pytest.fixture(scope="module")
+def point_store():
+    ds = DataStoreFinder.get_data_store(backend="tpu")
+    sft = ds.create_schema("pts", "name:String,val:Int,dtg:Date,*geom:Point")
+    n = 5000
+    x = RNG.uniform(-180, 180, n)
+    y = RNG.uniform(-90, 90, n)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    table = FeatureTable.build(sft, {
+        "name": RNG.choice(["a", "b"], n),
+        "val": RNG.integers(0, 100, n).astype(np.int32),
+        "dtg": base + RNG.integers(0, 30 * 86400000, n),
+        "geom": (x, y),
+    })
+    ds.load("pts", table)
+    return ds, table
+
+
+@pytest.fixture(scope="module")
+def sharded_scan(point_store):
+    ds, _ = point_store
+    planner = ds.planner("pts")
+    idx = planner.indexes[0]
+    mesh = create_mesh()
+    host_cols = {k: np.asarray(v) for k, v in idx.device.columns.items()}
+    sharded = ShardedTable.from_host_columns(mesh, host_cols)
+    return planner, idx, DistributedScan(sharded)
+
+
+class TestDistributedScan:
+    def test_eight_devices_present(self):
+        assert len(jax.devices()) == 8
+
+    @pytest.mark.parametrize("ecql", [
+        "BBOX(geom, -10, -10, 10, 10)",
+        "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z",
+        "val > 50",
+        "INCLUDE",
+    ])
+    def test_sharded_count_matches(self, point_store, sharded_scan, ecql):
+        ds, table = point_store
+        planner, idx, dscan = sharded_scan
+        plan = planner.plan(ecql)
+        # distributed loose count must equal single-device loose count
+        single = idx.kernels.count(plan.primary_kind, plan.boxes_loose,
+                                   plan.windows, plan.residual_device)
+        assert dscan.count(plan) == single
+
+    def test_sharded_mask_matches(self, point_store, sharded_scan):
+        planner, idx, dscan = sharded_scan
+        plan = planner.plan("BBOX(geom, -30, -30, 30, 30)")
+        dist_mask = dscan.mask(plan)
+        local_mask = np.asarray(idx.kernels.mask(
+            plan.primary_kind, plan.boxes_loose, plan.windows, plan.residual_device))
+        np.testing.assert_array_equal(dist_mask, local_mask)
+
+    def test_sharded_density_matches_host(self, point_store, sharded_scan):
+        ds, table = point_store
+        planner, idx, dscan = sharded_scan
+        plan = planner.plan("BBOX(geom, -90, -45, 90, 45)")
+        grid = dscan.density(plan, (-90, -45, 90, 45), 64, 32)
+        assert grid.shape == (32, 64)
+        # total mass = number of matching points (all matches are inside bbox)
+        expected = int(evaluate(parse_ecql("BBOX(geom, -90, -45, 90, 45)"), table).sum())
+        assert int(grid.sum()) == expected
+
+
+class TestSpatialJoin:
+    def test_counts_match_host_pip(self):
+        n = 2000
+        x = RNG.uniform(-50, 50, n)
+        y = RNG.uniform(-50, 50, n)
+        polys = [
+            parse_wkt("POLYGON ((-40 -40, -10 -40, -10 -10, -40 -10, -40 -40))"),
+            parse_wkt("POLYGON ((0 0, 30 0, 15 25, 0 0))"),
+            parse_wkt("POLYGON ((-5 -5, 5 -5, 5 5, -5 5, -5 -5), (-2 -2, 2 -2, 2 2, -2 2, -2 -2))"),
+        ]
+        join = SpatialJoin(polys)
+        counts = join.counts(x.astype(np.float32), y.astype(np.float32))
+        for p, lit in enumerate(polys):
+            exact = gn.points_in_polygon(x, y, lit)
+            # f32 vs f64 may disagree only within a boundary band
+            assert abs(int(counts[p]) - int(exact.sum())) <= 2
+
+    def test_assign(self):
+        x = np.array([-20.0, 10.0, 0.0, 60.0], dtype=np.float32)
+        y = np.array([-20.0, 5.0, 0.0, 60.0], dtype=np.float32)
+        polys = [
+            parse_wkt("POLYGON ((-40 -40, -10 -40, -10 -10, -40 -10, -40 -40))"),
+            parse_wkt("POLYGON ((0 0, 30 0, 15 25, 0 0))"),
+        ]
+        join = SpatialJoin(polys)
+        got = join.assign(x, y)
+        assert got[0] == 0
+        assert got[1] == 1
+        assert got[3] == -1
+
+    def test_sharded_join(self, sharded_scan):
+        planner, idx, dscan = sharded_scan
+        sharded = dscan.sharded
+        polys = [parse_wkt("POLYGON ((-60 -60, 60 -60, 60 60, -60 60, -60 -60))")]
+        join = SpatialJoin(polys)
+        counts = join.counts(sharded.columns["xf"], sharded.columns["yf"],
+                             mask=sharded.columns["__valid__"], sharded=sharded)
+        x = np.asarray(sharded.columns["xf"])[: sharded.n]
+        y = np.asarray(sharded.columns["yf"])[: sharded.n]
+        exact = gn.points_in_polygon(x.astype(np.float64), y.astype(np.float64), polys[0])
+        assert abs(int(counts[0]) - int(exact.sum())) <= 2
