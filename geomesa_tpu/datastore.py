@@ -95,6 +95,7 @@ class TpuDataStore:
         self.schemas: Dict[str, SimpleFeatureType] = {}
         self.tables: Dict[str, FeatureTable] = {}
         self.planners: Dict[str, QueryPlanner] = {}
+        self._stats: Dict[str, object] = {}
         self._counters: Dict[str, int] = {}
 
     # -- factory SPI --------------------------------------------------------
@@ -126,7 +127,7 @@ class TpuDataStore:
         return list(self.schemas)
 
     def remove_schema(self, type_name: str) -> None:
-        for d in (self.schemas, self.tables, self.planners):
+        for d in (self.schemas, self.tables, self.planners, self._stats):
             d.pop(type_name, None)
 
     # -- writes -------------------------------------------------------------
@@ -147,6 +148,8 @@ class TpuDataStore:
         self._rebuild_indexes(type_name)
 
     def _rebuild_indexes(self, type_name: str) -> None:
+        from geomesa_tpu.stats.store import GeoMesaStats
+
         sft = self.schemas[type_name]
         table = self.tables[type_name]
         names = sft.configured_indices
@@ -158,7 +161,12 @@ class TpuDataStore:
                 indexes.append(c(sft, table))
                 break  # one primary spatial index (others on demand later)
         indexes.append(FullScanIndex(sft, table))
-        self.planners[type_name] = QueryPlanner(sft, table, indexes)
+        stats = self._stats.get(type_name) or GeoMesaStats(sft)
+        planner = QueryPlanner(sft, table, indexes, stats=stats)
+        stats.planner = planner
+        stats.update(table)  # ≙ statUpdater flush on write
+        self._stats[type_name] = stats
+        self.planners[type_name] = planner
 
     def _fid_counter(self, type_name: str) -> int:
         c = self._counters.get(type_name, 0)
@@ -181,6 +189,11 @@ class TpuDataStore:
 
     def explain(self, type_name: str, f: Union[str, ir.Filter]) -> dict:
         return self.planner(type_name).explain(f)
+
+    def stats(self, type_name: str):
+        """Per-type stats API (≙ GeoMesaDataStore.stats)."""
+        self.planner(type_name)  # materialize
+        return self._stats[type_name]
 
 
 class DataStoreFinder:

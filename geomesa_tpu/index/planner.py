@@ -33,10 +33,12 @@ _SELECT_CAP = 1 << 16
 class QueryPlanner:
     """Planner + executor for one feature type."""
 
-    def __init__(self, sft, table: FeatureTable, indexes: List[object]):
+    def __init__(self, sft, table: FeatureTable, indexes: List[object],
+                 stats=None):
         self.sft = sft
         self.table = table
         self.indexes = indexes
+        self.stats = stats  # GeoMesaStats for cost-based strategy selection
         self._fid_map: Optional[Dict[str, int]] = None
 
     # -- fid lookup (≙ IdIndex direct row lookup) ---------------------------
@@ -58,6 +60,31 @@ class QueryPlanner:
         if not self.indexes:
             raise ValueError(f"No indexes for {self.sft.name}")
         plans = [p for p in (idx.plan(f) for idx in self.indexes) if p is not None]
+        if self.stats is not None and self.stats.total > 0 and len(plans) > 1:
+            # cost-based strategy selection (≙ CostBasedStrategyDecider,
+            # StrategyDecider.scala:140-168): price each strategy by the
+            # estimated rows its PRIMARY constraints leave to scan; the
+            # heuristic cost breaks ties.
+            est = self.stats.estimator
+            n = self.stats.total
+
+            def priced(p):
+                if p.empty:
+                    return (0.0, p.cost)
+                sel = 1.0
+                boxes = p.explain.get("boxes")
+                if p.boxes_loose is not None and boxes:
+                    s = est.spatial_selectivity(boxes)
+                    if s is not None:
+                        sel *= s
+                intervals = p.explain.get("intervals")
+                if p.windows is not None and intervals:
+                    s = est.temporal_selectivity(intervals)
+                    if s is not None:
+                        sel *= s
+                return (sel * n, p.cost)
+
+            return min(plans, key=priced)
         return min(plans, key=lambda p: p.cost)
 
     def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:

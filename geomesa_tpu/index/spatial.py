@@ -137,10 +137,12 @@ class BaseSpatialIndex:
         windows = None
         if iv is not None and not iv.unconstrained:
             w = np.empty((len(iv.intervals), 4), dtype=np.int32)
+            i32 = (1 << 31) - 1  # open-ended intervals overflow the bin i32
             for i, (lo, hi) in enumerate(iv.intervals):
                 blo, olo = time_to_binned_time(lo, self.period)
                 bhi, ohi = time_to_binned_time(hi, self.period)
-                w[i] = (int(blo), int(olo), int(bhi), int(ohi))
+                w[i] = (max(-i32, int(blo)), int(olo),
+                        min(i32, int(bhi)), int(ohi))
             windows = pad_windows(w)
 
         dev_res, host_res = split_residual(residual, self.sft, self.vocabs)

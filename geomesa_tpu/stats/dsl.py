@@ -1,0 +1,193 @@
+"""Stat DSL parser + columnar observation driver.
+
+≙ the reference's parser-combinator Stat spec grammar (utils/stats/
+Stat.scala:40-131): semicolon-separated ``Name(args)`` calls, attribute names
+quoted. Examples accepted here exactly as there::
+
+    Count()
+    MinMax("dtg");Count()
+    Enumeration("name");TopK("name")
+    Frequency("name",12)
+    Histogram("val",20,0,100)
+    Z3Histogram("dtg","week")
+    GroupBy("cat",Count())
+
+``observe_table`` drives bulk observation from a FeatureTable — each sketch
+receives whole numpy columns (geometry → bbox planes / point coords; dtg for
+Z3Histogram → exact (bin, offset) decomposition).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from geomesa_tpu.curves.binnedtime import TimePeriod, max_offset, time_to_binned_time
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.stats import sketches as sk
+
+_CALL = re.compile(r"^\s*(\w+)\s*\(")
+
+
+def _split_args(body: str) -> List[str]:
+    """Split a call body on top-level commas (respects quotes and parens)."""
+    out, depth, quote, cur = [], 0, None, []
+    for ch in body:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return [a for a in out if a]
+
+
+def _unquote(s: str) -> str:
+    s = s.strip()
+    if len(s) >= 2 and s[0] in "\"'" and s[-1] == s[0]:
+        return s[1:-1]
+    return s
+
+
+def _split_calls(spec: str) -> List[str]:
+    """Split a spec on top-level semicolons."""
+    out, depth, quote, cur = [], 0, None, []
+    for ch in spec:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch == ";" and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur and "".join(cur).strip():
+        out.append("".join(cur).strip())
+    return out
+
+
+def parse_stat(spec: str) -> sk.Stat:
+    """Parse a Stat DSL string into a sketch (SeqStat when ';'-separated)."""
+    calls = _split_calls(spec)
+    if not calls:
+        raise ValueError(f"Empty stat spec: {spec!r}")
+    stats = [_parse_one(c) for c in calls]
+    return stats[0] if len(stats) == 1 else sk.SeqStat(stats)
+
+
+def _parse_one(call: str) -> sk.Stat:
+    m = _CALL.match(call)
+    if not m or not call.rstrip().endswith(")"):
+        raise ValueError(f"Invalid stat call: {call!r}")
+    name = m.group(1)
+    body = call[m.end(): call.rstrip().rfind(")")]
+    args = _split_args(body)
+    if name == "Count":
+        return sk.CountStat()
+    if name == "MinMax":
+        return sk.MinMaxStat(_unquote(args[0]))
+    if name == "Enumeration":
+        return sk.EnumerationStat(_unquote(args[0]))
+    if name == "TopK":
+        return sk.TopKStat(_unquote(args[0]))
+    if name == "Frequency":
+        return sk.FrequencyStat(_unquote(args[0]),
+                                int(args[1]) if len(args) > 1 else 12)
+    if name == "Histogram":
+        return sk.HistogramStat(_unquote(args[0]), int(args[1]),
+                                float(args[2]), float(args[3]))
+    if name == "Z2Histogram":
+        return sk.Z2HistogramStat(_unquote(args[0]),
+                                  int(args[1]) if len(args) > 1 else 5)
+    if name == "Z3Histogram":
+        return sk.Z3HistogramStat(_unquote(args[0]),
+                                  _unquote(args[1]) if len(args) > 1 else "week")
+    if name == "DescriptiveStats":
+        return sk.DescriptiveStat([_unquote(a) for a in args])
+    if name == "GroupBy":
+        return sk.GroupByStat(_unquote(args[0]), ",".join(args[1:]))
+    raise ValueError(f"Unknown stat: {name!r}")
+
+
+# -- columnar observation ----------------------------------------------------
+
+
+def _raw_column(table: FeatureTable, attr: str) -> np.ndarray:
+    col = table.columns[attr]
+    if isinstance(col, StringColumn):
+        return np.asarray(col.vocab, dtype=object)[col.codes]
+    if isinstance(col, GeometryArray):
+        raise TypeError("geometry columns are observed via bbox/point paths")
+    return np.asarray(col)
+
+
+def observe_table(stat: sk.Stat, table: FeatureTable,
+                  mask: Optional[np.ndarray] = None) -> sk.Stat:
+    """Observe every row of ``table`` (optionally mask-filtered) into ``stat``."""
+    sub = table if mask is None else table.take(np.nonzero(mask)[0])
+    n = len(sub)
+    if isinstance(stat, sk.SeqStat):
+        for s in stat.stats:
+            observe_table(s, sub)
+        return stat
+    if isinstance(stat, sk.CountStat):
+        stat.observe(n)
+        return stat
+    if isinstance(stat, sk.Z3HistogramStat):
+        period = TimePeriod.parse(stat.period)
+        ms = np.asarray(sub.columns[stat.dtg], dtype=np.int64)
+        bins, offs = time_to_binned_time(ms, period)
+        stat.observe(bins, offs, max_offset(period))
+        return stat
+    if isinstance(stat, sk.Z2HistogramStat):
+        garr = sub.columns[stat.attr]
+        if garr.is_points:
+            x, y = garr.point_xy()
+        else:
+            bb = garr.bboxes()
+            x, y = (bb[:, 0] + bb[:, 2]) / 2, (bb[:, 1] + bb[:, 3]) / 2
+        stat.observe(x, y)
+        return stat
+    if isinstance(stat, sk.MinMaxStat):
+        col = sub.columns[stat.attr]
+        if isinstance(col, GeometryArray):
+            stat.geometric = True
+            bb = col.bboxes()
+            stat.observe(bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3])
+        else:
+            stat.observe(_raw_column(sub, stat.attr))
+        return stat
+    if isinstance(stat, sk.GroupByStat):
+        sub_attrs = stat._template.attrs
+        stat.observe(_raw_column(sub, stat.attr),
+                     *[_raw_column(sub, a) for a in sub_attrs])
+        return stat
+    stat.observe(*[_raw_column(sub, a) for a in stat.attrs])
+    return stat

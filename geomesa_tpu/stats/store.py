@@ -1,0 +1,184 @@
+"""GeoMesaStats facade: cached sketches + exact stat scans.
+
+≙ reference `GeoMesaStats` API (geomesa-index-api/.../stats/
+GeoMesaStats.scala:30,51-160 — getCount/getBounds/getMinMax/getFrequency/
+getTopK/getHistogram with exact|estimated modes) and `MetadataBackedStats`
+(MetadataBackedStats.scala:36 — sketches recomputed on write and persisted
+with the catalog). Here the durable copy is the JSON-safe ``to_dict`` form
+(checkpointed with the catalog); the exact path runs the query engine's
+device scan to select rows, then bulk-observes the survivors with vectorized
+numpy — the filter *is* the expensive part and it runs on the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.stats import sketches as sk
+from geomesa_tpu.stats.dsl import observe_table, parse_stat
+from geomesa_tpu.stats.estimator import StatsBasedEstimator
+
+_NUMERIC = {"Int", "Integer", "Long", "Float", "Double"}
+
+
+def default_stat_specs(sft) -> List[str]:
+    """The per-type sketch battery computed on write (≙ the stats that
+    MetadataBackedStats.writeStat maintains: count, bounds, histograms,
+    frequencies for indexed attributes)."""
+    specs = ["Count()"]
+    geom = sft.geometry_attribute
+    dtg = sft.dtg_attribute
+    if geom is not None:
+        specs.append(f'MinMax("{geom.name}")')
+        specs.append(f'Z2Histogram("{geom.name}",5)')
+    if dtg is not None:
+        specs.append(f'MinMax("{dtg.name}")')
+        specs.append(f'Z3Histogram("{dtg.name}","{sft.z3_interval}")')
+    for a in sft.attributes:
+        if a.is_geometry or (dtg is not None and a.name == dtg.name):
+            continue
+        specs.append(f'MinMax("{a.name}")')
+        if a.type_name == "String":
+            specs.append(f'Frequency("{a.name}",12)')
+            specs.append(f'TopK("{a.name}")')
+    return specs
+
+
+class GeoMesaStats:
+    """Per-feature-type stats: cached estimates + exact scans."""
+
+    def __init__(self, sft, planner=None):
+        self.sft = sft
+        self.planner = planner  # set by the datastore after index build
+        self.cached: Dict[str, sk.Stat] = {}
+
+    # -- write path (≙ statUpdater.add + flush) ------------------------------
+
+    def update(self, table: FeatureTable) -> None:
+        """Recompute the default sketch battery over the full table (called
+        on writer flush; bulk recompute replaces the reference's incremental
+        observe since the columnar build is itself a bulk operation)."""
+        self.cached = {}
+        for spec in default_stat_specs(self.sft):
+            stat = parse_stat(spec)
+            observe_table(stat, table)
+            self.cached[spec] = stat
+
+    # -- estimation ----------------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        c = self.cached.get("Count()")
+        return c.count if isinstance(c, sk.CountStat) else 0
+
+    @property
+    def estimator(self) -> StatsBasedEstimator:
+        return StatsBasedEstimator(self.sft, self.cached, self.total)
+
+    # -- GeoMesaStats API ----------------------------------------------------
+
+    def get_count(self, f: Union[str, ir.Filter, None] = None,
+                  exact: bool = False) -> int:
+        f = self._filter(f)
+        if isinstance(f, ir.Include) and not exact:
+            return self.total
+        if exact:
+            return self.planner.count(f)
+        return self.estimator.estimate_count(f)
+
+    def get_bounds(self, f=None, exact: bool = False):
+        """(xmin, ymin, xmax, ymax) of the geometry attribute."""
+        geom = self.sft.geometry_attribute
+        if geom is None:
+            return None
+        if not exact:
+            mm = self._cached_minmax(geom.name)
+            if mm is not None and not mm.is_empty:
+                return (mm.min[0], mm.min[1], mm.max[0], mm.max[1])
+        stat = self.run_stat(f'MinMax("{geom.name}")', f, exact=True)
+        if stat.is_empty:
+            return None
+        return (stat.min[0], stat.min[1], stat.max[0], stat.max[1])
+
+    def get_min_max(self, attr: str, f=None, exact: bool = False) -> Optional[sk.MinMaxStat]:
+        if not exact:
+            mm = self._cached_minmax(attr)
+            if mm is not None:
+                return mm
+        return self.run_stat(f'MinMax("{attr}")', f, exact=True)
+
+    def get_frequency(self, attr: str, f=None, exact: bool = False):
+        if not exact:
+            fr = self._find_cached("frequency", attr)
+            if fr is not None:
+                return fr
+        return self.run_stat(f'Frequency("{attr}",12)', f, exact=True)
+
+    def get_top_k(self, attr: str, f=None, exact: bool = False):
+        if not exact:
+            tk = self._find_cached("topk", attr)
+            if tk is not None:
+                return tk
+        return self.run_stat(f'TopK("{attr}")', f, exact=True)
+
+    def get_enumeration(self, attr: str, f=None):
+        return self.run_stat(f'Enumeration("{attr}")', f, exact=True)
+
+    def get_histogram(self, attr: str, bins: int = 20, f=None,
+                      exact: bool = False) -> Optional[sk.HistogramStat]:
+        mm = self.get_min_max(attr, exact=False)
+        if mm is None or mm.is_empty or mm.geometric \
+                or not isinstance(mm.min, (int, float)):
+            return None  # only numeric/date attributes are binnable
+        lo, hi = float(mm.min), float(mm.max)
+        if hi <= lo:
+            hi = lo + 1.0
+        return self.run_stat(f'Histogram("{attr}",{bins},{lo},{hi})', f, exact=True)
+
+    # -- exact stat scans (≙ StatsScan) --------------------------------------
+
+    def run_stat(self, spec: str, f=None, exact: bool = True) -> sk.Stat:
+        """Compute a stat over rows matching ``f`` — the device scan selects,
+        numpy observes (≙ the distributed StatsScan + client-side merge)."""
+        stat = parse_stat(spec)
+        f = self._filter(f)
+        if self.planner is None:
+            raise ValueError("stats not attached to a planner")
+        if isinstance(f, ir.Include):
+            observe_table(stat, self.planner.table)
+        else:
+            rows = self.planner.select_indices(f)
+            observe_table(stat, self.planner.table.take(rows))
+        return stat
+
+    # -- helpers -------------------------------------------------------------
+
+    def _filter(self, f) -> ir.Filter:
+        if f is None:
+            return ir.Include()
+        if isinstance(f, str):
+            return parse_ecql(f)
+        return f
+
+    def _cached_minmax(self, attr: str) -> Optional[sk.MinMaxStat]:
+        return self._find_cached("minmax", attr)
+
+    def _find_cached(self, kind: str, attr: str):
+        return sk.find_stat(self.cached.values(), kind, attr)
+
+    # -- persistence (checkpointed with the catalog) -------------------------
+
+    def to_dict(self) -> dict:
+        return {spec: stat.to_dict() for spec, stat in self.cached.items()}
+
+    @classmethod
+    def from_dict(cls, sft, d: dict, planner=None) -> "GeoMesaStats":
+        out = cls(sft, planner)
+        out.cached = {spec: sk.from_dict(sd) for spec, sd in d.items()}
+        return out
