@@ -160,6 +160,9 @@ class TpuDataStore:
             if c.supports(sft):
                 indexes.append(c(sft, table))
                 break  # one primary spatial index (others on demand later)
+        from geomesa_tpu.index.attribute import AttributeIndex, indexed_attributes
+        for attr in indexed_attributes(sft):
+            indexes.append(AttributeIndex(sft, table, attr))
         indexes.append(FullScanIndex(sft, table))
         stats = self._stats.get(type_name) or GeoMesaStats(sft)
         planner = QueryPlanner(sft, table, indexes, stats=stats)

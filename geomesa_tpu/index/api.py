@@ -31,6 +31,22 @@ class IndexScanPlan:
     cost: float = 0.0
     empty: bool = False                            # provably no results
     explain: Dict[str, object] = field(default_factory=dict)
+    # attribute-index pruning: [lo, hi) slices (into the index's sorted
+    # order) of candidate rows; when set, the device scan gathers + masks
+    # only these rows (≙ a contiguous key-range scan instead of a full-table
+    # scan). Positions materialize lazily — pricing needs only the count.
+    candidate_slices: Optional[List[Tuple[int, int]]] = None
+
+    @property
+    def n_candidates(self) -> Optional[int]:
+        if self.candidate_slices is None:
+            return None
+        return sum(h - l for l, h in self.candidate_slices)
+
+    def candidate_positions(self) -> np.ndarray:
+        return np.concatenate(
+            [np.arange(l, h, dtype=np.int64) for l, h in self.candidate_slices]
+        ) if self.candidate_slices else np.empty(0, dtype=np.int64)
 
 
 @dataclass

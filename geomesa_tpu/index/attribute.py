@@ -1,0 +1,204 @@
+"""Attribute index: value-sorted rows + tiered spatio-temporal order.
+
+≙ reference AttributeIndex (index/attribute/AttributeIndexKeySpace.scala:35,
+AttributeIndexKey.scala:23-79): rows keyed ``[attr value][tier]`` where the
+tier is the Z3/date secondary key. The KV-store's lexicoded-bytes trick is
+unnecessary here — the TPU build sorts typed columns directly (string columns
+sort by dictionary code; vocabularies are built sorted so code order IS
+lexicographic order).
+
+Query path: equality / range / IN predicates on the attribute become
+``searchsorted`` slices over the host copy of the sorted values (≙ the row
+ranges of GeoMesaFeatureIndex.getQueryStrategy), producing candidate
+positions; the device scan gathers ONLY those rows and applies the remaining
+boxes/windows/residual mask (≙ scanning one key range with the pushdown
+filter attached, instead of the full table).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curves.binnedtime import time_to_binned_time
+from geomesa_tpu.features.table import StringColumn
+from geomesa_tpu.filter import ir
+from geomesa_tpu.index.api import IndexScanPlan
+from geomesa_tpu.index.spatial import BaseSpatialIndex
+
+# predicates an attribute slice can consume entirely
+_RANGE_OPS = {"=", "<", "<=", ">", ">="}
+
+
+def indexed_attributes(sft) -> List[str]:
+    """Attributes flagged for indexing: ``index=true``/``index=full`` options
+    (≙ the reference's attribute-spec opt, SimpleFeatureTypes) plus ``attr:X``
+    entries in ``geomesa.indices``."""
+    out = []
+    for a in sft.attributes:
+        if a.is_geometry:
+            continue
+        if a.options.get("index", "").lower() in ("true", "full", "join"):
+            out.append(a.name)
+    raw = sft.user_data.get("geomesa.indices", "")
+    for part in raw.split(","):
+        if ":" in part:
+            name, _, attr = part.partition(":")
+            if name == "attr" and attr and attr not in out:
+                out.append(attr)
+    return out
+
+
+class AttributeIndex(BaseSpatialIndex):
+    """One instance per indexed attribute (like the reference: one
+    GeoMesaFeatureIndex per attribute + secondary tier)."""
+
+    name = "attr"
+    temporal = True   # tier carries (bin, off) when the sft has a dtg
+    points = True
+
+    def __init__(self, sft, table, attr: str):
+        self.attr = attr
+        spec = sft.attribute(attr)
+        self.type_name = spec.type_name
+        g = sft.geometry_attribute
+        super().__init__(sft, table)
+        self.points = g is not None and g.type_name == "Point"
+
+    @classmethod
+    def supports(cls, sft) -> bool:
+        return bool(indexed_attributes(sft))
+
+    def _sort_permutation(self) -> np.ndarray:
+        col = self.table.columns[self.attr]
+        if isinstance(col, StringColumn):
+            vals = col.codes.astype(np.int64)
+            self._vocab = col.vocab
+        else:
+            vals = np.asarray(col)
+            self._vocab = None
+        # secondary tier: (bin, z3-ish) via dtg when present, else raw order
+        keys = [vals]
+        if self.dtg is not None:
+            ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
+            bins, offs = time_to_binned_time(ms, self.period)
+            keys = [offs, bins, vals]  # lexsort: last key is primary
+        perm = np.lexsort(keys)
+        self._sorted_vals = vals[perm]
+        return perm
+
+    # -- predicate extraction ------------------------------------------------
+
+    def _split_attr_predicate(self, f: ir.Filter):
+        """(consumable predicates on self.attr, remaining filter). Only
+        AND-rooted (or single) filters qualify — OR across attributes falls
+        back to other strategies (≙ FilterSplitter per-index primaries)."""
+        children = f.children if isinstance(f, ir.And) else (f,)
+        if isinstance(f, ir.Or):
+            return [], f
+        mine, rest = [], []
+        for c in children:
+            if isinstance(c, ir.Cmp) and c.attr == self.attr and c.op in _RANGE_OPS:
+                mine.append(c)
+            elif isinstance(c, ir.In) and c.attr == self.attr:
+                mine.append(c)
+            else:
+                rest.append(c)
+        return mine, (ir.and_filters(rest) if rest else None)
+
+    def _value_key(self, v):
+        """User value → sort-domain value."""
+        if self._vocab is not None:
+            return np.searchsorted(np.asarray(self._vocab, dtype=object), v), v
+        return v, v
+
+    def _slices(self, preds) -> Optional[List[Tuple[int, int]]]:
+        """Candidate [lo, hi) position slices from the predicates (None =
+        cannot consume: unsupported value type)."""
+        sv = self._sorted_vals
+        n = len(sv)
+        lo, hi = 0, n
+        points: Optional[List[Tuple[int, int]]] = None
+        for p in preds:
+            if isinstance(p, ir.In):
+                pts = []
+                for v in p.values:
+                    l, h = self._eq_slice(v)
+                    pts.append((l, h))
+                points = pts if points is None else [
+                    (max(l0, l1), min(h0, h1))
+                    for (l0, h0) in points for (l1, h1) in pts]
+                continue
+            code, raw = self._value_key(p.value)
+            if self._vocab is not None and p.op in ("<", "<=", ">", ">=", "="):
+                # string ordering: codes are lexicographic. Map the bound to a
+                # CODE CUTPOINT first (codes < cut satisfy </<=; codes >= cut
+                # satisfy >/>=) — bounds absent from the vocabulary land
+                # between codes, so the cut, not the insertion code, is exact.
+                if p.op == "=":
+                    l, h = self._eq_slice(raw)
+                    lo, hi = max(lo, l), min(hi, h)
+                    continue
+                vocab = np.asarray(self._vocab, dtype=object)
+                vside = "left" if p.op in ("<", ">=") else "right"
+                cut = int(np.searchsorted(vocab, raw, side=vside))
+                pos = int(np.searchsorted(sv, cut, side="left"))
+                if p.op in ("<", "<="):
+                    hi = min(hi, pos)
+                else:
+                    lo = max(lo, pos)
+                continue
+            if p.op == "=":
+                l = int(np.searchsorted(sv, code, side="left"))
+                h = int(np.searchsorted(sv, code, side="right"))
+                lo, hi = max(lo, l), min(hi, h)
+            elif p.op in ("<", "<="):
+                hi = min(hi, int(np.searchsorted(sv, code,
+                                                 side="left" if p.op == "<" else "right")))
+            else:  # > >=
+                lo = max(lo, int(np.searchsorted(sv, code,
+                                                 side="right" if p.op == ">" else "left")))
+        if points is not None:
+            return [(max(l, lo), min(h, hi)) for l, h in points if min(h, hi) > max(l, lo)]
+        return [(lo, hi)] if hi > lo else []
+
+    def _eq_slice(self, v) -> Tuple[int, int]:
+        if self._vocab is not None:
+            vocab = np.asarray(self._vocab, dtype=object)
+            pos = int(np.searchsorted(vocab, v))
+            if pos >= len(vocab) or vocab[pos] != v:
+                return (0, 0)
+            code = pos
+        else:
+            code = v
+        return (int(np.searchsorted(self._sorted_vals, code, side="left")),
+                int(np.searchsorted(self._sorted_vals, code, side="right")))
+
+    # -- planning ------------------------------------------------------------
+
+    def plan(self, f: ir.Filter) -> Optional[IndexScanPlan]:
+        mine, rest = self._split_attr_predicate(f)
+        if not mine:
+            return None
+        try:
+            slices = self._slices(mine)
+        except TypeError:
+            return None  # incomparable value type
+        if slices is not None and not slices:
+            return IndexScanPlan(self, "none", empty=True, full_filter=f, cost=0.0,
+                                 explain={"index": f"attr:{self.attr}"})
+        if slices is None:
+            return None
+        # remaining filter plans through the base machinery (boxes/windows/
+        # residual split); the slice enforces the attr predicates exactly
+        base = super().plan(rest if rest is not None else ir.Include())
+        base.candidate_slices = slices
+        base.full_filter = f
+        base.cost = 0.5 if not base.empty else 0.0  # exact-slice strategies win ties
+        base.explain.update({
+            "index": f"attr:{self.attr}",
+            "predicates": [type(p).__name__ for p in mine],
+            "candidates": base.n_candidates,
+        })
+        return base

@@ -71,6 +71,9 @@ class QueryPlanner:
             def priced(p):
                 if p.empty:
                     return (0.0, p.cost)
+                if p.candidate_slices is not None:
+                    # attribute slices: the scanned row count is exact
+                    return (float(p.n_candidates), p.cost)
                 sel = 1.0
                 boxes = p.explain.get("boxes")
                 if p.boxes_loose is not None and boxes:
@@ -111,6 +114,10 @@ class QueryPlanner:
             return len(self._fid_rows(plan.full_filter))
         if plan.residual_host is None:
             # fully device-exact: one fused reduction, one roundtrip
+            if plan.candidate_slices is not None:
+                return plan.index.kernels.count_at(
+                    plan.primary_kind, plan.boxes_loose, plan.windows,
+                    plan.residual_device, plan.candidate_positions())
             return plan.index.kernels.count(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
@@ -123,9 +130,14 @@ class QueryPlanner:
             return np.empty(0, dtype=np.int64)
         if plan.primary_kind == "fid":
             return self._fid_rows(plan.full_filter)
-        idx, _ = plan.index.kernels.select(
-            plan.primary_kind, plan.boxes_loose, plan.windows,
-            plan.residual_device, _SELECT_CAP)
+        if plan.candidate_slices is not None:
+            idx, _ = plan.index.kernels.select_at(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device, plan.candidate_positions())
+        else:
+            idx, _ = plan.index.kernels.select(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device, _SELECT_CAP)
         rows = plan.index.perm[idx]
         if plan.residual_host is None:
             return np.sort(rows)

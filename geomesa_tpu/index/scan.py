@@ -270,6 +270,23 @@ class ScanKernels:
         elif mode == "mask":
             def run(cols, boxes, windows, rparams):
                 return mask_fn(cols, boxes, windows, rparams, residual_fn)
+        elif mode == "count_at":
+            # candidate-pruned scan (attribute index): gather the candidate
+            # rows' columns, mask only those (≙ scanning one key range
+            # instead of the table)
+            def run(cols, boxes, windows, rparams, idxs, nvalid):
+                g = {k: v[idxs] for k, v in cols.items()}
+                m = mask_fn(g, boxes, windows, rparams, residual_fn)
+                m = m & (jnp.arange(idxs.shape[0]) < nvalid)
+                return jnp.sum(m)
+        elif mode == "select_at":
+            def run(cols, boxes, windows, rparams, idxs, nvalid):
+                g = {k: v[idxs] for k, v in cols.items()}
+                m = mask_fn(g, boxes, windows, rparams, residual_fn)
+                m = m & (jnp.arange(idxs.shape[0]) < nvalid)
+                sel = jnp.nonzero(m, size=idxs.shape[0], fill_value=idxs.shape[0])[0]
+                return jnp.concatenate([
+                    jnp.sum(m)[None].astype(jnp.int32), sel.astype(jnp.int32)])
         elif mode == "select_packed":
             # single-roundtrip select: [count, idx...] in ONE int32 array so
             # the host pays a single device-fetch latency (transfers/dispatch
@@ -309,6 +326,37 @@ class ScanKernels:
         return fn(self.cols, _dev(boxes), _dev(windows),
                   [jnp.asarray(p) for p in residual[1]] if residual else [])
 
+    def count_at(self, primary_kind, boxes, windows, residual,
+                 positions: np.ndarray) -> int:
+        """Count over candidate positions only (attribute-index pruning)."""
+        idxs, nvalid = _pad_positions(positions)
+        fn = self._get("count_at", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       idxs.shape[0])
+        return int(fn(self.cols, _dev(boxes), _dev(windows),
+                      [jnp.asarray(p) for p in residual[1]] if residual else [],
+                      jnp.asarray(idxs), nvalid))
+
+    def select_at(self, primary_kind, boxes, windows, residual,
+                  positions: np.ndarray):
+        """Surviving positions (subset of ``positions``) + count."""
+        idxs, nvalid = _pad_positions(positions)
+        fn = self._get("select_at", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       idxs.shape[0])
+        out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows),
+                            [jnp.asarray(p) for p in residual[1]] if residual else [],
+                            jnp.asarray(idxs), nvalid))
+        cnt = int(out[0])
+        sel = out[1: 1 + cnt].astype(np.int64)
+        return positions[sel], cnt
+
     def select(self, primary_kind, boxes, windows, residual, capacity: int):
         """Returns (sorted-row indices ndarray, true_count) in one roundtrip.
         Grows capacity and retries on overflow (fixed-capacity +
@@ -330,6 +378,17 @@ class ScanKernels:
 
 def _dev(a):
     return None if a is None else jnp.asarray(a)
+
+
+def _pad_positions(positions: np.ndarray):
+    """Pad a candidate-position array to the next power of two (shared jit
+    signatures across queries); padding rows point at row 0 and are masked
+    off by the valid-length compare."""
+    n = len(positions)
+    cap = max(8, 1 << max(0, (n - 1)).bit_length())
+    out = np.zeros(cap, dtype=np.int32)
+    out[:n] = positions
+    return out, np.int32(n)
 
 
 # -- padding helpers --------------------------------------------------------

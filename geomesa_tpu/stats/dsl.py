@@ -32,10 +32,10 @@ from geomesa_tpu.stats import sketches as sk
 _CALL = re.compile(r"^\s*(\w+)\s*\(")
 
 
-def _split_args(body: str) -> List[str]:
-    """Split a call body on top-level commas (respects quotes and parens)."""
+def _split_top(s: str, delim: str) -> List[str]:
+    """Split on top-level ``delim`` (respects quotes and parens)."""
     out, depth, quote, cur = [], 0, None, []
-    for ch in body:
+    for ch in s:
         if quote:
             cur.append(ch)
             if ch == quote:
@@ -49,7 +49,7 @@ def _split_args(body: str) -> List[str]:
         elif ch == ")":
             depth -= 1
             cur.append(ch)
-        elif ch == "," and depth == 0:
+        elif ch == delim and depth == 0:
             out.append("".join(cur).strip())
             cur = []
         else:
@@ -59,38 +59,19 @@ def _split_args(body: str) -> List[str]:
     return [a for a in out if a]
 
 
+def _split_args(body: str) -> List[str]:
+    return _split_top(body, ",")
+
+
+def _split_calls(spec: str) -> List[str]:
+    return _split_top(spec, ";")
+
+
 def _unquote(s: str) -> str:
     s = s.strip()
     if len(s) >= 2 and s[0] in "\"'" and s[-1] == s[0]:
         return s[1:-1]
     return s
-
-
-def _split_calls(spec: str) -> List[str]:
-    """Split a spec on top-level semicolons."""
-    out, depth, quote, cur = [], 0, None, []
-    for ch in spec:
-        if quote:
-            cur.append(ch)
-            if ch == quote:
-                quote = None
-        elif ch in "\"'":
-            quote = ch
-            cur.append(ch)
-        elif ch == "(":
-            depth += 1
-            cur.append(ch)
-        elif ch == ")":
-            depth -= 1
-            cur.append(ch)
-        elif ch == ";" and depth == 0:
-            out.append("".join(cur).strip())
-            cur = []
-        else:
-            cur.append(ch)
-    if cur and "".join(cur).strip():
-        out.append("".join(cur).strip())
-    return out
 
 
 def parse_stat(spec: str) -> sk.Stat:

@@ -101,7 +101,7 @@ class GeoMesaStats:
             mm = self._cached_minmax(geom.name)
             if mm is not None and not mm.is_empty:
                 return (mm.min[0], mm.min[1], mm.max[0], mm.max[1])
-        stat = self.run_stat(f'MinMax("{geom.name}")', f, exact=True)
+        stat = self.run_stat(f'MinMax("{geom.name}")', f)
         if stat.is_empty:
             return None
         return (stat.min[0], stat.min[1], stat.max[0], stat.max[1])
@@ -111,27 +111,27 @@ class GeoMesaStats:
             mm = self._cached_minmax(attr)
             if mm is not None:
                 return mm
-        return self.run_stat(f'MinMax("{attr}")', f, exact=True)
+        return self.run_stat(f'MinMax("{attr}")', f)
 
     def get_frequency(self, attr: str, f=None, exact: bool = False):
         if not exact:
             fr = self._find_cached("frequency", attr)
             if fr is not None:
                 return fr
-        return self.run_stat(f'Frequency("{attr}",12)', f, exact=True)
+        return self.run_stat(f'Frequency("{attr}",12)', f)
 
     def get_top_k(self, attr: str, f=None, exact: bool = False):
         if not exact:
             tk = self._find_cached("topk", attr)
             if tk is not None:
                 return tk
-        return self.run_stat(f'TopK("{attr}")', f, exact=True)
+        return self.run_stat(f'TopK("{attr}")', f)
 
     def get_enumeration(self, attr: str, f=None):
-        return self.run_stat(f'Enumeration("{attr}")', f, exact=True)
+        return self.run_stat(f'Enumeration("{attr}")', f)
 
-    def get_histogram(self, attr: str, bins: int = 20, f=None,
-                      exact: bool = False) -> Optional[sk.HistogramStat]:
+    def get_histogram(self, attr: str, bins: int = 20, f=None) -> Optional[sk.HistogramStat]:
+        """Always an exact scan — endpoints come from the cached MinMax."""
         mm = self.get_min_max(attr, exact=False)
         if mm is None or mm.is_empty or mm.geometric \
                 or not isinstance(mm.min, (int, float)):
@@ -139,11 +139,11 @@ class GeoMesaStats:
         lo, hi = float(mm.min), float(mm.max)
         if hi <= lo:
             hi = lo + 1.0
-        return self.run_stat(f'Histogram("{attr}",{bins},{lo},{hi})', f, exact=True)
+        return self.run_stat(f'Histogram("{attr}",{bins},{lo},{hi})', f)
 
     # -- exact stat scans (≙ StatsScan) --------------------------------------
 
-    def run_stat(self, spec: str, f=None, exact: bool = True) -> sk.Stat:
+    def run_stat(self, spec: str, f=None) -> sk.Stat:
         """Compute a stat over rows matching ``f`` — the device scan selects,
         numpy observes (≙ the distributed StatsScan + client-side merge)."""
         stat = parse_stat(spec)
