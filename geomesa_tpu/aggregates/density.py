@@ -60,24 +60,21 @@ def density(planner, f, bbox, width: int = 256, height: int = 256,
     differences are inside one grid cell for any realistic grid); host
     fallback mirrors LocalQueryRunner's density transform.
     """
-    plan = planner.plan(f)
+    plan, mask = planner.scan_mask(f)
     grid = np.asarray(bbox, dtype=np.float32)
     if plan.empty:
         return DensityGrid(tuple(bbox), width, height, np.zeros((height, width), np.float32))
 
     idx = plan.index
-    if plan.primary_kind != "fid" and plan.residual_host is None and idx is not None \
-            and "xf" in idx.device.columns:
+    if mask is not None and "xf" in idx.device.columns:
         cols = idx.device.columns
-        mask = idx.kernels.mask(plan.primary_kind, plan.boxes_loose,
-                                plan.windows, plan.residual_device)
         wcol = cols.get(weight_attr) if weight_attr else None
         out = _jit_density(mask, cols["xf"], cols["yf"], jnp.asarray(grid),
                            width, height, wcol)
         return DensityGrid(tuple(bbox), width, height, np.asarray(out))
 
     # host fallback (≙ LocalQueryRunner.transform density path)
-    rows = planner.select_indices(f)
+    rows = planner.select_indices(f, plan=plan)
     sub = planner.table.take(rows)
     garr = sub.geometry()
     bbs = garr.bboxes()

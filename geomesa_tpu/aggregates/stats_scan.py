@@ -1,0 +1,132 @@
+"""Device-side stat reductions (the StatsScan kernel path).
+
+≙ reference `StatsScan` (index/iterators/StatsScan.scala): sketches computed
+next to the data. On TPU the scan mask stays on device and each supported
+sketch becomes one fused reduction over it (scatter-add bincounts, masked
+sums) — only the tiny reduced result crosses to the host. Unsupported sketch
+kinds fall back to select+observe (the LocalQueryRunner path); the split is
+per-leaf so one spec string can mix both.
+
+Device-computable: Count, Histogram (numeric), Z2Histogram (point layers),
+Enumeration (dictionary strings), GroupBy(string, Count()). MinMax keeps the
+host path — its HLL cardinality needs 64-bit hashing the TPU has no business
+doing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.stats import sketches as sk
+
+
+@functools.partial(jax.jit, static_argnames=("bins",))
+def _masked_hist(col, mask, lo, hi, bins: int):
+    frac = (col.astype(jnp.float32) - lo) / (hi - lo)
+    idx = jnp.clip((frac * bins).astype(jnp.int32), 0, bins - 1)
+    return jnp.zeros((bins,), jnp.int32).at[idx].add(mask.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("g",))
+def _masked_grid(x, y, mask, g: int):
+    ix = jnp.clip(((x + 180.0) / 360.0 * g).astype(jnp.int32), 0, g - 1)
+    iy = jnp.clip(((y + 90.0) / 180.0 * g).astype(jnp.int32), 0, g - 1)
+    return jnp.zeros((g, g), jnp.int32).at[iy, ix].add(mask.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _masked_bincount(codes, mask, n: int):
+    return jnp.zeros((n,), jnp.int32).at[codes].add(mask.astype(jnp.int32))
+
+
+def observe_on_device(leaf: sk.Stat, index, mask) -> bool:
+    """Try to fold the masked scan into ``leaf`` via a device reduction.
+    Returns False when this sketch kind must take the host path."""
+    cols = index.device.columns
+    sft = index.sft
+
+    if isinstance(leaf, sk.CountStat):
+        leaf.observe(int(jnp.sum(mask)))
+        return True
+
+    if isinstance(leaf, sk.HistogramStat):
+        attr = leaf.attr
+        try:
+            spec = sft.attribute(attr)
+        except KeyError:
+            return False
+        if attr not in cols or spec.type_name not in ("Int", "Integer", "Float"):
+            return False
+        counts = np.asarray(_masked_hist(cols[attr], mask,
+                                         np.float32(leaf.lo), np.float32(leaf.hi),
+                                         leaf.bins))
+        leaf.counts += counts.astype(np.int64)
+        return True
+
+    if isinstance(leaf, sk.Z2HistogramStat):
+        if "xf" not in cols:
+            return False
+        grid = np.asarray(_masked_grid(cols["xf"], cols["yf"], mask, leaf.g))
+        leaf.counts += grid.astype(np.int64)
+        return True
+
+    if isinstance(leaf, sk.EnumerationStat):
+        vocab = index.vocabs.get(leaf.attr)
+        if vocab is None or leaf.attr not in cols:
+            return False
+        counts = np.asarray(_masked_bincount(cols[leaf.attr], mask, len(vocab)))
+        for v, c in zip(vocab, counts):
+            if c:
+                leaf.counts[v] = leaf.counts.get(v, 0) + int(c)
+        return True
+
+    if isinstance(leaf, sk.GroupByStat) and leaf.sub_spec.strip() == "Count()":
+        vocab = index.vocabs.get(leaf.attr)
+        if vocab is None or leaf.attr not in cols:
+            return False
+        counts = np.asarray(_masked_bincount(cols[leaf.attr], mask, len(vocab)))
+        for v, c in zip(vocab, counts):
+            if c:
+                sub = leaf.groups.setdefault(v, sk.CountStat())
+                sub.observe(int(c))
+        return True
+
+    return False
+
+
+def run_stat(planner, spec: str, f=None) -> sk.Stat:
+    """Compute a stat spec over matching rows, device reductions first.
+
+    The scan mask is evaluated once; device-supported leaves reduce against
+    it, the rest share one select+observe pass (≙ the coprocessor running
+    some aggregations region-side while the client computes the rest)."""
+    from geomesa_tpu.filter import ir
+    from geomesa_tpu.filter.parser import parse_ecql
+    from geomesa_tpu.stats.dsl import observe_table, parse_stat
+
+    stat = parse_stat(spec)
+    if f is None:
+        f = ir.Include()
+    elif isinstance(f, str):
+        f = parse_ecql(f)
+
+    leaves = stat.stats if isinstance(stat, sk.SeqStat) else [stat]
+    include = isinstance(f, ir.Include)
+    plan, mask = planner.scan_mask(f)
+    host_leaves = list(leaves)
+    if mask is not None:
+        host_leaves = [l for l in leaves
+                       if not observe_on_device(l, plan.index, mask)]
+    if host_leaves:
+        # one shared pass for every host-path leaf; INCLUDE observes the
+        # master table directly (no select, no copy)
+        sub = planner.table if include else \
+            planner.table.take(planner.select_indices(f, plan=plan))
+        for l in host_leaves:
+            observe_table(l, sub)
+    return stat

@@ -184,8 +184,42 @@ class TpuDataStore:
                 raise ValueError(f"No data written to {type_name}")
         return self.planners[type_name]
 
-    def query(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE") -> QueryResult:
-        return self.planner(type_name).query(f)
+    def query(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
+              hints: Optional[dict] = None):
+        """Run a query; ``hints`` switch the result form exactly like the
+        reference's QueryHints (conf/QueryHints.scala — DENSITY_*/BIN_*/
+        STATS_*/SAMPLING keys):
+
+          hints["density"] = {"bbox": (..), "width": W, "height": H,
+                              "weight": attr?}        → DensityGrid
+          hints["bin"]     = {"track": attr, "label": attr?, "sort": bool}
+                                                       → packed BIN records
+          hints["stats"]   = stat spec string          → Stat sketch
+          hints["sample"]  = n | {"n": n, "by": attr?} → sampled QueryResult
+        """
+        planner = self.planner(type_name)
+        if not hints:
+            return planner.query(f)
+        if "density" in hints:
+            from geomesa_tpu.aggregates.density import density
+            d = dict(hints["density"])
+            return density(planner, f, d["bbox"], d.get("width", 256),
+                           d.get("height", 256), d.get("weight"))
+        if "bin" in hints:
+            from geomesa_tpu.aggregates.bin import bin_records
+            b = dict(hints["bin"])
+            return bin_records(planner, f, b["track"], b.get("label"),
+                               b.get("sort", False))
+        if "stats" in hints:
+            return self.stats(type_name).run_stat(hints["stats"], f)
+        if "sample" in hints:
+            from geomesa_tpu.aggregates.sampling import sample_rows
+            s = hints["sample"]
+            s = {"n": s} if isinstance(s, int) else dict(s)
+            plan = planner.plan(f)
+            rows = sample_rows(planner, f, s["n"], s.get("by"), plan=plan)
+            return QueryResult(rows, planner.table.take(rows), plan)
+        raise ValueError(f"Unknown hints: {sorted(hints)}")
 
     def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE") -> int:
         return self.planner(type_name).count(f)

@@ -123,9 +123,11 @@ class QueryPlanner:
                 plan.residual_device)
         return len(self.select_indices(f if isinstance(f, ir.Filter) else parse_ecql(f)))
 
-    def select_indices(self, f: Union[str, ir.Filter]) -> np.ndarray:
+    def select_indices(self, f: Union[str, ir.Filter],
+                       plan: Optional[IndexScanPlan] = None) -> np.ndarray:
         """Matching row indices (ascending) into the master table."""
-        plan = self.plan(f)
+        if plan is None:
+            plan = self.plan(f)
         if plan.empty:
             return np.empty(0, dtype=np.int64)
         if plan.primary_kind == "fid":
@@ -142,6 +144,18 @@ class QueryPlanner:
         if plan.residual_host is None:
             return np.sort(rows)
         return np.sort(self._refine(plan, rows))
+
+    def scan_mask(self, f: Union[str, ir.Filter]):
+        """(plan, device mask over the plan index's sorted rows) — None mask
+        when the plan needs host refinement or is candidate-pruned. The mask
+        stays on device for aggregation kernels to consume (≙ the shared
+        AggregatingScan validate step)."""
+        plan = self.plan(f)
+        if plan.empty or plan.primary_kind == "fid" or plan.residual_host is not None \
+                or plan.candidate_slices is not None or plan.index is None:
+            return plan, None
+        return plan, plan.index.kernels.mask(
+            plan.primary_kind, plan.boxes_loose, plan.windows, plan.residual_device)
 
     def query(self, f: Union[str, ir.Filter]) -> QueryResult:
         plan = self.plan(f)

@@ -144,18 +144,13 @@ class GeoMesaStats:
     # -- exact stat scans (≙ StatsScan) --------------------------------------
 
     def run_stat(self, spec: str, f=None) -> sk.Stat:
-        """Compute a stat over rows matching ``f`` — the device scan selects,
-        numpy observes (≙ the distributed StatsScan + client-side merge)."""
-        stat = parse_stat(spec)
-        f = self._filter(f)
+        """Compute a stat over rows matching ``f`` (≙ StatsScan): device
+        reductions where the sketch kind supports them, select+observe for
+        the rest (see aggregates.stats_scan)."""
+        from geomesa_tpu.aggregates.stats_scan import run_stat as _run
         if self.planner is None:
             raise ValueError("stats not attached to a planner")
-        if isinstance(f, ir.Include):
-            observe_table(stat, self.planner.table)
-        else:
-            rows = self.planner.select_indices(f)
-            observe_table(stat, self.planner.table.take(rows))
-        return stat
+        return _run(self.planner, spec, self._filter(f))
 
     # -- helpers -------------------------------------------------------------
 
