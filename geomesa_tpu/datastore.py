@@ -137,17 +137,22 @@ class TpuDataStore:
             raise KeyError(type_name)
         return FeatureWriter(self, type_name)
 
-    def load(self, type_name: str, table: FeatureTable) -> None:
-        """Bulk load a prebuilt columnar table (the fast ingest path)."""
-        self._append(type_name, table)
+    def load(self, type_name: str, table: FeatureTable,
+             stats_cached: Optional[dict] = None) -> None:
+        """Bulk load a prebuilt columnar table (the fast ingest path).
+        ``stats_cached`` restores checkpointed sketches instead of
+        re-observing (io.checkpoint)."""
+        self._append(type_name, table, stats_cached)
 
-    def _append(self, type_name: str, batch: FeatureTable) -> None:
+    def _append(self, type_name: str, batch: FeatureTable,
+                stats_cached: Optional[dict] = None) -> None:
         current = self.tables.get(type_name)
         table = batch if current is None else FeatureTable.concat([current, batch])
         self.tables[type_name] = table
-        self._rebuild_indexes(type_name)
+        self._rebuild_indexes(type_name, stats_cached)
 
-    def _rebuild_indexes(self, type_name: str) -> None:
+    def _rebuild_indexes(self, type_name: str,
+                         stats_cached: Optional[dict] = None) -> None:
         from geomesa_tpu.stats.store import GeoMesaStats
 
         sft = self.schemas[type_name]
@@ -167,7 +172,10 @@ class TpuDataStore:
         stats = self._stats.get(type_name) or GeoMesaStats(sft)
         planner = QueryPlanner(sft, table, indexes, stats=stats)
         stats.planner = planner
-        stats.update(table)  # ≙ statUpdater flush on write
+        if stats_cached is not None:
+            stats.cached = stats_cached  # checkpoint restore
+        else:
+            stats.update(table)  # ≙ statUpdater flush on write
         self._stats[type_name] = stats
         self.planners[type_name] = planner
 

@@ -1,0 +1,308 @@
+"""TWKB + WKB geometry codecs.
+
+≙ reference `TwkbSerialization` / `WkbSerialization`
+(geomesa-features/.../serialization/TwkbSerialization.scala:1-670,
+WkbSerialization.scala): TWKB is the compact varint delta wire format the
+reference uses inside its Kryo feature payloads; WKB is the standard
+interchange form. Re-designed columnar: the varint encoder/decoder are fully
+vectorized over the whole value stream (byte-matrix assembly / cumsum group
+reconstruction) instead of the reference's per-coordinate stream writer —
+encoding N geometries is a handful of numpy passes, not N×k method calls.
+
+TWKB layout per geometry (standard spec subset):
+  [type_precision byte][metadata byte=0][structure varints + zigzag coord
+  deltas interleaved], deltas continuing across rings/parts.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.features import geometry as geo
+
+# -- vectorized varint -------------------------------------------------------
+
+
+def zigzag(v: np.ndarray) -> np.ndarray:
+    v = np.asarray(v, dtype=np.int64)
+    return ((v << 1) ^ (v >> 63)).view(np.uint64).astype(np.uint64)
+
+
+def unzigzag(u: np.ndarray) -> np.ndarray:
+    u = np.asarray(u, dtype=np.uint64)
+    return ((u >> np.uint64(1)).astype(np.int64)) ^ -(u & np.uint64(1)).astype(np.int64)
+
+
+def _varint_encode_with_lens(vals: np.ndarray):
+    """(LEB128 bytes, per-value byte lengths), vectorized: build the
+    (n, maxlen) byte matrix column by column, then flatten through the
+    per-value length mask (row-major order preserves value order)."""
+    v = np.asarray(vals, dtype=np.uint64).copy()
+    if len(v) == 0:
+        return b"", np.empty(0, dtype=np.int64)
+    cols = []
+    more_cols = []
+    while True:
+        byte = (v & np.uint64(0x7F)).astype(np.uint8)
+        more = v >= np.uint64(0x80)
+        cols.append(byte | (more.astype(np.uint8) << 7))
+        more_cols.append(more)
+        v >>= np.uint64(7)
+        if not more.any():
+            break
+    mat = np.stack(cols, axis=1)                      # (n, L)
+    lens = 1 + np.sum(np.stack(more_cols, axis=1), axis=1)
+    mask = np.arange(mat.shape[1]) < lens[:, None]
+    return mat[mask].tobytes(), lens
+
+
+def varint_encode(vals: np.ndarray) -> bytes:
+    return _varint_encode_with_lens(vals)[0]
+
+
+def varint_decode(buf: np.ndarray, count: int = -1) -> Tuple[np.ndarray, int]:
+    """Decode LEB128 stream → (uint64 values, bytes consumed). Vectorized:
+    terminator bytes mark value boundaries; within-value bit positions come
+    from a group-relative arange; bitwise_or.at folds septets into values."""
+    b = np.asarray(buf, dtype=np.uint8)
+    if count == 0:
+        return np.empty(0, dtype=np.uint64), 0
+    ends = (b & 0x80) == 0
+    stops = np.nonzero(ends)[0]
+    if count > 0:
+        if len(stops) < count:
+            raise ValueError("Truncated varint stream")
+        consumed = int(stops[count - 1]) + 1
+        b = b[:consumed]
+        ends = ends[:consumed]
+        stops = stops[:count]
+    else:
+        if len(b) and not ends[-1]:
+            raise ValueError("Truncated varint stream")
+        consumed = len(b)
+        count = len(stops)
+    gid = np.r_[0, np.cumsum(ends[:-1])].astype(np.int64)
+    starts = np.r_[0, stops[:-1] + 1]
+    shifts = ((np.arange(len(b)) - starts[gid]) * 7).astype(np.uint64)
+    vals = np.zeros(count, dtype=np.uint64)
+    np.bitwise_or.at(vals, gid, (b & np.uint64(0x7F)).astype(np.uint64) << shifts)
+    return vals, consumed
+
+
+# -- TWKB --------------------------------------------------------------------
+
+
+def encode_twkb(garr: "geo.GeometryArray", precision: int = 7) -> List[bytes]:
+    """Per-geometry TWKB blobs. precision = decimal digits (coords are
+    rounded to 10^-precision — the reference default keeps 7).
+
+    Stream-wide vectorized: coordinate deltas (with per-geometry resets) and
+    zigzag run once over the whole coords buffer; structure counts splice in
+    as small per-ring segments; ONE varint pass encodes the concatenated
+    value stream, which then splits into per-geometry blobs by summed
+    varint byte lengths."""
+    n = len(garr)
+    if n == 0:
+        return []
+    scale = 10.0 ** precision
+    qcoords = np.round(garr.coords * scale).astype(np.int64)
+    # delta-encode globally, resetting to absolute at each geometry start
+    deltas = np.empty_like(qcoords)
+    if len(qcoords):
+        deltas[0] = qcoords[0]
+        deltas[1:] = qcoords[1:] - qcoords[:-1]
+        gstarts = garr.ring_offsets[garr.part_offsets[garr.geom_offsets[:-1]]]
+        deltas[gstarts] = qcoords[gstarts]
+    zz = zigzag(deltas.ravel())  # coord i -> zz[2i], zz[2i+1]
+
+    segments: List[np.ndarray] = []      # value stream pieces, in order
+    vcounts = np.empty(n, dtype=np.int64)  # values per geometry
+    total_before = 0
+
+    if garr.is_points:
+        # pure points carry no structure varints: the stream IS the coords
+        segments = [zz]
+        vcounts[:] = 2
+        total_before = 2 * n
+
+    def coords_seg(s: int, e: int) -> None:
+        segments.append(zz[2 * s: 2 * e])
+
+    def count_seg(c: int) -> None:
+        segments.append(np.asarray([c], dtype=np.uint64))
+
+    for i in range(n if not garr.is_points else 0):
+        code = int(garr.type_codes[i])
+        nvals0 = total_before
+        g0, g1 = garr.geom_offsets[i], garr.geom_offsets[i + 1]
+        if code == geo.POINT:
+            r = garr.part_offsets[g0]
+            coords_seg(garr.ring_offsets[r], garr.ring_offsets[r + 1])
+            total_before += 2
+        elif code == geo.LINESTRING:
+            r = garr.part_offsets[g0]
+            s, e = garr.ring_offsets[r], garr.ring_offsets[r + 1]
+            count_seg(e - s)
+            coords_seg(s, e)
+            total_before += 1 + 2 * (e - s)
+        elif code == geo.POLYGON:
+            r0, r1 = garr.part_offsets[g0], garr.part_offsets[g0 + 1]
+            count_seg(r1 - r0)
+            total_before += 1
+            for r in range(r0, r1):
+                s, e = garr.ring_offsets[r], garr.ring_offsets[r + 1]
+                count_seg(e - s)
+                coords_seg(s, e)
+                total_before += 1 + 2 * (e - s)
+        else:  # Multi*
+            count_seg(g1 - g0)
+            total_before += 1
+            for p in range(g0, g1):
+                pr0, pr1 = garr.part_offsets[p], garr.part_offsets[p + 1]
+                if code == geo.MULTIPOINT:
+                    s = garr.ring_offsets[pr0]
+                    coords_seg(s, s + 1)
+                    total_before += 2
+                elif code == geo.MULTILINESTRING:
+                    s, e = garr.ring_offsets[pr0], garr.ring_offsets[pr0 + 1]
+                    count_seg(e - s)
+                    coords_seg(s, e)
+                    total_before += 1 + 2 * (e - s)
+                else:  # MULTIPOLYGON
+                    count_seg(pr1 - pr0)
+                    total_before += 1
+                    for r in range(pr0, pr1):
+                        s, e = garr.ring_offsets[r], garr.ring_offsets[r + 1]
+                        count_seg(e - s)
+                        coords_seg(s, e)
+                        total_before += 1 + 2 * (e - s)
+        vcounts[i] = total_before - nvals0
+
+    stream = np.concatenate(segments) if segments else np.empty(0, np.uint64)
+    buf, lens = _varint_encode_with_lens(stream)
+    # per-geometry byte spans
+    voff = np.r_[0, np.cumsum(vcounts)]
+    boff = np.r_[0, np.cumsum(lens)][voff]
+    # spec header: high nibble = zigzag(precision), low nibble = type
+    pz = int(zigzag(np.asarray([precision]))[0]) & 0x0F
+    out = []
+    for i in range(n):
+        head = bytes([(pz << 4) | int(garr.type_codes[i]), 0])
+        out.append(head + buf[boff[i]: boff[i + 1]])
+    return out
+
+
+def decode_twkb(blobs: Sequence[bytes]) -> "geo.GeometryArray":
+    shapes = []
+    for blob in blobs:
+        code = blob[0] & 0x0F
+        precision = int(unzigzag(np.asarray([(blob[0] >> 4) & 0x0F],
+                                            dtype=np.uint64))[0])
+        scale = 10.0 ** precision
+        vals, _ = varint_decode(np.frombuffer(blob, dtype=np.uint8, offset=2))
+        pos = 0
+        prev = np.zeros(2, dtype=np.int64)
+
+        def take_coords(n: int):
+            nonlocal pos, prev
+            deltas = unzigzag(vals[pos: pos + 2 * n]).reshape(-1, 2)
+            pos += 2 * n
+            pts = prev[None, :] + np.cumsum(deltas, axis=0)
+            if len(pts):
+                prev = pts[-1]
+            return (pts / scale).tolist()
+
+        def take(n: int = 1) -> int:
+            nonlocal pos
+            v = int(vals[pos])
+            pos += n
+            return v
+
+        if code == geo.POINT:
+            shapes.append((code, take_coords(1)[0]))
+        elif code == geo.LINESTRING:
+            shapes.append((code, take_coords(take())))
+        elif code == geo.POLYGON:
+            shapes.append((code, [take_coords(take()) for _ in range(take())]))
+        elif code == geo.MULTIPOINT:
+            shapes.append((code, [take_coords(1)[0] for _ in range(take())]))
+        elif code == geo.MULTILINESTRING:
+            shapes.append((code, [take_coords(take()) for _ in range(take())]))
+        elif code == geo.MULTIPOLYGON:
+            n = take()
+            shapes.append((code, [[take_coords(take()) for _ in range(take())]
+                                  for _ in range(n)]))
+        else:
+            raise ValueError(f"Bad TWKB type {code}")
+    return geo.GeometryArray.from_shapes(shapes)
+
+
+# -- WKB (standard little-endian) --------------------------------------------
+
+
+def _wkb_ring(ring: list) -> bytes:
+    arr = np.asarray(ring, dtype="<f8").reshape(-1, 2)
+    return struct.pack("<I", len(arr)) + arr.tobytes()
+
+
+def _wkb_one(code: int, data) -> bytes:
+    head = b"\x01" + struct.pack("<I", code)
+    if code == geo.POINT:
+        return head + np.asarray(data, dtype="<f8").tobytes()
+    if code == geo.LINESTRING:
+        return head + _wkb_ring(data)
+    if code == geo.POLYGON:
+        return head + struct.pack("<I", len(data)) + b"".join(_wkb_ring(r) for r in data)
+    sub_code = {geo.MULTIPOINT: geo.POINT, geo.MULTILINESTRING: geo.LINESTRING,
+                geo.MULTIPOLYGON: geo.POLYGON}[code]
+    return head + struct.pack("<I", len(data)) + \
+        b"".join(_wkb_one(sub_code, d) for d in data)
+
+
+def encode_wkb(garr: "geo.GeometryArray") -> List[bytes]:
+    return [_wkb_one(*garr.shape(i)) for i in range(len(garr))]
+
+
+def _wkb_read(buf: memoryview, pos: int):
+    little = buf[pos] == 1
+    order = "<" if little else ">"
+    code = struct.unpack_from(order + "I", buf, pos + 1)[0] & 0xFF
+    pos += 5
+
+    def coords(n):
+        nonlocal pos
+        arr = np.frombuffer(buf, dtype=order + "f8", count=2 * n, offset=pos)
+        pos += 16 * n
+        return arr.reshape(-1, 2).tolist()
+
+    def count():
+        nonlocal pos
+        v = struct.unpack_from(order + "I", buf, pos)[0]
+        pos += 4
+        return v
+
+    if code == geo.POINT:
+        return (code, coords(1)[0]), pos
+    if code == geo.LINESTRING:
+        return (code, coords(count())), pos
+    if code == geo.POLYGON:
+        return (code, [coords(count()) for _ in range(count())]), pos
+    if code in (geo.MULTIPOINT, geo.MULTILINESTRING, geo.MULTIPOLYGON):
+        n = count()
+        members = []
+        for _ in range(n):
+            (sub_code, d), pos = _wkb_read(buf, pos)
+            members.append(d)
+        return (code, members), pos
+    raise ValueError(f"Bad WKB type {code}")
+
+
+def decode_wkb(blobs: Sequence[bytes]) -> "geo.GeometryArray":
+    shapes = []
+    for blob in blobs:
+        shape, _ = _wkb_read(memoryview(blob), 0)
+        shapes.append(shape)
+    return geo.GeometryArray.from_shapes(shapes)

@@ -1,0 +1,22 @@
+"""IO layer: Arrow interchange, checkpoint/restore, export formats.
+
+≙ reference `geomesa-arrow` (§2.7), the durable-state machinery (§5
+checkpoint/resume), and the export half of `geomesa-tools` (§2.11).
+
+Arrow-dependent names load lazily — checkpoint (npz/json) and text exports
+need only numpy, so pyarrow stays an optional extra.
+"""
+
+from geomesa_tpu.io.checkpoint import load_store, save_store
+from geomesa_tpu.io.export import FORMATS, export
+
+_ARROW_NAMES = ("from_arrow", "read_ipc", "to_arrow", "write_ipc")
+
+__all__ = ["FORMATS", "export", "load_store", "save_store", *_ARROW_NAMES]
+
+
+def __getattr__(name):
+    if name in _ARROW_NAMES:
+        from geomesa_tpu.io import arrow
+        return getattr(arrow, name)
+    raise AttributeError(name)

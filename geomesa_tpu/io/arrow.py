@@ -1,0 +1,115 @@
+"""Arrow interchange: FeatureTable ↔ pyarrow Table ↔ IPC files.
+
+≙ reference `geomesa-arrow` (SURVEY.md §2.7 — SimpleFeatureVector.scala:42,
+ArrowAttributeWriter/Reader, the IPC writers of io/*.scala). The columnar
+FeatureTable is already Arrow-shaped, so the mapping is direct:
+
+  - numeric/bool columns  → matching Arrow primitive arrays (Date → ms
+    timestamp)
+  - String columns        → dictionary-encoded arrays (≙ ArrowDictionary)
+  - point geometry        → struct<x: f64, y: f64> (≙ the fixed-width point
+    vectors of arrow-jts)
+  - other geometries      → WKB binary column (standard interop: geopandas /
+    GDAL read it as-is)
+
+The SFT spec string rides in the schema metadata so IPC files round-trip
+schemas without a side channel (≙ the reference embedding the SFT in the
+Arrow schema metadata)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+from geomesa_tpu.features.twkb import decode_wkb, encode_wkb
+
+_SFT_KEY = b"geomesa.sft.spec"
+_NAME_KEY = b"geomesa.sft.name"
+
+
+def to_arrow(table: FeatureTable) -> pa.Table:
+    arrays, names = [], []
+    names.append("__fid__")
+    arrays.append(pa.array([str(f) for f in table.fids], type=pa.string()))
+    for attr in table.sft.attributes:
+        col = table.columns[attr.name]
+        names.append(attr.name)
+        if isinstance(col, GeometryArray):
+            if col.is_points:
+                x, y = col.point_xy()
+                arrays.append(pa.StructArray.from_arrays(
+                    [pa.array(x, pa.float64()), pa.array(y, pa.float64())],
+                    ["x", "y"]))
+            else:
+                arrays.append(pa.array(encode_wkb(col), type=pa.binary()))
+        elif isinstance(col, StringColumn):
+            arrays.append(pa.DictionaryArray.from_arrays(
+                pa.array(col.codes, pa.int32()), pa.array(col.vocab, pa.string())))
+        elif attr.type_name == "Date":
+            arrays.append(pa.array(np.asarray(col, dtype=np.int64),
+                                   pa.timestamp("ms")))
+        else:
+            arrays.append(pa.array(np.asarray(col)))
+    out = pa.table(dict(zip(names, arrays)))
+    return out.replace_schema_metadata(
+        {_SFT_KEY: table.sft.to_spec().encode(),
+         _NAME_KEY: table.sft.name.encode()})
+
+
+def from_arrow(at: pa.Table, sft: Optional[SimpleFeatureType] = None) -> FeatureTable:
+    if sft is None:
+        meta = at.schema.metadata or {}
+        if _SFT_KEY not in meta:
+            raise ValueError("Arrow table has no embedded SFT spec; pass sft=")
+        sft = SimpleFeatureType.from_spec(
+            meta.get(_NAME_KEY, b"features").decode(), meta[_SFT_KEY].decode())
+    fids = None
+    if "__fid__" in at.column_names:
+        fids = np.asarray(at.column("__fid__").to_pylist(), dtype=object)
+    data = {}
+    for attr in sft.attributes:
+        col = at.column(attr.name)
+        if attr.is_geometry:
+            typ = col.type
+            if pa.types.is_struct(typ):
+                combined = col.combine_chunks()
+                data[attr.name] = GeometryArray.points(
+                    np.asarray(combined.field("x")), np.asarray(combined.field("y")))
+            else:
+                data[attr.name] = decode_wkb(col.to_pylist())
+        elif attr.type_name == "String":
+            combined = col.combine_chunks()
+            if pa.types.is_dictionary(col.type):
+                vocab = [str(v) for v in combined.dictionary.to_pylist()]
+                codes = np.asarray(combined.indices, dtype=np.int32)
+                if vocab == sorted(vocab) and len(set(vocab)) == len(vocab):
+                    data[attr.name] = StringColumn(codes, vocab)
+                else:
+                    # foreign dictionaries may be unsorted; the attribute
+                    # index requires code order == lexicographic order
+                    data[attr.name] = StringColumn.encode(
+                        np.asarray(vocab, dtype=object)[codes])
+            else:
+                data[attr.name] = combined.to_pylist()
+        elif attr.type_name == "Date":
+            data[attr.name] = np.asarray(col.cast(pa.int64()))
+        else:
+            data[attr.name] = np.asarray(col)
+    return FeatureTable.build(sft, data, fids=fids)
+
+
+def write_ipc(table: FeatureTable, path: str) -> None:
+    at = to_arrow(table)
+    with ipc.new_file(path, at.schema) as w:
+        w.write_table(at)
+
+
+def read_ipc(path: str, sft: Optional[SimpleFeatureType] = None) -> FeatureTable:
+    with ipc.open_file(path) as r:
+        return from_arrow(r.read_all(), sft)

@@ -1,0 +1,116 @@
+"""Datastore checkpoint / restore.
+
+≙ the reference's durable state (SURVEY.md §5 checkpoint/resume): catalog
+metadata (GeoMesaMetadata.scala:17 — SFT specs under ``attributes``), persisted
+stat sketches (MetadataBackedStats.scala:36), and the feature data itself.
+Layout::
+
+    <dir>/catalog.json            # schemas, fid counters, stats sketches
+    <dir>/<type>.npz              # columnar payload (numeric cols, string
+                                  # codes+vocab, geometry buffers, fids)
+
+Restore rebuilds device indexes from the columns (sort permutations are
+cheap relative to load) but reuses the checkpointed sketches instead of
+re-observing the table — the same split the reference makes between data
+tables and the stats metadata row."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+
+_VERSION = 1
+
+
+def save_store(store, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    catalog: Dict[str, dict] = {"version": _VERSION, "types": {}}
+    for name, sft in store.schemas.items():
+        table = store.tables.get(name)
+        entry = {
+            "spec": sft.to_spec(),
+            "counter": store._counters.get(name, 0),
+            "rows": 0 if table is None else len(table),
+        }
+        stats = store._stats.get(name)
+        if stats is not None:
+            entry["stats"] = stats.to_dict()
+        catalog["types"][name] = entry
+        if table is not None:
+            _save_table(table, os.path.join(path, f"{name}.npz"))
+    with open(os.path.join(path, "catalog.json"), "w") as f:
+        json.dump(catalog, f)
+
+
+def load_store(path: str):
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.stats.store import GeoMesaStats
+
+    with open(os.path.join(path, "catalog.json")) as f:
+        catalog = json.load(f)
+    store = TpuDataStore()
+    for name, entry in catalog["types"].items():
+        sft = store.create_schema(SimpleFeatureType.from_spec(name, entry["spec"]))
+        store._counters[name] = entry.get("counter", 0)
+        npz = os.path.join(path, f"{name}.npz")
+        if entry.get("rows", 0):
+            if not os.path.exists(npz):
+                raise ValueError(
+                    f"Corrupt checkpoint: catalog records {entry['rows']} rows "
+                    f"for {name!r} but {npz} is missing")
+            table = _load_table(sft, npz)
+            stats_dict = entry.get("stats")
+            cached = None
+            if stats_dict is not None:
+                cached = GeoMesaStats.from_dict(sft, stats_dict).cached
+            store.load(name, table, stats_cached=cached)
+    return store
+
+
+# -- columnar table codec ----------------------------------------------------
+
+
+def _save_table(table: FeatureTable, path: str) -> None:
+    payload: Dict[str, np.ndarray] = {
+        "__fids__": np.asarray(table.fids, dtype="U"),
+    }
+    for attr in table.sft.attributes:
+        col = table.columns[attr.name]
+        k = f"col:{attr.name}"
+        if isinstance(col, GeometryArray):
+            payload[k + ":types"] = col.type_codes
+            payload[k + ":geom_off"] = col.geom_offsets
+            payload[k + ":part_off"] = col.part_offsets
+            payload[k + ":ring_off"] = col.ring_offsets
+            payload[k + ":coords"] = col.coords
+        elif isinstance(col, StringColumn):
+            payload[k + ":codes"] = col.codes
+            payload[k + ":vocab"] = np.asarray(col.vocab, dtype="U")
+        else:
+            payload[k] = np.asarray(col)
+    np.savez_compressed(path, **payload)
+
+
+def _load_table(sft: SimpleFeatureType, path: str) -> FeatureTable:
+    z = np.load(path, allow_pickle=False)
+    data: Dict[str, object] = {}
+    for attr in sft.attributes:
+        k = f"col:{attr.name}"
+        if attr.is_geometry:
+            data[attr.name] = GeometryArray(
+                z[k + ":types"], z[k + ":geom_off"], z[k + ":part_off"],
+                z[k + ":ring_off"], z[k + ":coords"])
+        elif attr.type_name == "String":
+            data[attr.name] = StringColumn(
+                z[k + ":codes"], [str(v) for v in z[k + ":vocab"]])
+        else:
+            data[attr.name] = z[k]
+    fids = np.asarray([str(v) for v in z["__fids__"]], dtype=object)
+    return FeatureTable.build(sft, data, fids=fids)

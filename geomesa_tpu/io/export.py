@@ -1,0 +1,130 @@
+"""Feature export formats.
+
+≙ reference export surface (tools/export/formats/ExportFormat.scala: arrow/
+avro/bin/csv/geojson/gml/json/leaflet/orc/parquet/shp/tsv/wkt). The formats
+that matter for a columnar TPU store: csv/tsv, geojson, json-lines, wkt,
+arrow IPC, parquet, npz (the checkpoint codec), bin (aggregates.bin)."""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from typing import Optional
+
+import numpy as np
+
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+
+FORMATS = ("csv", "tsv", "geojson", "json", "wkt", "arrow", "parquet")
+
+
+def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
+    """Write ``table`` in ``fmt`` to ``path`` (or return a str for text
+    formats when path is None)."""
+    fmt = fmt.lower()
+    if fmt in ("csv", "tsv"):
+        return _delimited(table, "," if fmt == "csv" else "\t", path)
+    if fmt == "geojson":
+        return _geojson(table, path)
+    if fmt == "json":
+        return _jsonlines(table, path)
+    if fmt == "wkt":
+        return _wkt(table, path)
+    if fmt == "arrow":
+        from geomesa_tpu.io.arrow import write_ipc
+        if path is None:
+            raise ValueError("arrow export requires a path")
+        write_ipc(table, path)
+        return path
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        from geomesa_tpu.io.arrow import to_arrow
+        if path is None:
+            raise ValueError("parquet export requires a path")
+        pq.write_table(to_arrow(table), path)
+        return path
+    raise ValueError(f"Unknown export format {fmt!r} (have {FORMATS})")
+
+
+def _out(path: Optional[str]):
+    return open(path, "w", newline="") if path else io.StringIO()
+
+
+def _finish(f, path):
+    if path:
+        f.close()
+        return path
+    return f.getvalue()
+
+
+def _iso(ms: int) -> str:
+    return str(np.datetime64(int(ms), "ms")) + "Z"
+
+
+def _cell(col, attr, i):
+    if isinstance(col, GeometryArray):
+        return col.wkt(i)
+    if isinstance(col, StringColumn):
+        return col.vocab[col.codes[i]]
+    v = col[i]
+    if attr.type_name == "Date":
+        return _iso(int(v))
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _delimited(table: FeatureTable, delim: str, path):
+    f = _out(path)
+    w = csv.writer(f, delimiter=delim)
+    attrs = table.sft.attributes
+    w.writerow(["id"] + [a.name for a in attrs])
+    cols = [table.columns[a.name] for a in attrs]
+    for i in range(len(table)):
+        w.writerow([table.fids[i]] + [_cell(c, a, i) for c, a in zip(cols, attrs)])
+    return _finish(f, path)
+
+
+def _geojson_geometry(garr: GeometryArray, i: int) -> dict:
+    from geomesa_tpu.features import geometry as geo
+    code, data = garr.shape(i)
+    return {"type": geo.TYPE_NAMES[code], "coordinates": data}
+
+
+def _geojson(table: FeatureTable, path):
+    garr = table.geometry() if table.sft.geometry_attribute else None
+    gname = table.sft.geometry_attribute.name if garr is not None else None
+    feats = []
+    for i in range(len(table)):
+        props = {}
+        for a in table.sft.attributes:
+            if a.name == gname:
+                continue
+            props[a.name] = _cell(table.columns[a.name], a, i)
+        feats.append({
+            "type": "Feature",
+            "id": str(table.fids[i]),
+            "geometry": None if garr is None else _geojson_geometry(garr, i),
+            "properties": props,
+        })
+    doc = {"type": "FeatureCollection", "features": feats}
+    f = _out(path)
+    json.dump(doc, f)
+    return _finish(f, path)
+
+
+def _jsonlines(table: FeatureTable, path):
+    f = _out(path)
+    for row in table.to_dicts():
+        json.dump({k: (v.item() if isinstance(v, np.generic) else v)
+                   for k, v in row.items()}, f)
+        f.write("\n")
+    return _finish(f, path)
+
+
+def _wkt(table: FeatureTable, path):
+    garr = table.geometry()
+    f = _out(path)
+    for i in range(len(table)):
+        f.write(garr.wkt(i) + "\n")
+    return _finish(f, path)

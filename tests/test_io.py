@@ -1,0 +1,208 @@
+"""IO tests: TWKB/WKB codecs, Arrow interchange, checkpoint/restore, export
+formats (SURVEY.md §2.3/§2.7/§5 parity)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.geometry import GeometryArray
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.features.twkb import (
+    decode_twkb, decode_wkb, encode_twkb, encode_wkb, unzigzag, varint_decode,
+    varint_encode, zigzag,
+)
+from geomesa_tpu.io import export, load_store, read_ipc, save_store, write_ipc
+
+WKTS = [
+    "POINT (10.5 -3.25)",
+    "LINESTRING (0 0, 1 1, 2 0.5)",
+    "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 1))",
+    "MULTIPOINT (1 1, 2 2)",
+    "MULTILINESTRING ((0 0, 1 0), (5 5, 6 6, 7 5))",
+    "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)), ((5 5, 6 5, 6 6, 5 5)))",
+]
+
+
+# -- varint ------------------------------------------------------------------
+
+
+def test_varint_roundtrip():
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        rng.integers(0, 128, 100, dtype=np.uint64),
+        rng.integers(0, 1 << 30, 100, dtype=np.uint64),
+        rng.integers(0, 1 << 62, 50, dtype=np.uint64),
+        np.array([0, 127, 128, (1 << 64) - 1], dtype=np.uint64)])
+    buf = varint_encode(vals)
+    out, consumed = varint_decode(np.frombuffer(buf, dtype=np.uint8))
+    assert consumed == len(buf)
+    assert np.array_equal(out, vals)
+
+
+def test_varint_partial_decode():
+    vals = np.array([300, 1, 2, 70000], dtype=np.uint64)
+    buf = varint_encode(vals)
+    out, consumed = varint_decode(np.frombuffer(buf, dtype=np.uint8), count=2)
+    assert np.array_equal(out, [300, 1])
+    rest, _ = varint_decode(np.frombuffer(buf[consumed:], dtype=np.uint8))
+    assert np.array_equal(rest, [2, 70000])
+
+
+def test_varint_truncated_stream_raises():
+    buf = varint_encode(np.array([300, 1], dtype=np.uint64)) + b"\x80"
+    with pytest.raises(ValueError, match="Truncated"):
+        varint_decode(np.frombuffer(buf, dtype=np.uint8))
+
+
+def test_twkb_header_spec_nibbles():
+    # high nibble = zigzag(precision), low nibble = geometry type
+    garr = GeometryArray.from_wkt(["POINT (1 2)"])
+    blob = encode_twkb(garr, precision=7)[0]
+    assert blob[0] >> 4 == 14  # zigzag(7)
+    assert blob[0] & 0x0F == 1
+    assert blob[1] == 0  # empty metadata byte
+
+
+def test_zigzag():
+    v = np.array([0, -1, 1, -2, 2, -(1 << 40)], dtype=np.int64)
+    assert np.array_equal(unzigzag(zigzag(v)), v)
+
+
+# -- TWKB / WKB --------------------------------------------------------------
+
+
+def test_twkb_roundtrip_all_types():
+    garr = GeometryArray.from_wkt(WKTS)
+    blobs = encode_twkb(garr, precision=7)
+    back = decode_twkb(blobs)
+    assert np.array_equal(back.type_codes, garr.type_codes)
+    np.testing.assert_allclose(back.coords, garr.coords, atol=1e-7)
+
+
+def test_twkb_precision():
+    garr = GeometryArray.from_wkt(["POINT (1.23456789 -9.87654321)"])
+    back = decode_twkb(encode_twkb(garr, precision=2))
+    np.testing.assert_allclose(back.coords, [[1.23, -9.88]], atol=1e-9)
+
+
+def test_twkb_compact():
+    # nearby points delta-encode far smaller than WKB
+    n = 1000
+    x = np.cumsum(np.full(n, 1e-4)) + 10
+    garr = GeometryArray.from_wkt(
+        [f"LINESTRING ({', '.join(f'{a:.5f} {a:.5f}' for a in x)})"])
+    twkb = sum(len(b) for b in encode_twkb(garr, precision=5))
+    wkb = sum(len(b) for b in encode_wkb(garr))
+    assert twkb < wkb / 3
+
+
+def test_wkb_roundtrip():
+    garr = GeometryArray.from_wkt(WKTS)
+    back = decode_wkb(encode_wkb(garr))
+    assert np.array_equal(back.type_codes, garr.type_codes)
+    np.testing.assert_allclose(back.coords, garr.coords)
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(21)
+    n = 3000
+    ds = TpuDataStore()
+    ds.create_schema("chk", "name:String,val:Int,dtg:Date,*geom:Point")
+    base = np.datetime64("2020-07-01", "ms").astype(np.int64)
+    ds.load("chk", FeatureTable.build(ds.get_schema("chk"), {
+        "name": rng.choice(["x", "y", "z"], n).astype(object),
+        "val": rng.integers(0, 50, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 5 * 86400000, n),
+        "geom": (rng.uniform(-50, 50, n), rng.uniform(-30, 30, n))}))
+    return ds
+
+
+# -- arrow -------------------------------------------------------------------
+
+
+def test_arrow_roundtrip(store, tmp_path):
+    table = store.tables["chk"]
+    p = str(tmp_path / "chk.arrow")
+    write_ipc(table, p)
+    back = read_ipc(p)  # schema from embedded metadata
+    assert back.sft.to_spec() == table.sft.to_spec()
+    assert np.array_equal(back.fids, table.fids)
+    assert np.array_equal(np.asarray(back.columns["val"]),
+                          np.asarray(table.columns["val"]))
+    x0, y0 = table.geometry().point_xy()
+    x1, y1 = back.geometry().point_xy()
+    np.testing.assert_array_equal(x0, x1)
+
+
+def test_arrow_polygons(tmp_path):
+    ds = TpuDataStore()
+    ds.create_schema("pg", "val:Int,*geom:Polygon")
+    t = FeatureTable.build(ds.get_schema("pg"), {
+        "val": [1, 2],
+        "geom": ["POLYGON ((0 0, 2 0, 2 2, 0 0))",
+                 "POLYGON ((5 5, 9 5, 9 9, 5 9, 5 5))"]})
+    p = str(tmp_path / "pg.arrow")
+    write_ipc(t, p)
+    back = read_ipc(p)
+    np.testing.assert_allclose(back.geometry().coords, t.geometry().coords)
+
+
+# -- checkpoint --------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip(store, tmp_path):
+    p = str(tmp_path / "ckpt")
+    save_store(store, p)
+    back = load_store(p)
+    assert back.get_type_names() == ["chk"]
+    ecql = "BBOX(geom, -10, -10, 30, 20) AND val < 25"
+    assert back.count("chk", ecql) == store.count("chk", ecql)
+    # stats restored from checkpoint, not recomputed: bounds identical
+    assert back.stats("chk").get_bounds() == store.stats("chk").get_bounds()
+    assert back.stats("chk").total == len(store.tables["chk"])
+    # writes continue after restore (fid counter persisted)
+    with back.get_writer("chk") as w:
+        fid = w.write(name="x", val=1,
+                      dtg=np.datetime64("2020-07-02", "ms"), geom=(0.0, 0.0))
+    assert back.count("chk") == store.count("chk") + 1
+    assert fid not in set(store.tables["chk"].fids)
+
+
+# -- export ------------------------------------------------------------------
+
+
+def test_export_csv(store):
+    res = store.query("chk", "val < 3")
+    out = export(res.table, "csv")
+    lines = out.strip().splitlines()
+    assert lines[0] == "id,name,val,dtg,geom"
+    assert len(lines) == res.count + 1
+    assert "POINT" in lines[1]
+
+
+def test_export_geojson(store):
+    res = store.query("chk", "val < 3")
+    doc = json.loads(export(res.table, "geojson"))
+    assert doc["type"] == "FeatureCollection"
+    assert len(doc["features"]) == res.count
+    f0 = doc["features"][0]
+    assert f0["geometry"]["type"] == "Point"
+    assert "val" in f0["properties"] and "geom" not in f0["properties"]
+
+
+def test_export_parquet(store, tmp_path):
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "x.parquet")
+    export(store.tables["chk"], "parquet", p)
+    assert pq.read_table(p).num_rows == len(store.tables["chk"])
+
+
+def test_export_unknown_format(store):
+    with pytest.raises(ValueError):
+        export(store.tables["chk"], "shapefile3000")
