@@ -240,6 +240,22 @@ class TpuDataStore:
         self.planner(type_name)  # materialize
         return self._stats[type_name]
 
+    # -- deletes ------------------------------------------------------------
+
+    def remove_features(self, type_name: str, f: Union[str, ir.Filter]) -> int:
+        """Delete matching features; returns the number removed (≙ GeoTools
+        removeFeatures / the age-off iterators). Rebuilds indexes over the
+        survivors — bulk deletion, matching the columnar build discipline."""
+        planner = self.planner(type_name)
+        rows = planner.select_indices(f)
+        if len(rows) == 0:
+            return 0
+        keep = np.ones(len(planner.table), dtype=bool)
+        keep[rows] = False
+        self.tables[type_name] = planner.table.take(np.nonzero(keep)[0])
+        self._rebuild_indexes(type_name)
+        return int(len(rows))
+
 
 class DataStoreFinder:
     """Registry of datastore factories, keyed by params (SPI-equivalent,

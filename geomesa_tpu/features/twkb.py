@@ -104,6 +104,8 @@ def encode_twkb(garr: "geo.GeometryArray", precision: int = 7) -> List[bytes]:
     as small per-ring segments; ONE varint pass encodes the concatenated
     value stream, which then splits into per-geometry blobs by summed
     varint byte lengths."""
+    if not -8 <= precision <= 7:
+        raise ValueError(f"TWKB precision must be in [-8, 7], got {precision}")
     n = len(garr)
     if n == 0:
         return []
@@ -199,6 +201,10 @@ def decode_twkb(blobs: Sequence[bytes]) -> "geo.GeometryArray":
     shapes = []
     for blob in blobs:
         code = blob[0] & 0x0F
+        if blob[1] != 0:
+            raise ValueError(
+                f"Unsupported TWKB metadata flags 0x{blob[1]:02x} "
+                "(bbox/size/idlist/extended-dims not implemented)")
         precision = int(unzigzag(np.asarray([(blob[0] >> 4) & 0x0F],
                                             dtype=np.uint64))[0])
         scale = 10.0 ** precision
@@ -269,8 +275,15 @@ def encode_wkb(garr: "geo.GeometryArray") -> List[bytes]:
 def _wkb_read(buf: memoryview, pos: int):
     little = buf[pos] == 1
     order = "<" if little else ">"
-    code = struct.unpack_from(order + "I", buf, pos + 1)[0] & 0xFF
+    raw = struct.unpack_from(order + "I", buf, pos + 1)[0]
     pos += 5
+    if raw & 0x20000000:  # EWKB SRID flag: 4-byte srid follows the type
+        pos += 4
+    if raw & 0xC0000000:  # EWKB Z/M flags
+        raise ValueError(f"WKB Z/M dimensions not supported (type 0x{raw:08x})")
+    code = raw & 0x1FFFFFFF
+    if code >= 1000:  # ISO Z/M type blocks (1001, 2001, 3001, ...)
+        raise ValueError(f"WKB Z/M dimensions not supported (type {code})")
 
     def coords(n):
         nonlocal pos

@@ -1,0 +1,8 @@
+"""Streaming layer: live feature cache + lambda hot/cold tiering.
+
+≙ reference `geomesa-kafka` + `geomesa-lambda` (SURVEY.md §2.6, §3.6).
+"""
+
+from geomesa_tpu.stream.live import GeoMessage, LambdaDataStore, LiveLayer
+
+__all__ = ["GeoMessage", "LambdaDataStore", "LiveLayer"]

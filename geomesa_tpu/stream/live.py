@@ -1,0 +1,247 @@
+"""Live (streaming) layer: near-real-time features over the indexed store.
+
+≙ reference Kafka tier (SURVEY.md §2.6/§3.6 — KafkaDataStore.scala:55-95,
+index/KafkaFeatureCache.scala:25, GeoMessageSerializer.scala) and the Lambda
+architecture (lambda/LambdaDataStore.scala — hot Kafka tier + cold persistent
+tier merged, DataStorePersistence flushing expired state).
+
+TPU-native shape: the message log is an append-only list of GeoMessages
+(CreateOrUpdate / Delete / Clear); the HOT tier materializes surviving
+messages into a small columnar table with a full-scan planner (the in-memory
+BucketIndex slot); `persist()` moves hot rows into the COLD TpuDataStore
+whose sorted device indexes serve the heavy scans — the LSM discipline of
+SURVEY.md §7 (delta buffer + periodic merge). Hot rows shadow cold rows by
+feature id, exactly like the Lambda tier's union-minus-overlap."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.evaluate import evaluate as _evaluate
+from geomesa_tpu.filter.parser import parse_ecql
+# -- GeoMessage (≙ kafka/utils/GeoMessage: CreateOrUpdate | Delete | Clear) --
+
+
+@dataclass
+class GeoMessage:
+    kind: str                       # "upsert" | "delete" | "clear"
+    fid: Optional[str] = None
+    attributes: Optional[dict] = None
+    ts_ms: int = 0
+
+    @staticmethod
+    def upsert(fid: str, attributes: dict, ts_ms: Optional[int] = None) -> "GeoMessage":
+        return GeoMessage("upsert", fid, attributes,
+                          int(time.time() * 1000) if ts_ms is None else ts_ms)
+
+    @staticmethod
+    def delete(fid: str) -> "GeoMessage":
+        return GeoMessage("delete", fid, None, int(time.time() * 1000))
+
+    @staticmethod
+    def clear() -> "GeoMessage":
+        return GeoMessage("clear", None, None, int(time.time() * 1000))
+
+
+class LiveLayer:
+    """In-memory live feature cache (≙ KafkaFeatureCache: latest state per
+    fid, optional event-time expiry)."""
+
+    def __init__(self, sft, expiry_ms: Optional[int] = None,
+                 event_time: Optional[str] = None):
+        self.sft = sft
+        self.expiry_ms = expiry_ms
+        # expiry clock: an attribute (event time, reference's event-time
+        # ordering) or message ingest time
+        self.event_time = event_time
+        self._state: Dict[str, GeoMessage] = {}   # latest upsert per fid
+        self._dirty = True
+        self._table: Optional[FeatureTable] = None
+
+    # -- message application (the consumer side of §3.6) ---------------------
+
+    def apply(self, msg: GeoMessage) -> None:
+        if msg.kind == "clear":
+            self._state.clear()
+        elif msg.kind == "delete":
+            self._state.pop(msg.fid, None)
+        else:
+            self._state[msg.fid] = msg
+        self._dirty = True
+
+    def put(self, fid: str, ts_ms: Optional[int] = None, **attributes) -> None:
+        self.apply(GeoMessage.upsert(fid, attributes, ts_ms))
+
+    def delete(self, fid: str) -> None:
+        self.apply(GeoMessage.delete(fid))
+
+    def clear(self) -> None:
+        self.apply(GeoMessage.clear())
+
+    # -- expiry --------------------------------------------------------------
+
+    def expire(self, now_ms: Optional[int] = None) -> int:
+        """Drop state older than expiry_ms (≙ FeatureStateFactory expiry).
+        Returns the number expired."""
+        if self.expiry_ms is None:
+            return 0
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        cutoff = now - self.expiry_ms
+        if self.event_time is not None:
+            def ts(m):
+                v = m.attributes[self.event_time]
+                return int(np.datetime64(v, "ms").astype(np.int64)) \
+                    if not isinstance(v, (int, np.integer)) else int(v)
+        else:
+            def ts(m):
+                return m.ts_ms
+        dead = [fid for fid, m in self._state.items() if ts(m) < cutoff]
+        for fid in dead:
+            del self._state[fid]
+        if dead:
+            self._dirty = True
+        return len(dead)
+
+    # -- materialized view ---------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+    @property
+    def fids(self) -> List[str]:
+        return list(self._state)
+
+    def table(self) -> Optional[FeatureTable]:
+        self._materialize()
+        return self._table
+
+    def _materialize(self) -> None:
+        if not self._dirty:
+            return
+        self._dirty = False
+        if not self._state:
+            self._table = None
+            return
+        fids = list(self._state)
+        data: Dict[str, list] = {a.name: [] for a in self.sft.attributes}
+        for fid in fids:
+            attrs = self._state[fid].attributes
+            for a in self.sft.attributes:
+                data[a.name].append(attrs[a.name])
+        cols: Dict[str, object] = {}
+        for a in self.sft.attributes:
+            if a.is_geometry:
+                vals = data[a.name]
+                if vals and isinstance(vals[0], (tuple, list)) and len(vals[0]) == 2 \
+                        and isinstance(vals[0][0], (int, float)):
+                    xy = np.asarray(vals, dtype=np.float64)
+                    from geomesa_tpu.features.geometry import GeometryArray
+                    cols[a.name] = GeometryArray.points(xy[:, 0], xy[:, 1])
+                else:
+                    from geomesa_tpu.features.geometry import GeometryArray
+                    cols[a.name] = GeometryArray.from_wkt(vals)
+            else:
+                cols[a.name] = data[a.name]
+        self._table = FeatureTable.build(self.sft, cols, fids=fids)
+
+    # -- queries (served entirely from memory, §3.6) -------------------------
+
+    def query(self, f: Union[str, ir.Filter] = "INCLUDE") -> FeatureTable:
+        self._materialize()
+        if self._table is None:
+            return FeatureTable.build(self.sft, {a.name: [] for a in self.sft.attributes})
+        if isinstance(f, str):
+            f = parse_ecql(f)
+        mask = _evaluate(f, self._table)
+        return self._table.take(np.nonzero(mask)[0])
+
+    def count(self, f: Union[str, ir.Filter] = "INCLUDE") -> int:
+        self._materialize()
+        if self._table is None:
+            return 0
+        if isinstance(f, str):
+            f = parse_ecql(f)
+        if isinstance(f, ir.Include):
+            return len(self._table)
+        return int(_evaluate(f, self._table).sum())
+
+
+class LambdaDataStore:
+    """Hot live tier + cold indexed tier, merged (≙ LambdaDataStore.scala:
+    query = union(cache, store minus overlap); persistence flushes the hot
+    tier into the cold store)."""
+
+    def __init__(self, cold_store, type_name: str,
+                 expiry_ms: Optional[int] = None,
+                 event_time: Optional[str] = None,
+                 persist_threshold: int = 100_000):
+        self.cold = cold_store
+        self.type_name = type_name
+        self.sft = cold_store.get_schema(type_name)
+        self.live = LiveLayer(self.sft, expiry_ms, event_time)
+        self.persist_threshold = persist_threshold
+
+    # -- writes land in the hot tier -----------------------------------------
+
+    def put(self, fid: str, **attributes) -> None:
+        self.live.put(fid, **attributes)
+        if len(self.live) >= self.persist_threshold:
+            self.persist()
+
+    def delete(self, fid: str) -> None:
+        """Remove from the hot tier AND the cold tier — a delete must reach
+        whichever tier currently holds the feature (≙ the lambda tier
+        writing Kafka deletes while also deleting from the persistent store)."""
+        self.live.delete(fid)
+        if self.cold.tables.get(self.type_name) is not None:
+            self.cold.remove_features(self.type_name, ir.FidFilter((fid,)))
+
+    def persist(self) -> int:
+        """Flush the hot tier into the cold store (≙ DataStorePersistence).
+        Hot rows that shadow cold fids replace them. Returns rows flushed."""
+        table = self.live.table()
+        if table is None:
+            return 0
+        shadowed = [f for f in table.fids]
+        if self.cold.tables.get(self.type_name) is not None:
+            existing = set(self.cold.tables[self.type_name].fids)
+            dup = [f for f in shadowed if f in existing]
+            if dup:
+                self.cold.remove_features(
+                    self.type_name, ir.FidFilter(tuple(dup)))
+        self.cold.load(self.type_name, table)
+        self.live.clear()
+        return len(table)
+
+    # -- merged reads --------------------------------------------------------
+
+    def count(self, f: Union[str, ir.Filter] = "INCLUDE") -> int:
+        return len(self.query_indices(f)[0]) + self.live.count(f)
+
+    def query(self, f: Union[str, ir.Filter] = "INCLUDE") -> FeatureTable:
+        rows, planner = self.query_indices(f)
+        cold_part = planner.table.take(rows) if planner is not None else None
+        hot_part = self.live.query(f)
+        if cold_part is None or len(cold_part) == 0:
+            return hot_part
+        if len(hot_part) == 0:
+            return cold_part
+        return FeatureTable.concat([cold_part, hot_part])
+
+    def query_indices(self, f):
+        """Cold-tier row indices minus rows shadowed by hot fids."""
+        if self.cold.tables.get(self.type_name) is None:
+            return np.empty(0, dtype=np.int64), None
+        planner = self.cold.planner(self.type_name)
+        rows = planner.select_indices(f)
+        hot = self.live.fids
+        if hot and len(rows):
+            rows = rows[~np.isin(planner.table.fids[rows],
+                                 np.asarray(hot, dtype=object))]
+        return rows, planner

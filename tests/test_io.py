@@ -65,6 +65,28 @@ def test_twkb_header_spec_nibbles():
     assert blob[1] == 0  # empty metadata byte
 
 
+def test_twkb_rejects_bad_inputs():
+    garr = GeometryArray.from_wkt(["POINT (1 2)"])
+    with pytest.raises(ValueError, match="precision"):
+        encode_twkb(garr, precision=8)
+    blob = bytearray(encode_twkb(garr)[0])
+    blob[1] = 0x02  # size flag — unimplemented metadata
+    with pytest.raises(ValueError, match="metadata"):
+        decode_twkb([bytes(blob)])
+
+
+def test_wkb_ewkb_srid_and_zm():
+    import struct as _s
+    garr = GeometryArray.from_wkt(["POINT (3 4)"])
+    plain = encode_wkb(garr)[0]
+    # EWKB: set SRID flag + splice in a 4-byte srid after the type word
+    ewkb = plain[:1] + _s.pack("<I", 1 | 0x20000000) + _s.pack("<I", 4326) + plain[5:]
+    back = decode_wkb([ewkb])
+    np.testing.assert_allclose(back.coords, [[3, 4]])
+    with pytest.raises(ValueError, match="Z/M"):
+        decode_wkb([plain[:1] + _s.pack("<I", 1001) + plain[5:]])
+
+
 def test_zigzag():
     v = np.array([0, -1, 1, -2, 2, -(1 << 40)], dtype=np.int64)
     assert np.array_equal(unzigzag(zigzag(v)), v)

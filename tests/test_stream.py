@@ -1,0 +1,134 @@
+"""Streaming layer tests: live cache semantics, expiry, lambda merge,
+persistence (SURVEY.md §2.6 Kafka/Lambda parity)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.stream import GeoMessage, LambdaDataStore, LiveLayer
+
+SPEC = "name:String,v:Int,dtg:Date,*geom:Point"
+
+
+def _sft():
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    return SimpleFeatureType.from_spec("live", SPEC)
+
+
+DTG = np.datetime64("2024-01-01T00:00:00", "ms")
+
+
+def test_upsert_replaces(niters=3):
+    live = LiveLayer(_sft())
+    for i in range(niters):
+        live.put("f1", name="a", v=i, dtg=DTG, geom=(1.0, 2.0))
+    assert len(live) == 1
+    assert live.query().to_dicts()[0]["v"] == niters - 1
+
+
+def test_delete_and_clear():
+    live = LiveLayer(_sft())
+    live.put("f1", name="a", v=1, dtg=DTG, geom=(0.0, 0.0))
+    live.put("f2", name="b", v=2, dtg=DTG, geom=(1.0, 1.0))
+    live.delete("f1")
+    assert live.fids == ["f2"]
+    live.clear()
+    assert len(live) == 0 and live.count() == 0
+
+
+def test_live_query_filters():
+    live = LiveLayer(_sft())
+    for i in range(100):
+        live.put(f"f{i}", name="a" if i % 2 else "b", v=i, dtg=DTG,
+                 geom=(float(i % 10), float(i // 10)))
+    assert live.count("v < 50") == 50
+    assert live.count("name = 'a' AND BBOX(geom, -1, -1, 4.5, 11)") == \
+        sum(1 for i in range(100) if i % 2 and (i % 10) <= 4.5)
+
+
+def test_ingest_time_expiry():
+    live = LiveLayer(_sft(), expiry_ms=1000)
+    live.apply(GeoMessage.upsert("old", dict(name="a", v=1, dtg=DTG, geom=(0.0, 0.0)),
+                                 ts_ms=1000))
+    live.apply(GeoMessage.upsert("new", dict(name="a", v=2, dtg=DTG, geom=(0.0, 0.0)),
+                                 ts_ms=5000))
+    assert live.expire(now_ms=5500) == 1
+    assert live.fids == ["new"]
+
+
+def test_event_time_expiry():
+    live = LiveLayer(_sft(), expiry_ms=3600_000, event_time="dtg")
+    base = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    live.put("a", name="x", v=1, dtg=int(base), geom=(0.0, 0.0))
+    live.put("b", name="x", v=2, dtg=int(base + 2 * 3600_000), geom=(0.0, 0.0))
+    assert live.expire(now_ms=int(base + 3 * 3600_000)) == 1
+    assert live.fids == ["b"]
+
+
+@pytest.fixture()
+def lam():
+    ds = TpuDataStore()
+    ds.create_schema("live", SPEC)
+    rng = np.random.default_rng(4)
+    n = 5000
+    base = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    ds.load("live", FeatureTable.build(ds.get_schema("live"), {
+        "name": rng.choice(["a", "b"], n).astype(object),
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 86400000, n),
+        "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+        fids=[f"c{i}" for i in range(n)]))
+    return LambdaDataStore(ds, "live")
+
+
+def test_lambda_merged_reads(lam):
+    cold_count = lam.cold.count("live", "v < 10")
+    lam.put("h1", name="a", v=5, dtg=DTG, geom=(0.0, 0.0))
+    lam.put("h2", name="a", v=50, dtg=DTG, geom=(0.0, 0.0))
+    assert lam.count("v < 10") == cold_count + 1
+
+
+def test_lambda_hot_shadows_cold(lam):
+    # overwrite an existing cold fid in the hot tier: total count unchanged,
+    # new value visible
+    total = lam.count()
+    lam.put("c0", name="a", v=999, dtg=DTG, geom=(0.0, 0.0))
+    assert lam.count() == total
+    got = lam.query("v = 999")
+    assert list(got.fids) == ["c0"]
+
+
+def test_lambda_persist(lam):
+    total = lam.count()
+    lam.put("h1", name="b", v=12, dtg=DTG, geom=(3.0, 3.0))
+    lam.put("c1", name="b", v=1000, dtg=DTG, geom=(3.0, 3.0))  # shadows cold
+    flushed = lam.persist()
+    assert flushed == 2
+    assert len(lam.live) == 0
+    assert lam.count() == total + 1  # h1 new, c1 replaced
+    assert lam.cold.count("live", "v = 1000") == 1
+    # cold store has exactly one c1 row
+    assert int(np.sum(lam.cold.tables["live"].fids == "c1")) == 1
+
+
+def test_lambda_delete_reaches_cold(lam):
+    lam.put("h9", name="a", v=7, dtg=DTG, geom=(1.0, 1.0))
+    lam.persist()
+    total = lam.count()
+    lam.delete("h9")       # persisted feature: delete must reach cold tier
+    lam.delete("c5")       # cold-only feature
+    assert lam.count() == total - 2
+    assert "h9" not in set(lam.cold.tables["live"].fids)
+    assert "c5" not in set(lam.cold.tables["live"].fids)
+
+
+def test_lambda_auto_persist():
+    ds = TpuDataStore()
+    ds.create_schema("live", SPEC)
+    lam = LambdaDataStore(ds, "live", persist_threshold=10)
+    for i in range(10):
+        lam.put(f"f{i}", name="a", v=i, dtg=DTG, geom=(float(i), 0.0))
+    assert len(lam.live) == 0  # threshold crossed -> flushed
+    assert lam.cold.count("live") == 10
+    assert lam.count("v < 5") == 5
