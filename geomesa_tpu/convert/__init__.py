@@ -1,0 +1,12 @@
+"""Ingest/converter layer: transform DSL, format frontends, type inference.
+
+≙ reference `geomesa-convert` (SURVEY.md §2.10).
+"""
+
+from geomesa_tpu.convert.converter import ConverterConfig, SimpleFeatureConverter
+from geomesa_tpu.convert.expression import FUNCTIONS, parse_expression
+from geomesa_tpu.convert.inference import converter_config_from_inference, infer_schema
+
+__all__ = ["ConverterConfig", "FUNCTIONS", "SimpleFeatureConverter",
+           "converter_config_from_inference", "infer_schema",
+           "parse_expression"]

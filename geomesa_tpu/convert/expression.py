@@ -1,0 +1,324 @@
+"""Transform expression DSL — columnar.
+
+≙ reference converter `Expression` DSL (geomesa-convert/convert2/
+transforms/Expression.scala + the function factories: DateFunctionFactory,
+GeometryFunctionFactory, StringFunctionFactory, MathFunctionFactory,
+IdFunctionFactory). Same surface — ``$1``/``$name`` field refs, nested
+function calls, literals — but every expression evaluates VECTORIZED over
+whole numpy columns instead of per-record: one ingest batch is one pass of
+array ops, which is what keeps a 100M-row CSV load columnar end to end.
+
+    point($lon, $lat)          geometry($wkt)
+    dateTime($d, '%Y-%m-%d')   isoDateTime($d)     millisToDate($ms)
+    toInt($1)  toLong  toFloat toDouble  toString  toBoolean
+    concat($1, '-', $2)        trim  lowercase  uppercase
+    substring($1, 0, 4)        regexReplace($1, 'a+', 'b')
+    add  subtract  multiply  divide
+    md5($1)   uuid()   literal('x')
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import uuid as _uuid
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+
+@dataclass
+class PointPair:
+    """Marker a geometry field returns for point(x, y) — the table builder
+    turns it into the (x, y) fast path."""
+    x: np.ndarray
+    y: np.ndarray
+
+
+# -- parsing -----------------------------------------------------------------
+
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<lparen>\() | (?P<rparen>\)) | (?P<comma>,)
+    | (?P<str>'(?:[^'\\]|\\.)*')
+    | (?P<num>-?\d+\.\d+|-?\d+)
+    | (?P<field>\$\{[^}]+\}|\$[A-Za-z_0-9.]+)
+    | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
+    )""", re.VERBOSE)
+
+
+class Expr:
+    def eval(self, fields: Dict[str, np.ndarray], n: int):
+        raise NotImplementedError
+
+
+@dataclass
+class Lit(Expr):
+    value: object
+
+    def eval(self, fields, n):
+        return np.full(n, self.value, dtype=object) \
+            if isinstance(self.value, str) else np.full(n, self.value)
+
+
+@dataclass
+class FieldRef(Expr):
+    name: str
+
+    def eval(self, fields, n):
+        if self.name not in fields:
+            raise KeyError(f"No input field {self.name!r} "
+                           f"(have {sorted(fields)})")
+        return fields[self.name]
+
+
+@dataclass
+class Call(Expr):
+    fn: str
+    args: List[Expr]
+
+    def eval(self, fields, n):
+        if self.fn not in FUNCTIONS:
+            raise ValueError(f"Unknown transform function {self.fn!r}")
+        return FUNCTIONS[self.fn](*[a.eval(fields, n) for a in self.args], n=n)
+
+
+def parse_expression(text: str) -> Expr:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"Bad expression at {text[pos:pos+20]!r}")
+        tokens.append(m)
+        pos = m.end()
+
+    idx = 0
+
+    def peek(kind):
+        return idx < len(tokens) and tokens[idx].lastgroup == kind
+
+    def take():
+        nonlocal idx
+        t = tokens[idx]
+        idx += 1
+        return t
+
+    def parse_one() -> Expr:
+        if peek("str"):
+            raw = take().group("str")[1:-1]
+            return Lit(raw.replace("\\'", "'").replace("\\\\", "\\"))
+        if peek("num"):
+            raw = take().group("num")
+            return Lit(float(raw) if "." in raw else int(raw))
+        if peek("field"):
+            raw = take().group("field")[1:]
+            name = raw[1:-1] if raw.startswith("{") else raw
+            return FieldRef(name)
+        if peek("name"):
+            fn = take().group("name")
+            args: List[Expr] = []
+            if not peek("lparen"):
+                raise ValueError(f"Expected '(' after {fn!r}")
+            take()
+            if not peek("rparen"):
+                args.append(parse_one())
+                while peek("comma"):
+                    take()
+                    args.append(parse_one())
+            if not peek("rparen"):
+                raise ValueError(f"Unclosed call {fn!r}")
+            take()
+            return Call(fn, args)
+        raise ValueError(f"Unexpected token in expression: {text!r}")
+
+    out = parse_one()
+    if idx != len(tokens):
+        raise ValueError(f"Trailing input in expression: {text!r}")
+    return out
+
+
+# -- function registry (vectorized) ------------------------------------------
+
+
+def _as_f64(a):
+    return np.asarray(a, dtype=np.float64)
+
+
+def _str(a):
+    arr = np.asarray(a)
+    if arr.dtype.kind in "OU":
+        return arr.astype(object)
+    return np.asarray([str(v) for v in arr], dtype=object)
+
+
+FUNCTIONS: Dict[str, Callable] = {}
+
+
+def register(name):
+    def inner(fn):
+        FUNCTIONS[name] = fn
+        return fn
+    return inner
+
+
+@register("point")
+def _point(x, y, n=0):
+    return PointPair(_as_f64(x), _as_f64(y))
+
+
+@register("geometry")
+def _geometry(wkt, n=0):
+    return _str(wkt)  # table builder parses WKT columns
+
+
+@register("dateTime")
+def _datetime(col, fmt, n=0):
+    from datetime import datetime, timezone
+    f = fmt[0]
+    out = np.empty(len(col), dtype=np.int64)
+    for i, v in enumerate(col):
+        dt = datetime.strptime(str(v).strip(), f)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        out[i] = int(dt.timestamp() * 1000)
+    return out
+
+
+@register("isoDateTime")
+@register("isoDate")
+def _isodate(col, n=0):
+    vals = np.asarray([str(v).strip().rstrip("Z") for v in col], dtype="datetime64[ms]")
+    return vals.astype(np.int64)
+
+
+@register("millisToDate")
+def _millis(col, n=0):
+    return np.asarray(col, dtype=np.int64)
+
+
+@register("secsToDate")
+def _secs(col, n=0):
+    return np.asarray(col, dtype=np.int64) * 1000
+
+
+@register("toInt")
+@register("toInteger")
+def _toint(col, n=0):
+    return _as_f64(col).astype(np.int32)
+
+
+@register("toLong")
+def _tolong(col, n=0):
+    return _as_f64(col).astype(np.int64)
+
+
+@register("toFloat")
+def _tofloat(col, n=0):
+    return _as_f64(col).astype(np.float32)
+
+
+@register("toDouble")
+def _todouble(col, n=0):
+    return _as_f64(col)
+
+
+@register("toBoolean")
+def _tobool(col, n=0):
+    arr = np.asarray(col)
+    if arr.dtype.kind == "b":
+        return arr
+    return np.asarray([str(v).strip().lower() in ("true", "1", "t", "yes")
+                       for v in arr])
+
+
+@register("toString")
+def _tostring(col, n=0):
+    return _str(col)
+
+
+@register("concat")
+def _concat(*cols, n=0):
+    parts = [_str(c) for c in cols]
+    out = parts[0].copy()
+    for p in parts[1:]:
+        out = np.asarray([a + b for a, b in zip(out, p)], dtype=object)
+    return out
+
+
+@register("trim")
+def _trim(col, n=0):
+    return np.asarray([s.strip() for s in _str(col)], dtype=object)
+
+
+@register("lowercase")
+def _lower(col, n=0):
+    return np.asarray([s.lower() for s in _str(col)], dtype=object)
+
+
+@register("uppercase")
+def _upper(col, n=0):
+    return np.asarray([s.upper() for s in _str(col)], dtype=object)
+
+
+@register("substring")
+def _substring(col, start, end, n=0):
+    s0, e0 = int(start[0]), int(end[0])
+    return np.asarray([s[s0:e0] for s in _str(col)], dtype=object)
+
+
+@register("regexReplace")
+def _regex_replace(col, pattern, repl, n=0):
+    rx = re.compile(str(pattern[0]))
+    rp = str(repl[0])
+    return np.asarray([rx.sub(rp, s) for s in _str(col)], dtype=object)
+
+
+@register("add")
+def _add(a, b, n=0):
+    return _as_f64(a) + _as_f64(b)
+
+
+@register("subtract")
+def _sub(a, b, n=0):
+    return _as_f64(a) - _as_f64(b)
+
+
+@register("multiply")
+def _mul(a, b, n=0):
+    return _as_f64(a) * _as_f64(b)
+
+
+@register("divide")
+def _div(a, b, n=0):
+    return _as_f64(a) / _as_f64(b)
+
+
+@register("md5")
+def _md5(col, n=0):
+    return np.asarray([hashlib.md5(str(s).encode()).hexdigest()
+                       for s in _str(col)], dtype=object)
+
+
+@register("uuid")
+def _uuid_fn(n=0):
+    return np.asarray([str(_uuid.uuid4()) for _ in range(n)], dtype=object)
+
+
+@register("literal")
+def _literal(col, n=0):
+    return col
+
+
+@register("withDefault")
+def _with_default(col, default, n=0):
+    arr = np.asarray(col, dtype=object)
+    miss = np.asarray([v is None or (isinstance(v, str) and v == "")
+                       for v in arr])
+    arr = arr.copy()
+    arr[miss] = default[0] if len(default) else None
+    return arr

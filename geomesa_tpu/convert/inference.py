@@ -1,0 +1,97 @@
+"""Type inference for schema-less ingest.
+
+≙ reference `TypeInference` (geomesa-convert/convert2/TypeInference.scala,
+477 LoC): sample the input, infer per-column attribute types, name a
+geometry. Heuristics mirror the reference: numeric widening Int → Long →
+Double, ISO dates, lat/lon column-name pairing into a Point.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LAT_NAMES = {"lat", "latitude", "y"}
+_LON_NAMES = {"lon", "lng", "long", "longitude", "x"}
+_ISO_RE = re.compile(r"^\d{4}-\d{2}-\d{2}([T ]\d{2}:\d{2}(:\d{2}(\.\d+)?)?Z?)?$")
+
+
+def _infer_one(values: Sequence[str]) -> str:
+    vals = [str(v).strip() for v in values if str(v).strip() != ""]
+    if not vals:
+        return "String"
+    try:
+        ints = [int(v) for v in vals]
+        if all(-(1 << 31) <= i < (1 << 31) for i in ints):
+            return "Int"
+        return "Long"
+    except ValueError:
+        pass
+    try:
+        [float(v) for v in vals]
+        return "Double"
+    except ValueError:
+        pass
+    if all(_ISO_RE.match(v) for v in vals):
+        return "Date"
+    if all(v.lower() in ("true", "false") for v in vals):
+        return "Boolean"
+    if all(re.match(r"^(POINT|LINESTRING|POLYGON|MULTI)", v.upper()) for v in vals):
+        m = re.match(r"^(\w+)", vals[0].upper())
+        return {"POINT": "Point", "LINESTRING": "LineString",
+                "POLYGON": "Polygon", "MULTIPOINT": "MultiPoint",
+                "MULTILINESTRING": "MultiLineString",
+                "MULTIPOLYGON": "MultiPolygon"}.get(m.group(1), "Geometry")
+    return "String"
+
+
+def infer_schema(names: List[str], sample_rows: Sequence[Sequence[str]],
+                 type_name: str = "features") -> Tuple[str, Dict[str, str]]:
+    """(sft spec string, field-name → transform expression map).
+
+    The transforms feed a converter config directly: numeric/date columns get
+    to*/isoDateTime casts, a detected (lon, lat) pair becomes ``point()``,
+    WKT columns become ``geometry()``.
+    """
+    cols = list(zip(*sample_rows)) if sample_rows else [[] for _ in names]
+    types = {n: _infer_one(c) for n, c in zip(names, cols)}
+
+    lat = next((n for n in names if n.lower() in _LAT_NAMES
+                and types[n] in ("Double", "Int", "Long")), None)
+    lon = next((n for n in names if n.lower() in _LON_NAMES
+                and types[n] in ("Double", "Int", "Long")), None)
+
+    attrs, transforms = [], {}
+    geom_done = False
+    for n in names:
+        t = types[n]
+        safe = re.sub(r"\W", "_", n)
+        if n in (lat, lon) and lat and lon:
+            continue  # folded into the point
+        if t in ("Point", "LineString", "Polygon", "MultiPoint",
+                 "MultiLineString", "MultiPolygon", "Geometry"):
+            star = "" if geom_done else "*"
+            attrs.append(f"{star}{safe}:{t}")
+            transforms[safe] = f"geometry(${{{n}}})"
+            geom_done = True
+            continue
+        attrs.append(f"{safe}:{t}")
+        transforms[safe] = {
+            "Int": f"toInt(${{{n}}})", "Long": f"toLong(${{{n}}})",
+            "Double": f"toDouble(${{{n}}})", "Date": f"isoDateTime(${{{n}}})",
+            "Boolean": f"toBoolean(${{{n}}})",
+        }.get(t, f"toString(${{{n}}})")
+    if lat and lon and not geom_done:
+        attrs.append("*geom:Point")
+        transforms["geom"] = f"point(${{{lon}}}, ${{{lat}}})"
+    return ",".join(attrs), transforms
+
+
+def converter_config_from_inference(spec: str, transforms: Dict[str, str],
+                                    fmt: str = "delimited-text") -> dict:
+    return {
+        "type": fmt,
+        "fields": [{"name": n, "transform": t} for n, t in transforms.items()],
+    }

@@ -65,16 +65,8 @@ class FeatureWriter:
                 data[a.name].append(row[a.name])
         cols: Dict[str, object] = {}
         for a in self.sft.attributes:
-            if a.is_geometry:
-                vals = data[a.name]
-                if vals and isinstance(vals[0], (tuple, list)) and len(vals[0]) == 2 \
-                        and isinstance(vals[0][0], (int, float)):
-                    xy = np.asarray(vals, dtype=np.float64)
-                    cols[a.name] = GeometryArray.points(xy[:, 0], xy[:, 1])
-                else:
-                    cols[a.name] = GeometryArray.from_wkt(vals)
-            else:
-                cols[a.name] = data[a.name]
+            cols[a.name] = GeometryArray.from_rows(data[a.name]) \
+                if a.is_geometry else data[a.name]
         batch = FeatureTable.build(self.sft, cols, fids=self._fids)
         self.store._append(self.type_name, batch)
         self._rows, self._fids = [], []

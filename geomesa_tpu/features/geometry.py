@@ -125,6 +125,16 @@ class GeometryArray:
     def from_wkt(cls, wkts: Sequence[str]) -> "GeometryArray":
         return cls.from_shapes([parse_wkt(w) for w in wkts])
 
+    @classmethod
+    def from_rows(cls, vals: Sequence) -> "GeometryArray":
+        """Coerce per-row geometry values — (x, y) pairs or WKT strings —
+        into a column (the row-writer ingest paths share this sniff)."""
+        if vals and isinstance(vals[0], (tuple, list)) and len(vals[0]) == 2 \
+                and isinstance(vals[0][0], (int, float)):
+            xy = np.asarray(vals, dtype=np.float64)
+            return cls.points(xy[:, 0], xy[:, 1])
+        return cls.from_wkt(list(vals))
+
     # -- accessors ----------------------------------------------------------
 
     @property

@@ -134,20 +134,12 @@ class LiveLayer:
             attrs = self._state[fid].attributes
             for a in self.sft.attributes:
                 data[a.name].append(attrs[a.name])
-        cols: Dict[str, object] = {}
-        for a in self.sft.attributes:
-            if a.is_geometry:
-                vals = data[a.name]
-                if vals and isinstance(vals[0], (tuple, list)) and len(vals[0]) == 2 \
-                        and isinstance(vals[0][0], (int, float)):
-                    xy = np.asarray(vals, dtype=np.float64)
-                    from geomesa_tpu.features.geometry import GeometryArray
-                    cols[a.name] = GeometryArray.points(xy[:, 0], xy[:, 1])
-                else:
-                    from geomesa_tpu.features.geometry import GeometryArray
-                    cols[a.name] = GeometryArray.from_wkt(vals)
-            else:
-                cols[a.name] = data[a.name]
+        from geomesa_tpu.features.geometry import GeometryArray
+        cols: Dict[str, object] = {
+            a.name: (GeometryArray.from_rows(data[a.name]) if a.is_geometry
+                     else data[a.name])
+            for a in self.sft.attributes
+        }
         self._table = FeatureTable.build(self.sft, cols, fids=fids)
 
     # -- queries (served entirely from memory, §3.6) -------------------------

@@ -1,0 +1,1 @@
+"""Ops tools: the command-line surface (≙ geomesa-tools, SURVEY.md §2.11)."""

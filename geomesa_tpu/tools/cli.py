@@ -1,0 +1,266 @@
+"""Command-line interface.
+
+≙ reference `geomesa-tools` (SURVEY.md §2.11 — tools/Runner.scala:24 command
+tree: create-schema / ingest / export / explain / stats-* / delete /
+remove-schema). The "catalog" is a checkpoint directory (io.checkpoint);
+mutating commands load → act → save.
+
+    geomesa-tpu create-schema -s STORE -f NAME --spec 'dtg:Date,*geom:Point'
+    geomesa-tpu ingest        -s STORE -f NAME data.csv [--converter conv.json | --infer]
+    geomesa-tpu count         -s STORE -f NAME [-q ECQL]
+    geomesa-tpu export        -s STORE -f NAME [-q ECQL] --format csv [-o out.csv]
+    geomesa-tpu explain       -s STORE -f NAME -q ECQL
+    geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
+    geomesa-tpu delete        -s STORE -f NAME -q ECQL
+    geomesa-tpu describe / list / remove-schema
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv as _csv
+import json
+import os
+import sys
+
+
+def _load(store_dir: str, must_exist: bool = False):
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.io.checkpoint import load_store
+    if os.path.exists(os.path.join(store_dir, "catalog.json")):
+        return load_store(store_dir)
+    if must_exist:
+        raise SystemExit(f"No store at {store_dir} (missing catalog.json)")
+    return TpuDataStore()
+
+
+def _save(store, store_dir: str) -> None:
+    from geomesa_tpu.io.checkpoint import save_store
+    save_store(store, store_dir)
+
+
+def cmd_create_schema(args):
+    store = _load(args.store)
+    store.create_schema(args.feature, args.spec)
+    _save(store, args.store)
+    print(f"Created schema {args.feature!r}")
+
+
+def cmd_list(args):
+    store = _load(args.store, must_exist=True)
+    for name in store.get_type_names():
+        t = store.tables.get(name)
+        print(f"{name}\t{0 if t is None else len(t)} features")
+
+
+def cmd_describe(args):
+    store = _load(args.store, must_exist=True)
+    sft = store.get_schema(args.feature)
+    for a in sft.attributes:
+        star = "*" if a.default else " "
+        print(f"{star} {a.name}: {a.type_name} {a.options or ''}")
+    if sft.user_data:
+        print(f"user-data: {sft.user_data}")
+
+
+def cmd_ingest(args):
+    from geomesa_tpu.convert import (SimpleFeatureConverter,
+                                     converter_config_from_inference,
+                                     infer_schema)
+    store = _load(args.store)
+    fmt = args.format or ("json" if args.files[0].endswith((".json", ".jsonl"))
+                          else "csv")
+    delim = "\t" if fmt == "tsv" else ","
+
+    if args.converter:
+        with open(args.converter) as fh:
+            config = json.load(fh)
+        sft = store.get_schema(args.feature)
+    elif args.infer:
+        if fmt == "json":
+            raise SystemExit(
+                "--infer only supports delimited input; for JSON provide a "
+                "--converter config")
+        with open(args.files[0], newline="") as fh:
+            rows = list(_csv.reader(fh, delimiter=delim))
+        if not rows or not rows[0]:
+            raise SystemExit(f"Cannot infer a schema from empty {args.files[0]}")
+        names, sample = rows[0], rows[1:101]
+        spec, transforms = infer_schema(names, sample)
+        config = converter_config_from_inference(spec, transforms)
+        if args.feature not in store.get_type_names():
+            store.create_schema(args.feature, spec)
+            print(f"Inferred schema: {spec}")
+        sft = store.get_schema(args.feature)
+    else:
+        raise SystemExit("ingest requires --converter CONF or --infer")
+
+    conv = SimpleFeatureConverter(config, sft)
+    total = 0
+    for path in args.files:
+        if fmt == "json":
+            table = conv.convert_json(path)
+        else:
+            table = conv.convert_delimited(path, delimiter=delim)
+        store.load(args.feature, table)
+        total += len(table)
+    _save(store, args.store)
+    msg = f"Ingested {total} features into {args.feature!r}"
+    if conv.skipped:
+        msg += f" ({conv.skipped} bad records skipped)"
+    print(msg)
+
+
+def cmd_count(args):
+    store = _load(args.store, must_exist=True)
+    print(store.count(args.feature, args.cql or "INCLUDE"))
+
+
+def cmd_export(args):
+    from geomesa_tpu.io.export import export
+    store = _load(args.store, must_exist=True)
+    res = store.query(args.feature, args.cql or "INCLUDE")
+    table = res.table
+    if args.max is not None and len(table) > args.max:
+        import numpy as np
+        table = table.take(np.arange(args.max))
+    out = export(table, args.format, args.output)
+    if args.output:
+        print(f"Exported {len(table)} features to {args.output}")
+    else:
+        sys.stdout.write(out)
+
+
+def cmd_explain(args):
+    store = _load(args.store, must_exist=True)
+    plan = store.explain(args.feature, args.cql)
+    print(json.dumps({k: str(v) for k, v in plan.items()}, indent=2))
+
+
+def cmd_stats(args):
+    store = _load(args.store, must_exist=True)
+    s = store.stats(args.feature)
+    kind = args.kind
+    if kind == "count":
+        print(s.get_count(args.cql, exact=not args.no_exact))
+    elif kind == "bounds":
+        print(s.get_bounds())
+    elif kind == "minmax":
+        mm = s.get_min_max(args.attr)
+        print(json.dumps(mm.to_json()))
+    elif kind == "topk":
+        print(json.dumps(s.get_top_k(args.attr).topk(10)))
+    elif kind == "histogram":
+        h = s.get_histogram(args.attr, bins=args.bins, f=args.cql)
+        if h is None:
+            raise SystemExit(f"{args.attr!r} is not a binnable attribute")
+        edges = h.bin_edges()
+        width = max(int(c) for c in h.counts) or 1
+        for i, c in enumerate(h.counts):
+            bar = "#" * max(1 if c else 0, int(40 * int(c) / width))
+            print(f"[{edges[i]:>12.2f} .. {edges[i+1]:>12.2f}] {int(c):>9} {bar}")
+    else:
+        raise SystemExit(f"Unknown stats kind {kind!r}")
+
+
+def cmd_delete(args):
+    store = _load(args.store, must_exist=True)
+    n = store.remove_features(args.feature, args.cql)
+    _save(store, args.store)
+    print(f"Deleted {n} features")
+
+
+def cmd_remove_schema(args):
+    store = _load(args.store, must_exist=True)
+    store.remove_schema(args.feature)
+    npz = os.path.join(args.store, f"{args.feature}.npz")
+    if os.path.exists(npz):
+        os.remove(npz)
+    _save(store, args.store)
+    print(f"Removed schema {args.feature!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="geomesa-tpu",
+        description="TPU-native spatio-temporal datastore tools")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, feature=True):
+        sp.add_argument("-s", "--store", required=True,
+                        help="store (checkpoint) directory")
+        if feature:
+            sp.add_argument("-f", "--feature", required=True,
+                            help="feature type name")
+
+    sp = sub.add_parser("create-schema", help="register a feature type")
+    common(sp)
+    sp.add_argument("--spec", required=True, help="SFT spec string")
+    sp.set_defaults(fn=cmd_create_schema)
+
+    sp = sub.add_parser("list", help="list feature types")
+    common(sp, feature=False)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("describe", help="describe a feature type")
+    common(sp)
+    sp.set_defaults(fn=cmd_describe)
+
+    sp = sub.add_parser("ingest", help="ingest files through a converter")
+    common(sp)
+    sp.add_argument("files", nargs="+")
+    sp.add_argument("--converter", help="converter config JSON file")
+    sp.add_argument("--infer", action="store_true",
+                    help="infer schema + converter from the data")
+    sp.add_argument("--format", choices=("csv", "tsv", "json"))
+    sp.set_defaults(fn=cmd_ingest)
+
+    sp = sub.add_parser("count", help="count matching features")
+    common(sp)
+    sp.add_argument("-q", "--cql", help="ECQL filter")
+    sp.set_defaults(fn=cmd_count)
+
+    sp = sub.add_parser("export", help="export matching features")
+    common(sp)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("--format", default="csv",
+                    help="csv|tsv|geojson|json|wkt|arrow|parquet")
+    sp.add_argument("-o", "--output")
+    sp.add_argument("--max", type=int)
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("explain", help="show the query plan")
+    common(sp)
+    sp.add_argument("-q", "--cql", required=True)
+    sp.set_defaults(fn=cmd_explain)
+
+    sp = sub.add_parser("stats", help="summary statistics")
+    common(sp)
+    sp.add_argument("--kind", default="count",
+                    choices=("count", "bounds", "minmax", "topk", "histogram"))
+    sp.add_argument("--attr")
+    sp.add_argument("--bins", type=int, default=20)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("--no-exact", action="store_true")
+    sp.set_defaults(fn=cmd_stats)
+
+    sp = sub.add_parser("delete", help="delete matching features")
+    common(sp)
+    sp.add_argument("-q", "--cql", required=True)
+    sp.set_defaults(fn=cmd_delete)
+
+    sp = sub.add_parser("remove-schema", help="drop a feature type")
+    common(sp)
+    sp.set_defaults(fn=cmd_remove_schema)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Converter/ingest + CLI tests (SURVEY.md §2.10/§2.11 parity)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert import (SimpleFeatureConverter, infer_schema,
+                                 parse_expression)
+from geomesa_tpu.features.sft import SimpleFeatureType
+
+CSV = """name,lat,lon,when,speed
+alpha,48.85,2.35,2024-03-01T10:00:00Z,12
+beta,51.50,-0.12,2024-03-02T11:30:00Z,7
+gamma,40.71,-74.00,2024-03-03T09:15:00Z,31
+"""
+
+CONFIG = {
+    "type": "delimited-text",
+    "id-field": "concat('f-', $name)",
+    "fields": [
+        {"name": "name", "transform": "toString($name)"},
+        {"name": "speed", "transform": "toInt($speed)"},
+        {"name": "dtg", "transform": "isoDateTime($when)"},
+        {"name": "geom", "transform": "point($lon, $lat)"},
+    ],
+}
+
+SFT = SimpleFeatureType.from_spec(
+    "boats", "name:String,speed:Int,dtg:Date,*geom:Point")
+
+
+# -- expression DSL ----------------------------------------------------------
+
+
+def test_expression_parse_and_eval():
+    e = parse_expression("concat(uppercase($1), '-', toString($2))")
+    out = e.eval({"1": np.asarray(["ab", "cd"], dtype=object),
+                  "2": np.asarray(["1", "2"], dtype=object)}, 2)
+    assert out.tolist() == ["AB-1", "CD-2"]
+
+
+def test_expression_math_and_dates():
+    e = parse_expression("multiply(toDouble($v), 2)")
+    assert e.eval({"v": np.asarray(["1.5", "2"], dtype=object)}, 2).tolist() == [3.0, 4.0]
+    d = parse_expression("dateTime($d, '%d/%m/%Y %H:%M')")
+    ms = d.eval({"d": np.asarray(["01/03/2024 10:00"], dtype=object)}, 1)
+    assert ms[0] == np.datetime64("2024-03-01T10:00:00", "ms").astype(np.int64)
+
+
+def test_expression_errors():
+    with pytest.raises(ValueError, match="Unknown transform function"):
+        parse_expression("nope($1)").eval({"1": np.zeros(1)}, 1)
+    with pytest.raises(ValueError):
+        parse_expression("toInt($1")  # unclosed
+    with pytest.raises(KeyError):
+        parse_expression("$missing").eval({"1": np.zeros(1)}, 1)
+
+
+# -- converter ---------------------------------------------------------------
+
+
+def test_csv_converter():
+    conv = SimpleFeatureConverter(CONFIG, SFT)
+    table = conv.convert_delimited(CSV)
+    assert len(table) == 3
+    assert list(table.fids) == ["f-alpha", "f-beta", "f-gamma"]
+    assert np.asarray(table.columns["speed"]).tolist() == [12, 7, 31]
+    x, y = table.geometry().point_xy()
+    np.testing.assert_allclose(x, [2.35, -0.12, -74.00])
+    assert table.columns["dtg"][0] == \
+        np.datetime64("2024-03-01T10:00:00", "ms").astype(np.int64)
+
+
+def test_csv_skip_bad_records():
+    bad = CSV + "delta,not-a-lat,9.99,2024-03-04T00:00:00Z,5\n"
+    conv = SimpleFeatureConverter(CONFIG, SFT)
+    table = conv.convert_delimited(bad)
+    assert len(table) == 3
+    assert conv.skipped == 1
+
+
+def test_csv_raise_errors_mode():
+    cfg = dict(CONFIG, options={"error-mode": "raise-errors"})
+    bad = CSV + "delta,not-a-lat,9.99,2024-03-04T00:00:00Z,5\n"
+    with pytest.raises(Exception):
+        SimpleFeatureConverter(cfg, SFT).convert_delimited(bad)
+
+
+def test_json_converter():
+    cfg = {
+        "type": "json",
+        "fields": [
+            {"name": "name", "transform": "toString($props.name)"},
+            {"name": "speed", "transform": "toInt($props.speed)"},
+            {"name": "dtg", "transform": "isoDateTime($when)"},
+            {"name": "geom", "transform": "point($loc.x, $loc.y)"},
+        ],
+    }
+    lines = "\n".join(json.dumps({
+        "props": {"name": f"n{i}", "speed": i * 10},
+        "when": f"2024-03-0{i+1}T00:00:00Z",
+        "loc": {"x": float(i), "y": float(-i)},
+    }) for i in range(3))
+    table = SimpleFeatureConverter(cfg, SFT).convert_json(lines)
+    assert len(table) == 3
+    assert np.asarray(table.columns["speed"]).tolist() == [0, 10, 20]
+
+
+def test_missing_transform_rejected():
+    cfg = {"type": "delimited-text",
+           "fields": [{"name": "name", "transform": "toString($1)"}]}
+    with pytest.raises(ValueError, match="no transform"):
+        SimpleFeatureConverter(cfg, SFT)
+
+
+# -- inference ---------------------------------------------------------------
+
+
+def test_braced_field_refs_with_odd_names():
+    e = parse_expression("toDouble(${wind-speed})")
+    out = e.eval({"wind-speed": np.asarray(["1.5"], dtype=object)}, 1)
+    assert out[0] == 1.5
+
+
+def test_single_line_content_not_path():
+    conv = SimpleFeatureConverter(dict(CONFIG, fields=[
+        {"name": "name", "transform": "toString($1)"},
+        {"name": "speed", "transform": "toInt($2)"},
+        {"name": "dtg", "transform": "isoDateTime($3)"},
+        {"name": "geom", "transform": "point($4, $5)"},
+    ], **{"id-field": None}), SFT)
+    t = conv.convert_delimited("a,1,2024-01-01T00:00:00Z,1.0,2.0", header=False)
+    assert len(t) == 1
+    with pytest.raises(FileNotFoundError):
+        conv.convert_delimited("missing-file.csv")
+
+
+def test_infer_schema():
+    names = ["name", "lat", "lon", "when", "speed"]
+    rows = [r.split(",") for r in CSV.strip().splitlines()[1:]]
+    spec, transforms = infer_schema(names, rows)
+    assert "name:String" in spec and "speed:Int" in spec
+    assert "when:Date" in spec
+    assert "*geom:Point" in spec and "lat" not in spec.split("*")[0].replace("name", "")
+    assert transforms["geom"] == "point(${lon}, ${lat})"
+
+
+def test_infer_wkt_geometry():
+    spec, transforms = infer_schema(
+        ["id", "shape"], [["1", "POLYGON ((0 0, 1 0, 1 1, 0 0))"]])
+    assert "*shape:Polygon" in spec
+
+
+# -- CLI (in-process: subprocess startup pays the full jax import per call) --
+
+
+class _Result:
+    def __init__(self, returncode, stdout, stderr):
+        self.returncode, self.stdout, self.stderr = returncode, stdout, stderr
+
+
+def _cli(tmp_path, *argv):
+    import contextlib
+    import io
+    from geomesa_tpu.tools.cli import main
+    out, err = io.StringIO(), io.StringIO()
+    code = 0
+    try:
+        with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+            code = main(list(argv))
+    except SystemExit as e:
+        code = 1 if e.code is None else (e.code if isinstance(e.code, int) else 1)
+        if not isinstance(e.code, int) and e.code is not None:
+            err.write(str(e.code))
+    return _Result(code, out.getvalue(), err.getvalue())
+
+
+def test_cli_roundtrip(tmp_path):
+    store = str(tmp_path / "store")
+    csv_file = tmp_path / "boats.csv"
+    csv_file.write_text(CSV)
+    conv_file = tmp_path / "conv.json"
+    conv_file.write_text(json.dumps(CONFIG))
+
+    r = _cli(tmp_path, "create-schema", "-s", store, "-f", "boats",
+             "--spec", "name:String,speed:Int,dtg:Date,*geom:Point")
+    assert r.returncode == 0, r.stderr
+    r = _cli(tmp_path, "ingest", "-s", store, "-f", "boats",
+             str(csv_file), "--converter", str(conv_file))
+    assert "Ingested 3" in r.stdout, r.stderr
+    r = _cli(tmp_path, "count", "-s", store, "-f", "boats",
+             "-q", "speed > 10")
+    assert r.stdout.strip() == "2"
+    r = _cli(tmp_path, "export", "-s", store, "-f", "boats", "--format", "csv")
+    assert "f-alpha" in r.stdout
+    r = _cli(tmp_path, "explain", "-s", store, "-f", "boats",
+             "-q", "BBOX(geom, 0, 40, 10, 55)")
+    assert r.returncode == 0 and "index" in r.stdout
+    r = _cli(tmp_path, "stats", "-s", store, "-f", "boats",
+             "--kind", "topk", "--attr", "name")
+    assert "alpha" in r.stdout
+    r = _cli(tmp_path, "delete", "-s", store, "-f", "boats", "-q", "speed = 7")
+    assert "Deleted 1" in r.stdout
+    r = _cli(tmp_path, "count", "-s", store, "-f", "boats")
+    assert r.stdout.strip() == "2"
+
+
+def test_cli_infer_ingest(tmp_path):
+    store = str(tmp_path / "store2")
+    csv_file = tmp_path / "pts.csv"
+    csv_file.write_text(CSV)
+    r = _cli(tmp_path, "ingest", "-s", store, "-f", "pts",
+             str(csv_file), "--infer")
+    assert "Inferred schema" in r.stdout and "Ingested 3" in r.stdout, r.stderr
+    r = _cli(tmp_path, "count", "-s", store, "-f", "pts",
+             "-q", "BBOX(geom, -80, 35, 5, 55)")
+    assert r.stdout.strip() == "3"
+
+
+def test_cli_missing_store(tmp_path):
+    r = _cli(tmp_path, "count", "-s", str(tmp_path / "nope"), "-f", "x")
+    assert r.returncode != 0
+    assert "No store" in r.stderr
