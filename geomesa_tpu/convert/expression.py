@@ -193,6 +193,9 @@ def _datetime(col, fmt, n=0):
 @register("isoDate")
 def _isodate(col, n=0):
     vals = np.asarray([str(v).strip().rstrip("Z") for v in col], dtype="datetime64[ms]")
+    if np.isnat(vals).any():
+        bad = [str(v) for v, isnat in zip(col, np.isnat(vals)) if isnat][:3]
+        raise ValueError(f"Unparseable ISO dates: {bad}")
     return vals.astype(np.int64)
 
 
@@ -206,15 +209,30 @@ def _secs(col, n=0):
     return np.asarray(col, dtype=np.int64) * 1000
 
 
+def _as_i64(col) -> np.ndarray:
+    """Integer parse without a float64 round-trip (which silently corrupts
+    values above 2^53 — snowflake ids, ns timestamps)."""
+    arr = np.asarray(col)
+    if arr.dtype.kind in "iu":
+        return arr.astype(np.int64)
+    if arr.dtype.kind == "f":
+        return arr.astype(np.int64)
+    out = np.empty(len(arr), dtype=np.int64)
+    for i, v in enumerate(arr):
+        s = str(v).strip()
+        out[i] = int(s) if ("." not in s and "e" not in s.lower()) else int(float(s))
+    return out
+
+
 @register("toInt")
 @register("toInteger")
 def _toint(col, n=0):
-    return _as_f64(col).astype(np.int32)
+    return _as_i64(col).astype(np.int32)
 
 
 @register("toLong")
 def _tolong(col, n=0):
-    return _as_f64(col).astype(np.int64)
+    return _as_i64(col)
 
 
 @register("toFloat")

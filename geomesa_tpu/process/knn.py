@@ -1,0 +1,77 @@
+"""K-nearest-neighbor search.
+
+≙ reference `KNearestNeighborSearchProcess` (geomesa-process/.../query/
+KNearestNeighborSearchProcess.scala): iterative expanding-radius queries
+against the index until enough candidates exist, then exact distance
+ranking. The radius doubling runs cheap device COUNTS (one fused scan each);
+only the final candidate set is pulled to the host for ranking — and the
+guarantee pass re-queries at the k-th distance so no closer feature outside
+the last bbox is missed."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.process.geo import expand_bbox, haversine_m
+
+
+def knn(planner, x: float, y: float, k: int,
+        f: Union[str, ir.Filter, None] = None,
+        initial_radius_m: float = 1000.0, max_doublings: int = 20):
+    """(row indices, distances in meters) of the k features nearest (x, y),
+    optionally restricted by a filter."""
+    if isinstance(f, str):
+        f = parse_ecql(f)
+    geom = planner.sft.geometry_attribute
+    if geom is None:
+        raise ValueError("KNN requires a geometry attribute")
+
+    def with_bbox(radius_m):
+        bbox = ir.BBox(geom.name, *expand_bbox(x, y, radius_m))
+        return bbox if f is None or isinstance(f, ir.Include) \
+            else ir.and_filters([f, bbox])
+
+    # expanding-radius count loop (device-side counts)
+    radius = float(initial_radius_m)
+    whole_world = False
+    for _ in range(max_doublings):
+        if planner.count(with_bbox(radius)) >= k:
+            break
+        radius *= 2
+        xmin, ymin, xmax, ymax = expand_bbox(x, y, radius)
+        if (xmin, ymin, xmax, ymax) == (-180.0, -90.0, 180.0, 90.0):
+            whole_world = True
+            break
+
+    rows, dists = _rank(planner, with_bbox(radius) if not whole_world else
+                        (f or ir.Include()), x, y, k)
+    if len(rows) == 0 or whole_world:
+        return rows, dists
+    # guarantee: the k-th distance may exceed the bbox's inscribed circle —
+    # re-query at that radius so boundary-adjacent closer points are seen
+    dk = float(dists[-1])
+    if dk > radius:
+        rows, dists = _rank(planner, with_bbox(dk * 1.001), x, y, k)
+    return rows, dists
+
+
+def _rank(planner, f, x, y, k):
+    rows = planner.select_indices(f)
+    if len(rows) == 0:
+        return rows, np.empty(0)
+    sub = planner.table.take(rows)
+    garr = sub.geometry()
+    if garr.is_points:
+        gx, gy = garr.point_xy()
+    else:
+        bb = garr.bboxes()
+        gx, gy = (bb[:, 0] + bb[:, 2]) / 2, (bb[:, 1] + bb[:, 3]) / 2
+    d = haversine_m(gx, gy, x, y)
+    take = min(k, len(d))
+    part = np.argpartition(d, take - 1)[:take]
+    order = part[np.argsort(d[part], kind="stable")]
+    return rows[order], d[order]

@@ -69,6 +69,7 @@ def cmd_ingest(args):
                                      infer_schema)
     store = _load(args.store)
     fmt = args.format or ("json" if args.files[0].endswith((".json", ".jsonl"))
+                          else "tsv" if args.files[0].endswith(".tsv")
                           else "csv")
     delim = "\t" if fmt == "tsv" else ","
 
@@ -146,12 +147,12 @@ def cmd_stats(args):
     elif kind == "bounds":
         print(s.get_bounds())
     elif kind == "minmax":
-        mm = s.get_min_max(args.attr)
+        mm = s.get_min_max(_require_attr(store, args))
         print(json.dumps(mm.to_json()))
     elif kind == "topk":
-        print(json.dumps(s.get_top_k(args.attr).topk(10)))
+        print(json.dumps(s.get_top_k(_require_attr(store, args)).topk(10)))
     elif kind == "histogram":
-        h = s.get_histogram(args.attr, bins=args.bins, f=args.cql)
+        h = s.get_histogram(_require_attr(store, args), bins=args.bins, f=args.cql)
         if h is None:
             raise SystemExit(f"{args.attr!r} is not a binnable attribute")
         edges = h.bin_edges()
@@ -161,6 +162,19 @@ def cmd_stats(args):
             print(f"[{edges[i]:>12.2f} .. {edges[i+1]:>12.2f}] {int(c):>9} {bar}")
     else:
         raise SystemExit(f"Unknown stats kind {kind!r}")
+
+
+def _require_attr(store, args) -> str:
+    if not args.attr:
+        raise SystemExit(f"stats --kind {args.kind} requires --attr")
+    sft = store.get_schema(args.feature)
+    try:
+        sft.attribute(args.attr)
+    except KeyError:
+        raise SystemExit(
+            f"No attribute {args.attr!r} in {args.feature!r} "
+            f"(have {[a.name for a in sft.attributes]})")
+    return args.attr
 
 
 def cmd_delete(args):

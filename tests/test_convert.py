@@ -107,6 +107,21 @@ def test_json_converter():
     assert np.asarray(table.columns["speed"]).tolist() == [0, 10, 20]
 
 
+def test_empty_date_is_a_bad_record():
+    # NaT must not silently become int64-min (year -292M poisoning the index)
+    bad = CSV + "delta,1.0,2.0,,5\n"
+    conv = SimpleFeatureConverter(CONFIG, SFT)
+    table = conv.convert_delimited(bad)
+    assert len(table) == 3 and conv.skipped == 1
+
+
+def test_tolong_exact_above_2_53():
+    big = "9007199254740993"  # 2^53 + 1: float64 round-trip corrupts it
+    e = parse_expression("toLong($1)")
+    out = e.eval({"1": np.asarray([big], dtype=object)}, 1)
+    assert int(out[0]) == 9007199254740993
+
+
 def test_missing_transform_rejected():
     cfg = {"type": "delimited-text",
            "fields": [{"name": "name", "transform": "toString($1)"}]}

@@ -1,0 +1,139 @@
+"""Process layer tests: KNN, proximity, route, tube, point2point, unique,
+hash/date utilities (SURVEY.md §2.9 parity) — each cross-checked against a
+brute-force numpy computation."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.process import (haversine_m, knn, point2point,
+                                 proximity_search, route_search, tube_select,
+                                 unique_values, hash_attribute, date_offset)
+
+
+@pytest.fixture(scope="module")
+def world():
+    rng = np.random.default_rng(17)
+    n = 20000
+    base = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    data = {
+        "track": rng.choice(["t1", "t2", "t3"], n).astype(object),
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 86400000, n),
+        "x": rng.uniform(-30, 30, n),
+        "y": rng.uniform(-30, 30, n),
+    }
+    ds = TpuDataStore()
+    ds.create_schema("w", "track:String,v:Int,dtg:Date,*geom:Point")
+    ds.load("w", FeatureTable.build(ds.get_schema("w"), {
+        "track": data["track"], "v": data["v"], "dtg": data["dtg"],
+        "geom": (data["x"], data["y"])}))
+    return ds.planner("w"), data, base
+
+
+def test_knn_matches_bruteforce(world):
+    planner, data, _ = world
+    rows, dists = knn(planner, 5.0, 5.0, 25)
+    ref_d = haversine_m(data["x"], data["y"], 5.0, 5.0)
+    ref_rows = np.argsort(ref_d, kind="stable")[:25]
+    assert np.array_equal(np.sort(rows), np.sort(ref_rows))
+    np.testing.assert_allclose(dists, ref_d[ref_rows], rtol=1e-9)
+    assert np.all(np.diff(dists) >= 0)
+
+
+def test_knn_with_filter(world):
+    planner, data, _ = world
+    rows, _ = knn(planner, 0.0, 0.0, 10, f="v < 50")
+    assert len(rows) == 10
+    assert np.all(data["v"][rows] < 50)
+    ref_d = haversine_m(data["x"], data["y"], 0.0, 0.0)
+    ref = np.argsort(np.where(data["v"] < 50, ref_d, np.inf), kind="stable")[:10]
+    assert np.array_equal(np.sort(rows), np.sort(ref))
+
+
+def test_knn_k_exceeds_matches(world):
+    planner, data, _ = world
+    rows, _ = knn(planner, 0.0, 0.0, 50, f="v = 7")
+    assert len(rows) == min(50, int(np.sum(data["v"] == 7)))
+
+
+def test_proximity_points(world):
+    planner, data, _ = world
+    centers = ["POINT (5 5)", "POINT (-10 -10)"]
+    rows = proximity_search(planner, centers, 200_000.0)
+    d1 = haversine_m(data["x"], data["y"], 5.0, 5.0)
+    d2 = haversine_m(data["x"], data["y"], -10.0, -10.0)
+    ref = np.nonzero((d1 <= 200_000) | (d2 <= 200_000))[0]
+    assert np.array_equal(np.sort(rows), ref)
+
+
+def test_route_search(world):
+    planner, data, _ = world
+    rows = route_search(planner, "LINESTRING (-20 0, 0 0, 20 10)", 100_000.0)
+    assert len(rows) > 0
+    # all results really are near the route (loose haversine check on the
+    # nearest vertex as a sanity bound: within buffer + segment length)
+    vx = np.array([-20.0, 0.0, 20.0])
+    vy = np.array([0.0, 0.0, 10.0])
+    dmin = np.min(haversine_m(data["x"][rows, None], data["y"][rows, None],
+                              vx[None, :], vy[None, :]), axis=1)
+    assert np.all(dmin <= 100_000 + 2_300_000)  # buffer + ~half segment span
+
+
+def test_tube_select(world):
+    planner, data, base = world
+    # track crossing the region over 24h
+    track = [(-20.0, -20.0, int(base)),
+             (0.0, 0.0, int(base + 12 * 3600_000)),
+             (20.0, 20.0, int(base + 24 * 3600_000))]
+    rows = tube_select(planner, track, buffer_m=150_000.0)
+    # brute force: interpolate per feature
+    t = np.clip(data["dtg"], base, base + 24 * 3600_000)
+    w = (t - base) / (24 * 3600_000)
+    ix = np.where(w <= 0.5, -20 + w * 2 * 20, 0 + (w - 0.5) * 2 * 20)
+    iy = ix  # same shape by construction
+    d = haversine_m(data["x"], data["y"], ix, iy)
+    ref = np.nonzero(d <= 150_000)[0]
+    assert np.array_equal(np.sort(rows), ref)
+
+
+def test_point2point(world):
+    planner, data, _ = world
+    lines = point2point(planner, "track", "v < 5")
+    ref = {}
+    m = data["v"] < 5
+    for tr in ("t1", "t2", "t3"):
+        ref[tr] = int(np.sum(m & (data["track"] == tr)))
+    got = {val: n for val, wkt, n in lines}
+    assert got == {k: v for k, v in ref.items() if v >= 2}
+    assert all(wkt.startswith("LINESTRING") for _, wkt, _ in lines)
+
+
+def test_unique_values(world):
+    planner, data, _ = world
+    vals = unique_values(planner, "track", sort_by_count=True)
+    uniq, cnt = np.unique(data["track"], return_counts=True)
+    assert dict(vals) == {v: int(c) for v, c in zip(uniq, cnt)}
+    assert vals[0][1] == max(cnt)
+
+
+def test_hash_attribute(world):
+    planner, _, _ = world
+    h = hash_attribute(planner, "track", 16)
+    assert h.min() >= 0 and h.max() < 16
+    # same attr value -> same bucket
+    sub = planner.table
+    col = sub.columns["track"]
+    b_by_val = {}
+    for code, bucket in zip(col.codes, h):
+        b_by_val.setdefault(code, set()).add(int(bucket))
+    assert all(len(s) == 1 for s in b_by_val.values())
+
+
+def test_date_offset(world):
+    planner, data, _ = world
+    out = date_offset(planner, 3600_000, "v = 1")
+    rows = planner.select_indices("v = 1")
+    assert np.array_equal(np.asarray(out.columns["dtg"]),
+                          data["dtg"][rows] + 3600_000)
