@@ -45,8 +45,12 @@ class FeatureWriter:
         self.sft = store.schemas[type_name]
         self._rows: List[dict] = []
         self._fids: List[Optional[str]] = []
+        self._vis: List[str] = []
 
-    def write(self, fid: Optional[str] = None, **attributes) -> str:
+    def write(self, fid: Optional[str] = None, vis: str = "",
+              **attributes) -> str:
+        """``vis``: visibility expression for this feature (≙ the mutation
+        visibility of geomesa-security; '' = public)."""
         missing = [a.name for a in self.sft.attributes if a.name not in attributes]
         if missing:
             raise ValueError(f"Missing attributes {missing}")
@@ -54,6 +58,7 @@ class FeatureWriter:
         if fid is None:
             fid = f"{self.type_name}.{self.store._fid_counter(self.type_name)}"
         self._fids.append(fid)
+        self._vis.append(vis)
         return fid
 
     def flush(self) -> None:
@@ -67,9 +72,11 @@ class FeatureWriter:
         for a in self.sft.attributes:
             cols[a.name] = GeometryArray.from_rows(data[a.name]) \
                 if a.is_geometry else data[a.name]
-        batch = FeatureTable.build(self.sft, cols, fids=self._fids)
+        vis = self._vis if any(self._vis) else None
+        batch = FeatureTable.build(self.sft, cols, fids=self._fids,
+                                   visibilities=vis)
         self.store._append(self.type_name, batch)
-        self._rows, self._fids = [], []
+        self._rows, self._fids, self._vis = [], [], []
 
     def __enter__(self):
         return self
@@ -185,7 +192,7 @@ class TpuDataStore:
         return self.planners[type_name]
 
     def query(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
-              hints: Optional[dict] = None):
+              hints: Optional[dict] = None, auths: Optional[list] = None):
         """Run a query; ``hints`` switch the result form exactly like the
         reference's QueryHints (conf/QueryHints.scala — DENSITY_*/BIN_*/
         STATS_*/SAMPLING keys):
@@ -199,7 +206,14 @@ class TpuDataStore:
         """
         planner = self.planner(type_name)
         if not hints:
-            return planner.query(f)
+            return planner.query(f, auths=auths)
+        if auths is not None:
+            # aggregate hint paths enforce visibility via the shared
+            # scan-mask/select machinery only when threaded; reject rather
+            # than silently ignore the caller's auth restriction
+            raise NotImplementedError(
+                "auths with aggregation hints: use planner.select_indices("
+                "f, auths=...) + the aggregate functions directly")
         if "density" in hints:
             from geomesa_tpu.aggregates.density import density
             d = dict(hints["density"])
@@ -221,8 +235,9 @@ class TpuDataStore:
             return QueryResult(rows, planner.table.take(rows), plan)
         raise ValueError(f"Unknown hints: {sorted(hints)}")
 
-    def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE") -> int:
-        return self.planner(type_name).count(f)
+    def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
+              auths: Optional[list] = None) -> int:
+        return self.planner(type_name).count(f, auths=auths)
 
     def explain(self, type_name: str, f: Union[str, ir.Filter]) -> dict:
         return self.planner(type_name).explain(f)

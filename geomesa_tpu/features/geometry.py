@@ -257,12 +257,14 @@ def parse_wkt(wkt: str) -> Tuple[int, list]:
 
 
 def _fmt_coords(coords: list) -> str:
-    return ", ".join(f"{x:g} {y:g}" for x, y in coords)
+    # .9g keeps ~1cm lon/lat precision; bare %g truncates to 6 significant
+    # digits (~50m error at mid-latitudes)
+    return ", ".join(f"{x:.9g} {y:.9g}" for x, y in coords)
 
 
 def write_wkt(code: int, data: list) -> str:
     if code == POINT:
-        return f"POINT ({data[0]:g} {data[1]:g})"
+        return f"POINT ({data[0]:.9g} {data[1]:.9g})"
     if code == LINESTRING:
         return f"LINESTRING ({_fmt_coords(data)})"
     if code == POLYGON:

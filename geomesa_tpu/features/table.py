@@ -48,6 +48,9 @@ class FeatureTable:
     fids: np.ndarray                                # (N,) object (str)
     columns: Dict[str, object] = field(default_factory=dict)
     # columns values: np.ndarray | StringColumn | GeometryArray
+    # per-feature visibility expressions, dictionary-encoded (≙ the
+    # visibility the reference stores with each mutation; geomesa-security)
+    visibility: Optional[StringColumn] = None
 
     def __len__(self) -> int:
         return len(self.fids)
@@ -58,11 +61,13 @@ class FeatureTable:
         sft: SimpleFeatureType,
         data: Dict[str, object],
         fids: Optional[Sequence[str]] = None,
+        visibilities: Optional[Sequence[str]] = None,
     ) -> "FeatureTable":
         """data: attribute name → column values.
 
         Geometries may be a GeometryArray, a list of WKT strings, or for Point
         attributes a (x, y) array tuple. Strings encode to dictionaries.
+        visibilities: per-feature visibility expressions ('' = public).
         """
         columns: Dict[str, object] = {}
         n = None
@@ -101,7 +106,12 @@ class FeatureTable:
             fids = np.asarray(fids, dtype=object)
             if len(fids) != n:
                 raise ValueError("fids length mismatch")
-        return cls(sft, fids, columns)
+        vis = None
+        if visibilities is not None:
+            if len(visibilities) != n:
+                raise ValueError("visibilities length mismatch")
+            vis = StringColumn.encode(visibilities)
+        return cls(sft, fids, columns, vis)
 
     # -- access -------------------------------------------------------------
 
@@ -129,7 +139,9 @@ class FeatureTable:
                 cols[name] = StringColumn(col.codes[idx], col.vocab)
             else:
                 cols[name] = col[idx]
-        return FeatureTable(self.sft, self.fids[idx], cols)
+        vis = StringColumn(self.visibility.codes[idx], self.visibility.vocab) \
+            if self.visibility is not None else None
+        return FeatureTable(self.sft, self.fids[idx], cols, vis)
 
     def to_dicts(self) -> List[dict]:
         """Materialize as a list of {attr: value} dicts (tests / export)."""
@@ -170,4 +182,13 @@ class FeatureTable:
                 cols[attr.name] = StringColumn.encode(values)
             else:
                 cols[attr.name] = np.concatenate(parts)
-        return FeatureTable(sft, fids, cols)
+        vis = None
+        if any(t.visibility is not None for t in tables):
+            values: List[str] = []
+            for t in tables:
+                if t.visibility is None:
+                    values.extend([""] * len(t))
+                else:
+                    values.extend(t.visibility.vocab[c] for c in t.visibility.codes)
+            vis = StringColumn.encode(values)
+        return FeatureTable(sft, fids, cols, vis)

@@ -126,6 +126,11 @@ class DeviceTable:
             cols["bin"] = jnp.asarray(bins, dtype=jnp.int32)
             cols["off"] = jnp.asarray(offs, dtype=jnp.int32)
 
+        if table.visibility is not None:
+            # dictionary codes; query-time auths shrink to an allowed-code set
+            cols["__vis__"] = jnp.asarray(table.visibility.codes[perm],
+                                          dtype=jnp.int32)
+
         for attr in table.sft.attributes:
             if attr.is_geometry:
                 continue

@@ -30,6 +30,13 @@ from geomesa_tpu.index.api import IndexScanPlan, QueryResult
 _SELECT_CAP = 1 << 16
 
 
+def _pad_pow2(arr: np.ndarray, fill: int) -> np.ndarray:
+    size = max(1, 1 << max(0, (len(arr) - 1)).bit_length())
+    out = np.full(size, fill, dtype=np.int32)
+    out[: len(arr)] = arr
+    return out
+
+
 class QueryPlanner:
     """Planner + executor for one feature type."""
 
@@ -104,14 +111,54 @@ class QueryPlanner:
         })
         return out
 
+    # -- visibility enforcement (≙ VisibilityFilter, geomesa-security) -------
+
+    def _apply_auths(self, plan: IndexScanPlan, auths) -> IndexScanPlan:
+        """Fold an auths-derived visibility mask into the plan's device
+        residual: each DISTINCT visibility expression evaluates once on the
+        host; the device tests dictionary-code membership."""
+        if auths is None or self.table.visibility is None or plan.empty:
+            return plan
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from geomesa_tpu.security.visibility import allowed_codes
+
+        vocab = self.table.visibility.vocab
+        allowed = allowed_codes(vocab, auths)
+        if len(allowed) == len(vocab):
+            return plan  # every expression visible — no mask needed
+        if len(allowed) == 0:
+            return dataclasses.replace(plan, empty=True)
+        padded = _pad_pow2(allowed, fill=-1)
+        key, params, fn = plan.residual_device or ("none", [], None)
+        i = len(params)
+
+        def fn2(cols, p, fn=fn, i=i):
+            m = jnp.any(cols["__vis__"][:, None] == p[i][None, :], axis=1)
+            return m if fn is None else (m & fn(cols, p))
+
+        return dataclasses.replace(
+            plan, residual_device=(f"vis{len(padded)}&({key})",
+                                   list(params) + [padded], fn2))
+
+    def _fid_vis_filter(self, rows: np.ndarray, auths) -> np.ndarray:
+        if auths is None or self.table.visibility is None or len(rows) == 0:
+            return rows
+        from geomesa_tpu.security.visibility import allowed_codes
+        allowed = allowed_codes(self.table.visibility.vocab, auths)
+        return rows[np.isin(self.table.visibility.codes[rows], allowed)]
+
     # -- execution ----------------------------------------------------------
 
-    def count(self, f: Union[str, ir.Filter]) -> int:
-        plan = self.plan(f)
+    def count(self, f: Union[str, ir.Filter], auths=None) -> int:
+        plan = self._apply_auths(self.plan(f), auths)
         if plan.empty:
             return 0
         if plan.primary_kind == "fid":
-            return len(self._fid_rows(plan.full_filter))
+            return len(self._fid_vis_filter(
+                self._fid_rows(plan.full_filter), auths))
         if plan.residual_host is None:
             # fully device-exact: one fused reduction, one roundtrip
             if plan.candidate_slices is not None:
@@ -121,17 +168,20 @@ class QueryPlanner:
             return plan.index.kernels.count(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
-        return len(self.select_indices(f if isinstance(f, ir.Filter) else parse_ecql(f)))
+        return len(self.select_indices(
+            f if isinstance(f, ir.Filter) else parse_ecql(f), auths=auths))
 
     def select_indices(self, f: Union[str, ir.Filter],
-                       plan: Optional[IndexScanPlan] = None) -> np.ndarray:
+                       plan: Optional[IndexScanPlan] = None,
+                       auths=None) -> np.ndarray:
         """Matching row indices (ascending) into the master table."""
         if plan is None:
             plan = self.plan(f)
+        plan = self._apply_auths(plan, auths)
         if plan.empty:
             return np.empty(0, dtype=np.int64)
         if plan.primary_kind == "fid":
-            return self._fid_rows(plan.full_filter)
+            return self._fid_vis_filter(self._fid_rows(plan.full_filter), auths)
         if plan.candidate_slices is not None:
             idx, _ = plan.index.kernels.select_at(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
@@ -145,21 +195,21 @@ class QueryPlanner:
             return np.sort(rows)
         return np.sort(self._refine(plan, rows))
 
-    def scan_mask(self, f: Union[str, ir.Filter]):
+    def scan_mask(self, f: Union[str, ir.Filter], auths=None):
         """(plan, device mask over the plan index's sorted rows) — None mask
         when the plan needs host refinement or is candidate-pruned. The mask
         stays on device for aggregation kernels to consume (≙ the shared
         AggregatingScan validate step)."""
-        plan = self.plan(f)
+        plan = self._apply_auths(self.plan(f), auths)
         if plan.empty or plan.primary_kind == "fid" or plan.residual_host is not None \
                 or plan.candidate_slices is not None or plan.index is None:
             return plan, None
         return plan, plan.index.kernels.mask(
             plan.primary_kind, plan.boxes_loose, plan.windows, plan.residual_device)
 
-    def query(self, f: Union[str, ir.Filter]) -> QueryResult:
+    def query(self, f: Union[str, ir.Filter], auths=None) -> QueryResult:
         plan = self.plan(f)
-        rows = self.select_indices(f)
+        rows = self.select_indices(f, plan=plan, auths=auths)
         return QueryResult(rows, self.table.take(rows), plan)
 
     # -- helpers ------------------------------------------------------------

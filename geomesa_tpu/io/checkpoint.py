@@ -81,6 +81,9 @@ def _save_table(table: FeatureTable, path: str) -> None:
     payload: Dict[str, np.ndarray] = {
         "__fids__": np.asarray(table.fids, dtype="U"),
     }
+    if table.visibility is not None:
+        payload["__vis__:codes"] = table.visibility.codes
+        payload["__vis__:vocab"] = np.asarray(table.visibility.vocab, dtype="U")
     for attr in table.sft.attributes:
         col = table.columns[attr.name]
         k = f"col:{attr.name}"
@@ -113,4 +116,8 @@ def _load_table(sft: SimpleFeatureType, path: str) -> FeatureTable:
         else:
             data[attr.name] = z[k]
     fids = np.asarray([str(v) for v in z["__fids__"]], dtype=object)
-    return FeatureTable.build(sft, data, fids=fids)
+    table = FeatureTable.build(sft, data, fids=fids)
+    if "__vis__:codes" in z:
+        table.visibility = StringColumn(
+            z["__vis__:codes"], [str(v) for v in z["__vis__:vocab"]])
+    return table

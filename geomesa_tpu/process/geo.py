@@ -25,9 +25,24 @@ def meters_to_degrees(m: float, lat: float) -> tuple:
 
 
 def expand_bbox(x: float, y: float, radius_m: float) -> tuple:
-    dlon, dlat = meters_to_degrees(radius_m, y)
+    dlat = radius_m / 111_320.0
+    # longitude degrees shrink toward the poles: buffer at the WIDEST
+    # latitude the box reaches, or the prefilter under-covers high latitudes
+    lat_w = min(89.0, abs(y) + dlat)
+    dlon, _ = meters_to_degrees(radius_m, lat_w)
     return (max(-180.0, x - dlon), max(-90.0, y - dlat),
             min(180.0, x + dlon), min(90.0, y + dlat))
+
+
+def buffered_envelope(xmin: float, ymin: float, xmax: float, ymax: float,
+                      radius_m: float) -> tuple:
+    """Envelope grown by ``radius_m`` on every side, with the longitude
+    buffer computed at the envelope's widest latitude."""
+    dlat = radius_m / 111_320.0
+    lat_w = min(89.0, max(abs(ymin - dlat), abs(ymax + dlat)))
+    dlon, _ = meters_to_degrees(radius_m, lat_w)
+    return (max(-180.0, xmin - dlon), max(-90.0, ymin - dlat),
+            min(180.0, xmax + dlon), min(90.0, ymax + dlat))
 
 
 def point_segment_distance_m(px, py, ax, ay, bx, by) -> np.ndarray:

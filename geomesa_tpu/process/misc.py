@@ -11,7 +11,6 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
-from geomesa_tpu.features import geometry as geo
 from geomesa_tpu.features.table import FeatureTable, StringColumn
 from geomesa_tpu.filter import ir
 from geomesa_tpu.stats.sketches import hash64
@@ -43,7 +42,7 @@ def point2point(planner, track_attr: str, f: Union[str, ir.Filter] = "INCLUDE",
         if e - s < 2:
             continue
         val = col.vocab[keys_s[s]] if isinstance(col, StringColumn) else keys_s[s].item()
-        coords = ", ".join(f"{xs[i]:g} {ys[i]:g}" for i in range(s, e))
+        coords = ", ".join(f"{xs[i]:.9g} {ys[i]:.9g}" for i in range(s, e))
         out.append((val, f"LINESTRING ({coords})", int(e - s)))
     return out
 

@@ -14,7 +14,7 @@ import numpy as np
 from geomesa_tpu.features import geometry as geo
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
-from geomesa_tpu.process.geo import (expand_bbox, haversine_m,
+from geomesa_tpu.process.geo import (buffered_envelope, haversine_m,
                                      point_segment_distance_m)
 
 
@@ -45,12 +45,9 @@ def proximity_search(planner, inputs: Union[geo.GeometryArray, Sequence[str]],
         raise ValueError("proximity requires a geometry attribute")
 
     # bbox prefilter: union of per-input buffered boxes (through the index)
-    boxes = []
     bbs = inputs.bboxes()
-    for bb in bbs:
-        gx0, gy0, _, _ = expand_bbox(bb[0], bb[1], distance_m)
-        _, _, gx1, gy1 = expand_bbox(bb[2], bb[3], distance_m)
-        boxes.append(ir.BBox(geom.name, gx0, gy0, gx1, gy1))
+    boxes = [ir.BBox(geom.name, *buffered_envelope(*bb, distance_m))
+             for bb in bbs]
     pre: ir.Filter = ir.or_filters(boxes) if len(boxes) > 1 else boxes[0]
     if f is not None and not isinstance(f, ir.Include):
         pre = ir.and_filters([f, pre])
@@ -82,6 +79,13 @@ def proximity_search(planner, inputs: Union[geo.GeometryArray, Sequence[str]],
                 px[:, None], py[:, None],
                 ax[None, :], ay[None, :], bx[None, :], by[None, :])
             keep |= (d <= distance_m).any(axis=1)
+        # distance-to-boundary misses interior points: polygon containment
+        # is distance 0 (≙ the reference's isWithinDistance semantics)
+        from geomesa_tpu.filter.geom_numpy import points_in_polygon
+        for i in range(len(extent_inputs)):
+            code = int(extent_inputs.type_codes[i])
+            if code in (geo.POLYGON, geo.MULTIPOLYGON):
+                keep |= points_in_polygon(px, py, extent_inputs.shape(i))
     return rows[keep]
 
 

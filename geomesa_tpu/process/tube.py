@@ -15,7 +15,7 @@ import numpy as np
 
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
-from geomesa_tpu.process.geo import expand_bbox, haversine_m
+from geomesa_tpu.process.geo import buffered_envelope, haversine_m
 
 
 def tube_select(planner, track: Sequence[Tuple[float, float, object]],
@@ -37,10 +37,10 @@ def tube_select(planner, track: Sequence[Tuple[float, float, object]],
     tx, ty, tt = tx[order], ty[order], tt[order]
 
     # index prefilter: track envelope buffered in space and time
-    ex0, ey0, _, _ = expand_bbox(float(tx.min()), float(ty.min()), buffer_m)
-    _, _, ex1, ey1 = expand_bbox(float(tx.max()), float(ty.max()), buffer_m)
+    env = buffered_envelope(float(tx.min()), float(ty.min()),
+                            float(tx.max()), float(ty.max()), buffer_m)
     pre: ir.Filter = ir.And((
-        ir.BBox(geom.name, ex0, ey0, ex1, ey1),
+        ir.BBox(geom.name, *env),
         ir.During(dtg.name, int(tt[0] - time_buffer_ms) - 1,
                   int(tt[-1] + time_buffer_ms) + 1),
     ))

@@ -1,0 +1,8 @@
+"""Security: visibility labels + authorizations (≙ geomesa-security)."""
+
+from geomesa_tpu.security.visibility import (AuthorizationsProvider,
+                                             VisibilityError, allowed_codes,
+                                             evaluate, parse_visibility)
+
+__all__ = ["AuthorizationsProvider", "VisibilityError", "allowed_codes",
+           "evaluate", "parse_visibility"]

@@ -98,6 +98,29 @@ def test_tube_select(world):
     assert np.array_equal(np.sort(rows), ref)
 
 
+def test_tube_high_latitude_buffer(world):
+    # lon buffer must widen at high latitude or the prefilter drops matches
+    ds = TpuDataStore()
+    ds.create_schema("hl", "dtg:Date,*geom:Point")
+    base = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    ds.load("hl", FeatureTable.build(ds.get_schema("hl"), {
+        "dtg": np.asarray([base + 3600_000]),
+        "geom": (np.asarray([-1.5]), np.asarray([60.0]))}))
+    track = [(0.0, 0.0, int(base)), (0.0, 60.0, int(base + 3600_000))]
+    rows = tube_select(ds.planner("hl"), track, buffer_m=100_000)
+    assert len(rows) == 1  # 83km away at lat 60
+
+
+def test_proximity_polygon_interior(world):
+    planner, data, _ = world
+    poly = "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))"
+    rows = proximity_search(planner, [poly], 10_000.0)
+    inside = ((data["x"] > 0) & (data["x"] < 10)
+              & (data["y"] > 0) & (data["y"] < 10))
+    # every strictly-interior feature is within distance 0 of the polygon
+    assert np.all(np.isin(np.nonzero(inside)[0], rows))
+
+
 def test_point2point(world):
     planner, data, _ = world
     lines = point2point(planner, "track", "v < 5")

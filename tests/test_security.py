@@ -1,0 +1,146 @@
+"""Security tests: visibility expression parsing/evaluation and device-mask
+enforcement through the full query stack (SURVEY.md §2.11 geomesa-security
+parity)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.security import (VisibilityError, allowed_codes, evaluate,
+                                  parse_visibility)
+
+
+# -- evaluator ---------------------------------------------------------------
+
+
+def test_empty_visible_to_all():
+    assert evaluate("", [])
+    assert evaluate("", ["x"])
+
+
+def test_single_label():
+    assert evaluate("admin", ["admin", "user"])
+    assert not evaluate("admin", ["user"])
+
+
+def test_and_or():
+    assert evaluate("admin&ops", ["admin", "ops"])
+    assert not evaluate("admin&ops", ["admin"])
+    assert evaluate("admin|ops", ["ops"])
+    assert not evaluate("admin|ops", ["user"])
+
+
+def test_nested_parens():
+    expr = "admin&(user|ops)"
+    assert evaluate(expr, ["admin", "ops"])
+    assert evaluate(expr, ["admin", "user"])
+    assert not evaluate(expr, ["admin"])
+    assert not evaluate(expr, ["user", "ops"])
+
+
+def test_quoted_labels():
+    assert evaluate('"a b"&x', ["a b", "x"])
+    assert not evaluate('"a b"&x', ["x"])
+
+
+def test_mixed_ops_need_parens():
+    with pytest.raises(VisibilityError, match="parentheses"):
+        parse_visibility("a&b|c")
+    with pytest.raises(VisibilityError):
+        parse_visibility("a&(b")
+    with pytest.raises(VisibilityError):
+        parse_visibility("&a")
+
+
+def test_allowed_codes():
+    vocab = ["", "admin", "admin&ops", "user|ops"]
+    assert allowed_codes(vocab, ["admin"]).tolist() == [0, 1]
+    assert allowed_codes(vocab, ["admin", "ops"]).tolist() == [0, 1, 2, 3]
+    assert allowed_codes(vocab, []).tolist() == [0]
+
+
+# -- end-to-end enforcement --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = TpuDataStore()
+    ds.create_schema("sec", "name:String,v:Int,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(6)
+    n = 3000
+    base = np.datetime64("2024-01-01", "ms").astype(np.int64)
+    vis = rng.choice(["", "admin", "admin&ops", "user|ops"], n,
+                     p=[0.4, 0.3, 0.2, 0.1])
+    table = FeatureTable.build(ds.get_schema("sec"), {
+        "name": rng.choice(["a", "b"], n).astype(object),
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 86400000, n),
+        "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n))},
+        visibilities=vis)
+    ds.load("sec", table)
+    return ds, vis
+
+
+def _visible(vis, auths):
+    return np.asarray([evaluate(v, auths) for v in vis])
+
+
+def test_no_auths_sees_everything(store):
+    ds, vis = store
+    assert ds.count("sec") == len(vis)  # auths=None -> security off
+
+
+def test_empty_auths_sees_public_only(store):
+    ds, vis = store
+    assert ds.count("sec", auths=[]) == int(np.sum(vis == ""))
+
+
+@pytest.mark.parametrize("auths", [["admin"], ["ops"], ["user"],
+                                   ["admin", "ops"], ["user", "admin"]])
+def test_count_respects_auths(store, auths):
+    ds, vis = store
+    assert ds.count("sec", auths=auths) == int(_visible(vis, auths).sum())
+
+
+def test_filtered_query_respects_auths(store):
+    ds, vis = store
+    res = ds.query("sec", "v < 50 AND BBOX(geom, -20, -20, 20, 20)",
+                   auths=["admin"])
+    t = ds.tables["sec"]
+    x, y = t.geometry().point_xy()
+    ref = (_visible(vis, ["admin"]) & (np.asarray(t.columns["v"]) < 50)
+           & (x >= -20) & (x <= 20) & (y >= -20) & (y <= 20))
+    assert res.count == int(ref.sum())
+    assert np.array_equal(res.indices, np.nonzero(ref)[0])
+
+
+def test_writer_vis_roundtrip():
+    ds = TpuDataStore()
+    ds.create_schema("w", "v:Int,*geom:Point")
+    with ds.get_writer("w") as w:
+        w.write(v=1, geom=(0.0, 0.0))                    # public
+        w.write(v=2, geom=(1.0, 1.0), vis="secret")
+    assert ds.count("w") == 2
+    assert ds.count("w", auths=[]) == 1
+    assert ds.count("w", auths=["secret"]) == 2
+
+
+def test_checkpoint_preserves_visibility(store, tmp_path):
+    from geomesa_tpu.io import load_store, save_store
+    ds, vis = store
+    p = str(tmp_path / "sec")
+    save_store(ds, p)
+    back = load_store(p)
+    assert back.count("sec", auths=["admin"]) == ds.count("sec", auths=["admin"])
+
+
+def test_fid_query_respects_auths(store):
+    ds, vis = store
+    t = ds.tables["sec"]
+    secret_fid = str(t.fids[np.nonzero(vis == "admin&ops")[0][0]])
+    from geomesa_tpu.filter import ir
+    assert ds.count("sec", ir.FidFilter((secret_fid,))) == 1
+    assert ds.count("sec", ir.FidFilter((secret_fid,)), auths=["admin"]) == 0
+    assert ds.count("sec", ir.FidFilter((secret_fid,)),
+                    auths=["admin", "ops"]) == 1
