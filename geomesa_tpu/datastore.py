@@ -96,6 +96,15 @@ class TpuDataStore:
         self.planners: Dict[str, QueryPlanner] = {}
         self._stats: Dict[str, object] = {}
         self._counters: Dict[str, int] = {}
+        self._interceptors: Dict[str, list] = {}
+        # audit trail (≙ AuditWriter): params {"audit": True | "path.jsonl"}
+        audit_param = self.params.get("audit")
+        if audit_param:
+            from geomesa_tpu.index.guards import AuditWriter
+            self.audit = AuditWriter(
+                audit_param if isinstance(audit_param, str) else None)
+        else:
+            self.audit = None
 
     # -- factory SPI --------------------------------------------------------
 
@@ -169,7 +178,12 @@ class TpuDataStore:
             indexes.append(AttributeIndex(sft, table, attr))
         indexes.append(FullScanIndex(sft, table))
         stats = self._stats.get(type_name) or GeoMesaStats(sft)
-        planner = QueryPlanner(sft, table, indexes, stats=stats)
+        timeout = sft.user_data.get("geomesa.query.timeout")
+        planner = QueryPlanner(
+            sft, table, indexes, stats=stats,
+            interceptors=self._interceptors.setdefault(type_name, []),
+            audit=self.audit,
+            timeout_ms=float(timeout) if timeout else None)
         stats.planner = planner
         if stats_cached is not None:
             stats.cached = stats_cached  # checkpoint restore
@@ -246,6 +260,11 @@ class TpuDataStore:
         """Per-type stats API (≙ GeoMesaDataStore.stats)."""
         self.planner(type_name)  # materialize
         return self._stats[type_name]
+
+    def add_interceptor(self, type_name: str, interceptor) -> None:
+        """Attach a query interceptor/guard (≙ the geomesa.query.interceptors
+        SPI registration)."""
+        self._interceptors.setdefault(type_name, []).append(interceptor)
 
     # -- deletes ------------------------------------------------------------
 

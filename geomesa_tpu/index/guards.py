@@ -1,0 +1,179 @@
+"""Query interceptors, guards, audit, timeouts.
+
+≙ reference planning/QueryInterceptor.scala:28 (SPI hooks that rewrite or
+veto queries), guard/GraduatedQueryGuard.scala + TemporalQueryGuard,
+QueryProperties.BlockFullTableScans (conf/QueryProperties.scala:40), the
+audit trail (audit/QueryEvent.scala:13 via AuditWriter), and the
+ThreadManagement QueryKiller (index/utils/ThreadManagement.scala:28).
+
+Timeout semantics: XLA dispatches are uninterruptible, so the deadline is
+checked between pipeline stages (plan → scan → refine) — the same guarantee
+level as the reference's cooperative QueryKiller, which also only interrupts
+between iterator batches.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
+
+
+class QueryGuardError(Exception):
+    """A guard vetoed the query (≙ the IllegalArgumentException the
+    reference guards raise)."""
+
+
+class QueryTimeout(Exception):
+    """Deadline exceeded (≙ ThreadManagement.QueryKiller cancellation)."""
+
+
+class QueryInterceptor:
+    """Rewrite and/or veto hook (≙ QueryInterceptor SPI)."""
+
+    def rewrite(self, f: ir.Filter, sft) -> ir.Filter:
+        return f
+
+    def guard(self, plan, f: ir.Filter, sft) -> Optional[str]:
+        """Return an error message to veto, None to allow."""
+        return None
+
+
+class FullTableScanGuard(QueryInterceptor):
+    """Block filtered queries that degenerate to a full-table scan
+    (≙ geomesa.scan.block-full-table)."""
+
+    def guard(self, plan, f, sft):
+        if isinstance(f, ir.Include):
+            return None  # explicit full reads are allowed, as in the reference
+        if plan.empty or plan.candidate_slices is not None:
+            return None
+        if plan.primary_kind == "none" and plan.windows is None:
+            return ("Query would require a full-table scan "
+                    "(no index-serviceable predicate); add a spatial, "
+                    "temporal, or indexed-attribute constraint")
+        return None
+
+
+class TemporalQueryGuard(QueryInterceptor):
+    """Require a bounded temporal filter under ``max_duration_ms``
+    (≙ guard/TemporalQueryGuard)."""
+
+    def __init__(self, max_duration_ms: int):
+        self.max_duration_ms = int(max_duration_ms)
+
+    def guard(self, plan, f, sft):
+        dtg = sft.dtg_attribute
+        if dtg is None or plan.empty:
+            return None
+        iv = extract_intervals(f, dtg.name)
+        if iv is None or iv.unconstrained or not len(iv.intervals):
+            return f"Query requires a temporal filter on {dtg.name!r}"
+        span = max(int(hi) - int(lo) for lo, hi in iv.intervals)
+        if span > self.max_duration_ms:
+            return (f"Temporal filter spans {span}ms, over the "
+                    f"{self.max_duration_ms}ms limit")
+        return None
+
+
+@dataclass
+class SizeAndDuration:
+    """One graduated limit: queries within ``area_deg2`` may span up to
+    ``duration_ms`` (≙ GraduatedQueryGuard.SizeAndDuration)."""
+    area_deg2: float
+    duration_ms: int
+
+
+class GraduatedQueryGuard(QueryInterceptor):
+    """Smaller spatial extent ⇒ longer allowed duration (≙
+    guard/GraduatedQueryGuard.scala). Limits sorted by area ascending; the
+    first limit whose area covers the query applies; the final limit may use
+    area=inf as the catch-all."""
+
+    def __init__(self, limits: Sequence[SizeAndDuration]):
+        self.limits = sorted(limits, key=lambda l: l.area_deg2)
+
+    def guard(self, plan, f, sft):
+        geom = sft.geometry_attribute
+        dtg = sft.dtg_attribute
+        if geom is None or plan.empty:
+            return None
+        ext = extract_bboxes(f, geom.name)
+        area = 360.0 * 180.0 if ext.unconstrained else sum(
+            max(0.0, (x1 - x0)) * max(0.0, (y1 - y0))
+            for x0, y0, x1, y1 in ext.boxes)
+        limit = next((l for l in self.limits if area <= l.area_deg2), None)
+        if limit is None:
+            return (f"Query area {area:.1f}deg2 exceeds the largest "
+                    f"configured limit")
+        if dtg is None:
+            return None
+        iv = extract_intervals(f, dtg.name)
+        if iv is None or iv.unconstrained or not len(iv.intervals):
+            span = None
+        else:
+            span = max(int(hi) - int(lo) for lo, hi in iv.intervals)
+        if span is None or span > limit.duration_ms:
+            return (f"Queries covering {area:.1f}deg2 must include a "
+                    f"temporal filter of at most {limit.duration_ms}ms")
+        return None
+
+
+# -- audit (≙ audit/QueryEvent + AuditWriter) --------------------------------
+
+
+@dataclass
+class QueryEvent:
+    type_name: str
+    filter: str
+    user: str = ""
+    ts_ms: int = 0
+    plan_time_ms: float = 0.0
+    scan_time_ms: float = 0.0
+    hits: int = 0
+    index: str = ""
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+
+class AuditWriter:
+    """In-memory audit trail with optional JSONL sink (≙ AuditLogger /
+    the Accumulo ``_queries`` table)."""
+
+    def __init__(self, path: Optional[str] = None, keep: int = 1000):
+        self.path = path
+        self.keep = keep
+        self.events: List[QueryEvent] = []
+
+    def write(self, event: QueryEvent) -> None:
+        self.events.append(event)
+        if len(self.events) > self.keep:
+            self.events = self.events[-self.keep:]
+        if self.path:
+            with open(self.path, "a") as fh:
+                fh.write(json.dumps(event.to_dict()) + "\n")
+
+
+# -- deadline ----------------------------------------------------------------
+
+
+class Deadline:
+    """Cooperative deadline checked between pipeline stages."""
+
+    def __init__(self, timeout_ms: Optional[float]):
+        self.t0 = time.perf_counter()
+        self.timeout_ms = timeout_ms
+
+    def check(self, stage: str) -> None:
+        if self.timeout_ms is None:
+            return
+        elapsed = (time.perf_counter() - self.t0) * 1000
+        if elapsed > self.timeout_ms:
+            raise QueryTimeout(
+                f"Query exceeded {self.timeout_ms}ms at stage {stage!r} "
+                f"({elapsed:.0f}ms elapsed)")

@@ -16,6 +16,7 @@ boundary band re-evaluates in f64 on the host.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Union
 
@@ -41,11 +42,15 @@ class QueryPlanner:
     """Planner + executor for one feature type."""
 
     def __init__(self, sft, table: FeatureTable, indexes: List[object],
-                 stats=None):
+                 stats=None, interceptors: Optional[list] = None,
+                 audit=None, timeout_ms: Optional[float] = None):
         self.sft = sft
         self.table = table
         self.indexes = indexes
         self.stats = stats  # GeoMesaStats for cost-based strategy selection
+        self.interceptors = interceptors if interceptors is not None else []
+        self.audit = audit              # AuditWriter | None
+        self.timeout_ms = timeout_ms    # cooperative deadline (guards.Deadline)
         self._fid_map: Optional[Dict[str, int]] = None
 
     # -- fid lookup (≙ IdIndex direct row lookup) ---------------------------
@@ -61,6 +66,8 @@ class QueryPlanner:
     def plan(self, f: Union[str, ir.Filter]) -> IndexScanPlan:
         if isinstance(f, str):
             f = parse_ecql(f)
+        for ic in self.interceptors:
+            f = ic.rewrite(f, self.sft)  # ≙ QueryInterceptor.rewrite
         if isinstance(f, ir.FidFilter):
             return IndexScanPlan(None, "fid", full_filter=f, cost=0.5,
                                  explain={"index": "id", "fids": f.fids})
@@ -94,8 +101,15 @@ class QueryPlanner:
                         sel *= s
                 return (sel * n, p.cost)
 
-            return min(plans, key=priced)
-        return min(plans, key=lambda p: p.cost)
+            chosen = min(plans, key=priced)
+        else:
+            chosen = min(plans, key=lambda p: p.cost)
+        for ic in self.interceptors:   # ≙ query guards veto (QueryPlanner:148)
+            msg = ic.guard(chosen, f, self.sft)
+            if msg:
+                from geomesa_tpu.index.guards import QueryGuardError
+                raise QueryGuardError(msg)
+        return chosen
 
     def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:
         """Hierarchical plan description (≙ Explainer / CLI explain)."""
@@ -117,8 +131,10 @@ class QueryPlanner:
         """Fold an auths-derived visibility mask into the plan's device
         residual: each DISTINCT visibility expression evaluates once on the
         host; the device tests dictionary-code membership."""
-        if auths is None or self.table.visibility is None or plan.empty:
+        if auths is None or self.table.visibility is None or plan.empty \
+                or plan.explain.get("__vis_applied__"):
             return plan
+        plan.explain["__vis_applied__"] = True
         import dataclasses
 
         import jax.numpy as jnp
@@ -152,8 +168,31 @@ class QueryPlanner:
 
     # -- execution ----------------------------------------------------------
 
+    def _write_audit(self, plan, f, plan_ms: float, scan_ms: float,
+                     hits: int) -> None:
+        if self.audit is None:
+            return
+        from geomesa_tpu.index.guards import QueryEvent
+        self.audit.write(QueryEvent(
+            type_name=self.sft.name, filter=str(f),
+            ts_ms=int(time.time() * 1000), plan_time_ms=round(plan_ms, 3),
+            scan_time_ms=round(scan_ms, 3), hits=hits,
+            index=str(plan.explain.get("index", ""))))
+
     def count(self, f: Union[str, ir.Filter], auths=None) -> int:
+        from geomesa_tpu.index.guards import Deadline
+        dl = Deadline(self.timeout_ms)
+        t0 = time.perf_counter()
         plan = self._apply_auths(self.plan(f), auths)
+        plan_ms = (time.perf_counter() - t0) * 1000
+        dl.check("plan")
+        t1 = time.perf_counter()
+        n = self._count(plan, f, auths)
+        dl.check("scan")
+        self._write_audit(plan, f, plan_ms, (time.perf_counter() - t1) * 1000, n)
+        return n
+
+    def _count(self, plan: IndexScanPlan, f, auths) -> int:
         if plan.empty:
             return 0
         if plan.primary_kind == "fid":
@@ -169,7 +208,8 @@ class QueryPlanner:
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
         return len(self.select_indices(
-            f if isinstance(f, ir.Filter) else parse_ecql(f), auths=auths))
+            f if isinstance(f, ir.Filter) else parse_ecql(f),
+            plan=plan, auths=auths))
 
     def select_indices(self, f: Union[str, ir.Filter],
                        plan: Optional[IndexScanPlan] = None,
@@ -208,8 +248,17 @@ class QueryPlanner:
             plan.primary_kind, plan.boxes_loose, plan.windows, plan.residual_device)
 
     def query(self, f: Union[str, ir.Filter], auths=None) -> QueryResult:
+        from geomesa_tpu.index.guards import Deadline
+        dl = Deadline(self.timeout_ms)
+        t0 = time.perf_counter()
         plan = self.plan(f)
+        plan_ms = (time.perf_counter() - t0) * 1000
+        dl.check("plan")
+        t1 = time.perf_counter()
         rows = self.select_indices(f, plan=plan, auths=auths)
+        dl.check("scan")
+        self._write_audit(plan, f, plan_ms, (time.perf_counter() - t1) * 1000,
+                          len(rows))
         return QueryResult(rows, self.table.take(rows), plan)
 
     # -- helpers ------------------------------------------------------------

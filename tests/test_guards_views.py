@@ -1,0 +1,153 @@
+"""Guards, audit, timeouts, merged/routed views (SURVEY.md §2.4 view pkg +
+§5 failure-detection parity)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.index.guards import (FullTableScanGuard, GraduatedQueryGuard,
+                                      QueryGuardError, QueryTimeout,
+                                      SizeAndDuration, TemporalQueryGuard)
+from geomesa_tpu.views import (MergedDataStoreView, RoutedDataStoreView,
+                               RouteSelectorByAttribute)
+
+SPEC = "name:String,v:Int,dtg:Date,*geom:Point"
+BASE = np.datetime64("2024-01-01", "ms").astype(np.int64)
+
+
+def _store(n=2000, seed=0, fid_prefix="f"):
+    ds = TpuDataStore()
+    ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(seed)
+    ds.load("t", FeatureTable.build(ds.get_schema("t"), {
+        "name": rng.choice(["a", "b"], n).astype(object),
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": BASE + rng.integers(0, 7 * 86400000, n),
+        "geom": (rng.uniform(-60, 60, n), rng.uniform(-60, 60, n))},
+        fids=[f"{fid_prefix}{i}" for i in range(n)]))
+    return ds
+
+
+# -- guards ------------------------------------------------------------------
+
+
+def test_full_table_scan_guard():
+    ds = _store()
+    ds.add_interceptor("t", FullTableScanGuard())
+    assert ds.count("t") == 2000  # INCLUDE stays allowed
+    assert ds.count("t", "BBOX(geom, 0, 0, 10, 10)") > 0
+    with pytest.raises(QueryGuardError, match="full-table"):
+        ds.count("t", "name = 'a'")  # name is not indexed
+
+
+def test_temporal_guard():
+    ds = _store()
+    ds.add_interceptor("t", TemporalQueryGuard(max_duration_ms=2 * 86400000))
+    ok = ("BBOX(geom, 0, 0, 10, 10) AND "
+          "dtg DURING 2024-01-01T00:00:00Z/2024-01-02T00:00:00Z")
+    assert ds.count("t", ok) >= 0
+    with pytest.raises(QueryGuardError, match="temporal"):
+        ds.count("t", "BBOX(geom, 0, 0, 10, 10)")
+    with pytest.raises(QueryGuardError, match="limit"):
+        ds.count("t", "BBOX(geom, 0, 0, 10, 10) AND "
+                      "dtg DURING 2024-01-01T00:00:00Z/2024-01-06T00:00:00Z")
+
+
+def test_graduated_guard():
+    ds = _store()
+    ds.add_interceptor("t", GraduatedQueryGuard([
+        SizeAndDuration(100.0, 7 * 86400000),       # small area: a week
+        SizeAndDuration(float("inf"), 86400000),    # anything: one day
+    ]))
+    # small box, long window: allowed
+    assert ds.count("t", "BBOX(geom, 0, 0, 5, 5) AND "
+                         "dtg DURING 2024-01-01T00:00:00Z/2024-01-06T00:00:00Z") >= 0
+    # huge box, long window: vetoed
+    with pytest.raises(QueryGuardError):
+        ds.count("t", "BBOX(geom, -50, -50, 50, 50) AND "
+                      "dtg DURING 2024-01-01T00:00:00Z/2024-01-06T00:00:00Z")
+    # huge box, short window: allowed
+    assert ds.count("t", "BBOX(geom, -50, -50, 50, 50) AND "
+                         "dtg DURING 2024-01-01T00:00:00Z/2024-01-01T12:00:00Z") >= 0
+
+
+def test_guard_only_on_this_type():
+    ds = _store()
+    ds.create_schema("open", "v:Int,*geom:Point")
+    ds.load("open", FeatureTable.build(ds.get_schema("open"),
+                                       {"v": [1], "geom": ([0.0], [0.0])}))
+    ds.add_interceptor("t", FullTableScanGuard())
+    assert ds.count("open", "v = 1") == 1  # other type unaffected
+
+
+# -- audit -------------------------------------------------------------------
+
+
+def test_audit_trail(tmp_path):
+    path = str(tmp_path / "audit.jsonl")
+    ds = TpuDataStore({"audit": path})
+    ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(1)
+    ds.load("t", FeatureTable.build(ds.get_schema("t"), {
+        "name": ["a", "b"], "v": [1, 2],
+        "dtg": [int(BASE), int(BASE)], "geom": ([0.0, 1.0], [0.0, 1.0])}))
+    ds.count("t", "v = 1")
+    ds.query("t", "BBOX(geom, -1, -1, 2, 2)")
+    events = ds.audit.events
+    assert len(events) == 2
+    assert events[0].hits == 1 and events[0].type_name == "t"
+    assert events[1].hits == 2
+    assert events[0].plan_time_ms >= 0 and events[0].scan_time_ms >= 0
+    import json
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2 and lines[0]["filter"]
+
+
+# -- timeout -----------------------------------------------------------------
+
+
+def test_query_timeout():
+    ds = TpuDataStore()
+    ds.create_schema("t", SPEC + ";geomesa.query.timeout=0.000001")
+    rng = np.random.default_rng(2)
+    ds.load("t", FeatureTable.build(ds.get_schema("t"), {
+        "name": ["a"] * 10, "v": list(range(10)),
+        "dtg": [int(BASE)] * 10, "geom": ([0.0] * 10, [0.0] * 10)}))
+    with pytest.raises(QueryTimeout):
+        ds.count("t", "v < 5")
+
+
+# -- views -------------------------------------------------------------------
+
+
+def test_merged_view():
+    a, b = _store(1000, seed=3, fid_prefix="a"), _store(500, seed=4, fid_prefix="b")
+    view = MergedDataStoreView([a, b], "t")
+    q = "BBOX(geom, -30, -30, 30, 30) AND v < 50"
+    assert view.count(q) == a.count("t", q) + b.count("t", q)
+    t = view.query(q)
+    assert len(t) == view.count(q)
+
+
+def test_merged_view_schema_mismatch():
+    a = _store(10)
+    b = TpuDataStore()
+    b.create_schema("t", "other:Int,*geom:Point")
+    with pytest.raises(ValueError, match="disagree"):
+        MergedDataStoreView([a, b], "t")
+
+
+def test_routed_view():
+    recent, historic = _store(1000, seed=5), _store(1000, seed=6)
+    sel = RouteSelectorByAttribute(
+        [(0, {"dtg", "geom"}), (1, {"name", "v"})], default=0)
+    view = RoutedDataStoreView([recent, historic], "t", sel)
+    # spatial+temporal -> store 0
+    q1 = "BBOX(geom, 0, 0, 20, 20)"
+    assert view.count(q1) == recent.count("t", q1)
+    # attribute-only -> store 1
+    assert view.count("v = 7") == historic.count("t", "v = 7")
+    # mixed (not covered by any route) -> default store 0
+    q3 = "v = 7 AND BBOX(geom, 0, 0, 20, 20)"
+    assert view.count(q3) == recent.count("t", q3)
