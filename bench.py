@@ -1,92 +1,258 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Headline (BASELINE.md config 1): GDELT-like point corpus, Z3 spatio-temporal
-bbox+time query, p50 latency on the available accelerator, vs the brute-force
-vectorized-numpy in-memory CPU store (the moral equivalent of the reference's
-GeoCQEngine in-memory datastore, BASELINE.json configs[0]).
+Covers the five BASELINE.md configs:
 
-Scale via GEOMESA_TPU_BENCH_N (default 20M points; the 100M headline target
-fits a v5e chip's HBM — raise the env var on real hardware).
+  0. CPU reference (GeoCQEngine moral slot): vectorized-numpy in-memory bbox
+     filter over 1M points (single core on this host — core count reported).
+  1. Z3 index (headline): GDELT-like corpus (default 100M pts), bbox+time
+     count. Reports blocking p50 (includes one device->host round trip —
+     ~100ms through the axon tunnel, sub-ms on a locally attached chip),
+     pipelined per-query latency (N async dispatches, one readback — the
+     sustained-throughput number), index build time, and effective HBM
+     bandwidth of the scan kernel.
+  2. XZ2 index: st_intersects polygon query over small linestring extents
+     (device envelope prefilter + exact host refine), p50.
+  3. Spatial join: point-in-polygon counts, points/sec/chip.
+  4. Density (512x512 scatter-add) + KNN process latency.
+
+Headline metric = config 1 blocking p50. ``vs_baseline`` = CPU time of the
+identical 100M-pt query on this host / headline p50.
+
+Scale via GEOMESA_TPU_BENCH_N (default 100M). Subset configs via
+GEOMESA_TPU_BENCH_CONFIGS, e.g. "1,3".
 """
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _p50(samples) -> float:
+    return float(np.median(np.asarray(samples) * 1000))
+
+
+def _time_reps(fn, reps: int):
+    lat = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        lat.append(time.perf_counter() - t0)
+    return lat
+
 
 def main() -> None:
     import jax
+    import jax.numpy as jnp
+
+    try:  # persistent compile cache: repeated bench runs skip XLA compiles
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     from geomesa_tpu.features.sft import SimpleFeatureType
     from geomesa_tpu.features.table import FeatureTable
     from geomesa_tpu.index.planner import QueryPlanner
-    from geomesa_tpu.index.spatial import Z3Index
+    from geomesa_tpu.index.spatial import XZ2Index, Z3Index
 
-    n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 20_000_000))
+    n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
+    configs = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS", "0,1,2,3,4").split(","))
     rng = np.random.default_rng(1234)
+    detail: dict = {"n_points": n, "device": str(jax.devices()[0]),
+                    "host_cores": os.cpu_count()}
 
     # GDELT-like synthetic corpus: clustered lon/lat over 30 days
+    t0 = time.perf_counter()
     centers = rng.uniform([-120, -40], [140, 60], size=(64, 2))
     which = rng.integers(0, 64, n)
     x = np.clip(centers[which, 0] + rng.normal(0, 8, n), -180, 180)
     y = np.clip(centers[which, 1] + rng.normal(0, 6, n), -90, 90)
     base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
     dtg = base + rng.integers(0, 30 * 86400000, n)
+    detail["gen_s"] = round(time.perf_counter() - t0, 2)
 
-    sft = SimpleFeatureType.from_spec(
-        "gdelt", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
-    table = FeatureTable.build(sft, {"dtg": dtg, "geom": (x, y)})
-
-    t0 = time.perf_counter()
-    idx = Z3Index(sft, table)
-    planner = QueryPlanner(sft, table, [idx])
-    build_s = time.perf_counter() - t0
-
-    ecql = ("BBOX(geom, -10, 30, 30, 55) AND "
-            "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
-
-    # warmup (compile)
-    count = planner.count(ecql)
-    jax.block_until_ready(next(iter(idx.device.columns.values())))
-
-    lat = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        planner.count(ecql)
-        lat.append(time.perf_counter() - t0)
-    p50_ms = float(np.median(lat) * 1000)
-
-    # CPU in-memory baseline: vectorized numpy mask (GeoCQEngine moral slot)
+    qx0, qy0, qx1, qy1 = -10.0, 30.0, 30.0, 55.0
     lo = np.datetime64("2020-01-05", "ms").astype(np.int64)
     hi = np.datetime64("2020-01-12", "ms").astype(np.int64)
-    cpu = []
-    for _ in range(max(3, reps // 4)):
+
+    def cpu_query(xs, ys, ts):
+        return int(np.sum((xs >= qx0) & (xs <= qx1) & (ys >= qy0) & (ys <= qy1)
+                          & (ts > lo) & (ts < hi)))
+
+    # ---- config 0: CPU in-memory reference (GeoCQEngine slot), 1M bbox ----
+    if "0" in configs:
+        m = min(1_000_000, n)
+        xs, ys = x[:m], y[:m]
+        lat = _time_reps(
+            lambda: int(np.sum((xs >= qx0) & (xs <= qx1)
+                               & (ys >= qy0) & (ys <= qy1))), max(5, reps))
+        detail["cfg0_cpu_1m_bbox_p50_ms"] = round(_p50(lat), 3)
+
+    headline_p50 = None
+    vs_baseline = None
+
+    # ---- config 1: Z3 bbox+time over the full corpus (headline) ----------
+    if "1" in configs:
+        sft = SimpleFeatureType.from_spec(
+            "gdelt", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
         t0 = time.perf_counter()
-        ref = int(np.sum((x >= -10) & (x <= 30) & (y >= 30) & (y <= 55)
-                         & (dtg > lo) & (dtg < hi)))
-        cpu.append(time.perf_counter() - t0)
-    cpu_ms = float(np.median(cpu) * 1000)
+        table = FeatureTable.build(sft, {"dtg": dtg, "geom": (x, y)})
+        t_table = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        idx = Z3Index(sft, table)
+        jax.block_until_ready(idx.device.columns["xi"])
+        t_index = time.perf_counter() - t0
+        planner = QueryPlanner(sft, table, [idx])
+        detail["cfg1_table_build_s"] = round(t_table, 2)
+        detail["cfg1_index_build_s"] = round(t_index, 2)
 
-    assert count == ref, f"bench correctness check failed: {count} != {ref}"
+        ecql = (f"BBOX(geom, {qx0}, {qy0}, {qx1}, {qy1}) AND "
+                "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+        t0 = time.perf_counter()
+        pq = planner.prepare(ecql)
+        detail["cfg1_plan_stage_ms"] = round((time.perf_counter() - t0) * 1000, 2)
 
-    print(json.dumps({
-        "metric": "z3_bbox_time_count_p50_latency",
-        "value": round(p50_ms, 3),
+        count = pq.count()  # warmup: compiles the fused scan
+        # blocking p50: dispatch + device scan + result readback per query
+        lat = _time_reps(pq.count, reps)
+        headline_p50 = _p50(lat)
+
+        # pipelined: K async dispatches, one stacked readback — amortizes the
+        # host<->device RTT; per-query time == sustained device throughput
+        k = 64
+
+        def pipeline():
+            outs = [pq.count_async() for _ in range(k)]
+            return np.asarray(jnp.stack(outs))
+
+        pipeline()  # warm the stacked-readback program
+        t0 = time.perf_counter()
+        total = pipeline()
+        wall = time.perf_counter() - t0
+        assert int(total[0]) == count
+        per_query_ms = wall * 1000 / k
+        detail["cfg1_pipelined_per_query_ms"] = round(per_query_ms, 3)
+        detail["cfg1_pipelined_qps"] = round(k / wall, 1)
+        # scan traffic: xi/xl/yi/yl/bin/off int32 per row
+        bytes_scanned = n * 6 * 4
+        detail["cfg1_scan_gb_per_s"] = round(
+            bytes_scanned / (per_query_ms / 1000) / 1e9, 1)
+
+        # CPU the same query over the identical corpus (vs_baseline)
+        cpu_lat = _time_reps(lambda: cpu_query(x, y, dtg), max(3, reps // 4))
+        cpu_ms = _p50(cpu_lat)
+        ref = cpu_query(x, y, dtg)
+        assert count == ref, f"correctness check failed: {count} != {ref}"
+        detail["cfg1_cpu_numpy_ms"] = round(cpu_ms, 1)
+        detail["cfg1_matched"] = count
+        detail["cfg1_blocking_p50_note"] = (
+            "blocking p50 includes one device->host readback round trip; "
+            "through the axon RPC tunnel that RTT is ~100ms (pipelined "
+            "number shows the device-side cost)")
+        vs_baseline = round(cpu_ms / headline_p50, 2)
+
+        del pq
+        gc.collect()
+
+    # ---- config 2: XZ2 st_intersects over linestring extents -------------
+    if "2" in configs:
+        n2 = max(100_000, min(n // 20, 5_000_000))
+        sft2 = SimpleFeatureType.from_spec("osm", "*geom:LineString")
+        lx = rng.uniform(-175, 170, n2)
+        ly = rng.uniform(-85, 80, n2)
+        dx = rng.uniform(0.01, 2.0, n2)
+        dy = rng.uniform(0.01, 2.0, n2)
+        from geomesa_tpu.features.geometry import GeometryArray, LINESTRING
+        t0 = time.perf_counter()
+        shapes = [(LINESTRING, [[lx[i], ly[i]], [lx[i] + dx[i], ly[i] + dy[i]]])
+                  for i in range(n2)]
+        garr = GeometryArray.from_shapes(shapes)
+        table2 = FeatureTable.build(sft2, {"geom": garr})
+        idx2 = XZ2Index(sft2, table2)
+        jax.block_until_ready(idx2.device.columns["bxmin_i"])
+        detail["cfg2_build_s"] = round(time.perf_counter() - t0, 2)
+        detail["cfg2_n"] = n2
+        planner2 = QueryPlanner(sft2, table2, [idx2])
+        poly = ("POLYGON ((-12 30, 10 28, 14 44, -2 50, -12 30))")
+        q2 = f"INTERSECTS(geom, {poly})"
+        c2 = planner2.count(q2)  # warmup (device prefilter + host refine)
+        lat2 = _time_reps(lambda: planner2.count(q2), max(5, reps // 2))
+        detail["cfg2_xz2_intersects_p50_ms"] = round(_p50(lat2), 2)
+        detail["cfg2_matched"] = c2
+        # CPU envelope-prefilter comparator over same extents
+        bb = garr.bboxes()
+        lat2c = _time_reps(lambda: int(np.sum(
+            (bb[:, 0] <= 14) & (bb[:, 2] >= -12)
+            & (bb[:, 1] <= 50) & (bb[:, 3] >= 28))), 5)
+        detail["cfg2_cpu_envelope_ms"] = round(_p50(lat2c), 2)
+        del idx2, planner2, table2, garr
+        gc.collect()
+
+    # ---- config 3: point-in-polygon join, pts/sec/chip -------------------
+    if "3" in configs:
+        from geomesa_tpu.parallel.join import SpatialJoin
+        n3 = min(n, 20_000_000)
+        px = np.asarray(x[:n3], dtype=np.float32)
+        py = np.asarray(y[:n3], dtype=np.float32)
+        polys = []
+        for cx, cy in centers[:32]:
+            ang = np.linspace(0, 2 * np.pi, 17)[:-1]
+            r = 3.0 + 2.0 * rng.random()
+            ring = [[float(cx + r * np.cos(a)), float(cy + r * np.sin(a))]
+                    for a in ang]
+            ring.append(ring[0])
+            polys.append((3, [ring]))  # POLYGON code, single ring
+        join = SpatialJoin(polys)
+        dx_ = jnp.asarray(px)
+        dy_ = jnp.asarray(py)
+        jax.block_until_ready([dx_, dy_])
+        hits = join.counts(dx_, dy_)  # warmup + correctness smoke
+        assert int(hits.sum()) > 0
+        lat3 = _time_reps(lambda: join.counts(dx_, dy_), max(5, reps // 2))
+        j_ms = _p50(lat3)
+        detail["cfg3_join_p50_ms"] = round(j_ms, 2)
+        detail["cfg3_join_mpts_per_s_per_chip"] = round(
+            n3 / (j_ms / 1000) / 1e6, 1)
+        detail["cfg3_n_points"] = n3
+        detail["cfg3_n_polygons"] = len(polys)
+        del join, dx_, dy_
+        gc.collect()
+
+    # ---- config 4: density + KNN -----------------------------------------
+    if "4" in configs and "1" in configs:
+        from geomesa_tpu.aggregates.density import density
+        ecql = (f"BBOX(geom, {qx0}, {qy0}, {qx1}, {qy1}) AND "
+                "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+        dg = density(planner, ecql, (qx0, qy0, qx1, qy1), 512, 512)  # warmup
+        lat4 = _time_reps(
+            lambda: density(planner, ecql, (qx0, qy0, qx1, qy1), 512, 512),
+            max(5, reps // 2))
+        detail["cfg4_density_512_p50_ms"] = round(_p50(lat4), 2)
+        detail["cfg4_density_mass"] = int(dg.weights.sum())
+
+        from geomesa_tpu.process.knn import knn
+        t0 = time.perf_counter()
+        rows, dists = knn(planner, 2.0, 48.0, 10)
+        detail["cfg4_knn10_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+        detail["cfg4_knn_max_m"] = round(float(dists.max()), 1)
+
+    out = {
+        "metric": "z3_bbox_time_count_p50_latency_100m",
+        "value": round(headline_p50, 3) if headline_p50 is not None else None,
         "unit": "ms",
-        "vs_baseline": round(cpu_ms / p50_ms, 2),
-        "detail": {
-            "n_points": n,
-            "matched": count,
-            "cpu_numpy_ms": round(cpu_ms, 3),
-            "index_build_s": round(build_s, 2),
-            "device": str(jax.devices()[0]),
-        },
-    }))
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

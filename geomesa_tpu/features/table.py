@@ -45,15 +45,25 @@ class StringColumn:
 @dataclass
 class FeatureTable:
     sft: SimpleFeatureType
-    fids: np.ndarray                                # (N,) object (str)
+    # (N,) object (str) — or None for implicit sequential fids, materialized
+    # lazily via the ``fids`` property (building 100M Python strings costs
+    # ~60s; most scan paths never touch them)
+    _fids: Optional[np.ndarray]
     columns: Dict[str, object] = field(default_factory=dict)
     # columns values: np.ndarray | StringColumn | GeometryArray
     # per-feature visibility expressions, dictionary-encoded (≙ the
     # visibility the reference stores with each mutation; geomesa-security)
     visibility: Optional[StringColumn] = None
+    _n: int = 0
+
+    @property
+    def fids(self) -> np.ndarray:
+        if self._fids is None:
+            self._fids = np.array([str(i) for i in range(self._n)], dtype=object)
+        return self._fids
 
     def __len__(self) -> int:
-        return len(self.fids)
+        return self._n if self._fids is None else len(self._fids)
 
     @classmethod
     def build(
@@ -100,9 +110,7 @@ class FeatureTable:
                 raise ValueError(f"Column {attr.name} length {m} != {n}")
             columns[attr.name] = col
         n = n or 0
-        if fids is None:
-            fids = np.array([str(i) for i in range(n)], dtype=object)
-        else:
+        if fids is not None:
             fids = np.asarray(fids, dtype=object)
             if len(fids) != n:
                 raise ValueError("fids length mismatch")
@@ -111,7 +119,7 @@ class FeatureTable:
             if len(visibilities) != n:
                 raise ValueError("visibilities length mismatch")
             vis = StringColumn.encode(visibilities)
-        return cls(sft, fids, columns, vis)
+        return cls(sft, fids, columns, vis, _n=n)
 
     # -- access -------------------------------------------------------------
 
@@ -141,7 +149,7 @@ class FeatureTable:
                 cols[name] = col[idx]
         vis = StringColumn(self.visibility.codes[idx], self.visibility.vocab) \
             if self.visibility is not None else None
-        return FeatureTable(self.sft, self.fids[idx], cols, vis)
+        return FeatureTable(self.sft, self.fids[idx], cols, vis, _n=len(idx))
 
     def to_dicts(self) -> List[dict]:
         """Materialize as a list of {attr: value} dicts (tests / export)."""
@@ -191,4 +199,4 @@ class FeatureTable:
                 else:
                     values.extend(t.visibility.vocab[c] for c in t.visibility.codes)
             vis = StringColumn.encode(values)
-        return FeatureTable(sft, fids, cols, vis)
+        return FeatureTable(sft, fids, cols, vis, _n=len(fids))

@@ -70,7 +70,7 @@ class AttributeIndex(BaseSpatialIndex):
     def supports(cls, sft) -> bool:
         return bool(indexed_attributes(sft))
 
-    def _sort_permutation(self) -> np.ndarray:
+    def _sort_keys(self):
         col = self.table.columns[self.attr]
         if isinstance(col, StringColumn):
             vals = col.codes.astype(np.int64)
@@ -78,15 +78,21 @@ class AttributeIndex(BaseSpatialIndex):
         else:
             vals = np.asarray(col)
             self._vocab = None
-        # secondary tier: (bin, z3-ish) via dtg when present, else raw order
-        keys = [vals]
+        self._vals = vals
+        # secondary tier: (bin, off) via dtg when present, else raw order.
+        # Keys are major-first; value dtypes may be float, which keeps this
+        # index on the host lexsort path (the device sort needs int32 planes).
         if self.dtg is not None:
             ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
             bins, offs = time_to_binned_time(ms, self.period)
-            keys = [offs, bins, vals]  # lexsort: last key is primary
-        perm = np.lexsort(keys)
-        self._sorted_vals = vals[perm]
-        return perm
+            return [vals, bins, offs]
+        return [vals]
+
+    @property
+    def _sorted_vals(self) -> np.ndarray:
+        if getattr(self, "_sorted_vals_cache", None) is None:
+            self._sorted_vals_cache = self._vals[self.perm]
+        return self._sorted_vals_cache
 
     # -- predicate extraction ------------------------------------------------
 

@@ -84,69 +84,97 @@ class DeviceTable:
         perm: np.ndarray,
         period: Optional[TimePeriod] = None,
     ) -> "DeviceTable":
-        """Project ``table`` rows (reordered by ``perm``) onto the device.
+        """Project ``table`` rows (reordered by host ``perm``) onto the device.
 
         period: when set, the default dtg column is decomposed into exact
         (bin, off) int32 pairs for temporal predicates.
         """
-        n = len(perm)
-        cols: Dict[str, jnp.ndarray] = {}
+        planes = host_planes(table, period)
+        cols = {k: jnp.asarray(v[perm]) for k, v in planes.items()}
+        return cls(len(perm), cols)
 
-        geom_attr = table.sft.geometry_attribute
-        if geom_attr is not None:
-            garr: GeometryArray = table.columns[geom_attr.name]
-            if garr.is_points:
-                x, y = garr.point_xy()
-                x, y = x[perm], y[perm]
-                xi, xl = fp62_lon(x)
-                yi, yl = fp62_lat(y)
-                cols["xi"], cols["xl"] = jnp.asarray(xi), jnp.asarray(xl)
-                cols["yi"], cols["yl"] = jnp.asarray(yi), jnp.asarray(yl)
-                cols["xf"] = jnp.asarray(x, dtype=jnp.float32)
-                cols["yf"] = jnp.asarray(y, dtype=jnp.float32)
-            else:
-                bb = garr.bboxes()[perm]
-                cols["bxmin"] = jnp.asarray(bb[:, 0], dtype=jnp.float32)
-                cols["bymin"] = jnp.asarray(bb[:, 1], dtype=jnp.float32)
-                cols["bxmax"] = jnp.asarray(bb[:, 2], dtype=jnp.float32)
-                cols["bymax"] = jnp.asarray(bb[:, 3], dtype=jnp.float32)
-                # fp62 envelope planes: exact envelope-overlap tests on device
-                for name, vals, f in (("bxmin", bb[:, 0], fp62_lon),
-                                      ("bymin", bb[:, 1], fp62_lat),
-                                      ("bxmax", bb[:, 2], fp62_lon),
-                                      ("bymax", bb[:, 3], fp62_lat)):
-                    hi, lo = f(vals)
-                    cols[name + "_i"] = jnp.asarray(hi)
-                    cols[name + "_l"] = jnp.asarray(lo)
+    @classmethod
+    def build_on_device(
+        cls,
+        table: FeatureTable,
+        dev_perm,
+        period: Optional[TimePeriod] = None,
+        planes: Optional[Dict[str, np.ndarray]] = None,
+    ) -> "DeviceTable":
+        """Upload unsorted planes once, then apply the device-resident sort
+        permutation with one fused gather — the large-table build path that
+        keeps the O(N) reorder on the accelerator instead of the host."""
+        import jax
 
-        dtg_attr = table.sft.dtg_attribute
-        if dtg_attr is not None and period is not None:
-            ms = np.asarray(table.columns[dtg_attr.name], dtype=np.int64)[perm]
-            bins, offs = time_to_binned_time(ms, period)
-            cols["bin"] = jnp.asarray(bins, dtype=jnp.int32)
-            cols["off"] = jnp.asarray(offs, dtype=jnp.int32)
+        if planes is None:
+            planes = host_planes(table, period)
+        unsorted = {k: jnp.asarray(v) for k, v in planes.items()}
 
-        if table.visibility is not None:
-            # dictionary codes; query-time auths shrink to an allowed-code set
-            cols["__vis__"] = jnp.asarray(table.visibility.codes[perm],
-                                          dtype=jnp.int32)
+        @jax.jit
+        def gather(cols, p):
+            return {k: v[p] for k, v in cols.items()}
 
-        for attr in table.sft.attributes:
-            if attr.is_geometry:
-                continue
-            raw = table.columns[attr.name]
-            if isinstance(raw, StringColumn):
-                cols[attr.name] = jnp.asarray(raw.codes[perm], dtype=jnp.int32)
-            elif attr.type_name == "Date":
-                # seconds resolution on device; exact ms compare via (bin,off)
-                # when this is the primary dtg, else host refine
-                cols[attr.name] = jnp.asarray(
-                    np.asarray(raw, dtype=np.int64)[perm] // 1000, dtype=jnp.int32)
-            elif attr.type_name == "Long":
-                cols[attr.name] = jnp.asarray(
-                    np.asarray(raw)[perm].astype(np.float64), dtype=jnp.float32)
-            elif attr.type_name == "Double":
-                cols[attr.name] = jnp.asarray(np.asarray(raw)[perm], dtype=jnp.float32)
-            else:
-                cols[attr.name] = jnp.asarray(np.asarray(raw)[perm])
-        return cls(n, cols)
+        cols = gather(unsorted, dev_perm)
+        return cls(len(table), cols)
+
+
+def host_planes(table: FeatureTable,
+                period: Optional[TimePeriod] = None) -> Dict[str, np.ndarray]:
+    """Unsorted numpy projection of ``table`` onto the device column layout
+    (row order = table order; the caller applies the index sort)."""
+    cols: Dict[str, np.ndarray] = {}
+
+    geom_attr = table.sft.geometry_attribute
+    if geom_attr is not None:
+        garr: GeometryArray = table.columns[geom_attr.name]
+        if garr.is_points:
+            x, y = garr.point_xy()
+            xi, xl = fp62_lon(x)
+            yi, yl = fp62_lat(y)
+            cols["xi"], cols["xl"] = xi, xl
+            cols["yi"], cols["yl"] = yi, yl
+            cols["xf"] = np.asarray(x, dtype=np.float32)
+            cols["yf"] = np.asarray(y, dtype=np.float32)
+        else:
+            bb = garr.bboxes()
+            cols["bxmin"] = np.asarray(bb[:, 0], dtype=np.float32)
+            cols["bymin"] = np.asarray(bb[:, 1], dtype=np.float32)
+            cols["bxmax"] = np.asarray(bb[:, 2], dtype=np.float32)
+            cols["bymax"] = np.asarray(bb[:, 3], dtype=np.float32)
+            # fp62 envelope planes: exact envelope-overlap tests on device
+            for name, vals, f in (("bxmin", bb[:, 0], fp62_lon),
+                                  ("bymin", bb[:, 1], fp62_lat),
+                                  ("bxmax", bb[:, 2], fp62_lon),
+                                  ("bymax", bb[:, 3], fp62_lat)):
+                hi, lo = f(vals)
+                cols[name + "_i"] = hi
+                cols[name + "_l"] = lo
+
+    dtg_attr = table.sft.dtg_attribute
+    if dtg_attr is not None and period is not None:
+        ms = np.asarray(table.columns[dtg_attr.name], dtype=np.int64)
+        bins, offs = time_to_binned_time(ms, period)
+        cols["bin"] = np.asarray(bins, dtype=np.int32)
+        cols["off"] = np.asarray(offs, dtype=np.int32)
+
+    if table.visibility is not None:
+        # dictionary codes; query-time auths shrink to an allowed-code set
+        cols["__vis__"] = np.asarray(table.visibility.codes, dtype=np.int32)
+
+    for attr in table.sft.attributes:
+        if attr.is_geometry:
+            continue
+        raw = table.columns[attr.name]
+        if isinstance(raw, StringColumn):
+            cols[attr.name] = np.asarray(raw.codes, dtype=np.int32)
+        elif attr.type_name == "Date":
+            # seconds resolution on device; exact ms compare via (bin,off)
+            # when this is the primary dtg, else host refine
+            cols[attr.name] = (np.asarray(raw, dtype=np.int64) // 1000).astype(np.int32)
+        elif attr.type_name == "Long":
+            cols[attr.name] = np.asarray(raw).astype(np.float64).astype(np.float32)
+        elif attr.type_name == "Double":
+            cols[attr.name] = np.asarray(raw, dtype=np.float32)
+        else:
+            cols[attr.name] = np.asarray(raw)
+    return cols

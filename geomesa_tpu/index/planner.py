@@ -179,6 +179,16 @@ class QueryPlanner:
             scan_time_ms=round(scan_ms, 3), hits=hits,
             index=str(plan.explain.get("index", ""))))
 
+    def prepare(self, f: Union[str, ir.Filter], auths=None) -> "PreparedQuery":
+        """Plan once and stage all query constants on device; the returned
+        handle re-executes without re-parsing, re-planning, or re-uploading
+        (≙ a configured scan the reference would hand each tablet server;
+        also the natural unit for pipelined dispatch)."""
+        plan = self._apply_auths(self.plan(f), auths)
+        return PreparedQuery(self, plan,
+                             f if isinstance(f, ir.Filter) else parse_ecql(f),
+                             auths)
+
     def count(self, f: Union[str, ir.Filter], auths=None) -> int:
         from geomesa_tpu.index.guards import Deadline
         dl = Deadline(self.timeout_ms)
@@ -275,3 +285,52 @@ class QueryPlanner:
         sub = self.table.take(rows)
         mask = _evaluate(plan.residual_host, sub)
         return rows[mask]
+
+
+class PreparedQuery:
+    """A planned query with constants staged on device.
+
+    ``count_async`` dispatches without blocking (returns the device scalar),
+    so many queries pipeline over a single host↔device round trip;
+    ``count``/``select_indices`` block for the value. Falls back to the
+    planner's general execution when the plan needs host refinement,
+    candidate pruning, or fid lookup.
+    """
+
+    def __init__(self, planner: QueryPlanner, plan: IndexScanPlan,
+                 f: ir.Filter, auths):
+        self.planner = planner
+        self.plan = plan
+        self.filter = f
+        self.auths = auths
+        self._count_disp = None
+        if (not plan.empty and plan.primary_kind != "fid"
+                and plan.residual_host is None
+                and plan.candidate_slices is None and plan.index is not None):
+            self._count_disp = plan.index.kernels.prepare_count(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device)
+
+    @property
+    def device_exact(self) -> bool:
+        """True when the whole query resolves on device (no host refine)."""
+        return self._count_disp is not None
+
+    def count_async(self):
+        """Async dispatch → 0-d device array (None for empty plans)."""
+        if self._count_disp is None:
+            if self.plan.empty:
+                return None
+            raise ValueError("plan needs host execution; use count()")
+        return self._count_disp()
+
+    def count(self) -> int:
+        if self.plan.empty:
+            return 0
+        if self._count_disp is not None:
+            return int(self._count_disp())
+        return self.planner._count(self.plan, self.filter, self.auths)
+
+    def select_indices(self) -> np.ndarray:
+        return self.planner.select_indices(self.filter, plan=self.plan,
+                                           auths=self.auths)

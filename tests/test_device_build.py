@@ -1,0 +1,123 @@
+"""Device-side index build (lax.sort key planes + device gather) parity with
+the host lexsort path, and the PreparedQuery staged-execution API."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.index import spatial
+from geomesa_tpu.index.planner import QueryPlanner
+from geomesa_tpu.index.spatial import XZ2Index, Z2Index, Z3Index
+
+
+def _point_table(n=5000, seed=7):
+    rng = np.random.default_rng(seed)
+    sft = SimpleFeatureType.from_spec(
+        "t", "val:Int,dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    dtg = base + rng.integers(0, 21 * 86400000, n)
+    val = rng.integers(0, 50, n).astype(np.int32)
+    table = FeatureTable.build(sft, {"val": val, "dtg": dtg, "geom": (x, y)})
+    return sft, table, (x, y, dtg, val, base)
+
+
+ECQL = ("BBOX(geom, -60, -30, 60, 30) AND "
+        "dtg DURING 2020-01-03T00:00:00Z/2020-01-15T00:00:00Z AND val > 10")
+
+
+def _brute(x, y, dtg, val, base):
+    lo = base + 2 * 86400000
+    hi = base + 14 * 86400000
+    return ((x >= -60) & (x <= 60) & (y >= -30) & (y <= 30)
+            & (dtg > lo) & (dtg < hi) & (val > 10))
+
+
+def test_device_sort_perm_matches_lexsort():
+    rng = np.random.default_rng(3)
+    z = rng.integers(0, 1 << 62, 10000).astype(np.int64)
+    bins = rng.integers(0, 50, 10000).astype(np.int32)
+    keys = [bins] + spatial._split63(z)
+    dev = np.asarray(spatial.device_sort_perm(keys)).astype(np.int64)
+    host = np.lexsort(tuple(reversed(keys)))
+    np.testing.assert_array_equal(dev, host)
+
+
+@pytest.mark.parametrize("cls", [Z3Index, Z2Index])
+def test_device_build_query_parity(monkeypatch, cls):
+    sft, table, raw = _point_table()
+    host_idx = cls(sft, table)
+    monkeypatch.setattr(spatial, "DEVICE_SORT_MIN_ROWS", 1)
+    dev_idx = cls(sft, table)
+    np.testing.assert_array_equal(dev_idx.perm, host_idx.perm)
+    for k in host_idx.device.columns:
+        np.testing.assert_array_equal(
+            np.asarray(dev_idx.device.columns[k]),
+            np.asarray(host_idx.device.columns[k]))
+    planner = QueryPlanner(sft, table, [dev_idx])
+    assert planner.count(ECQL) == int(_brute(*raw).sum())
+
+
+def test_device_build_extents(monkeypatch):
+    rng = np.random.default_rng(11)
+    n = 3000
+    sft = SimpleFeatureType.from_spec("ls", "dtg:Date,*geom:LineString")
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    x0 = rng.uniform(-170, 160, n)
+    y0 = rng.uniform(-80, 70, n)
+    wkt = [f"LINESTRING ({x0[i]:.5f} {y0[i]:.5f}, {x0[i]+1:.5f} {y0[i]+2:.5f})"
+           for i in range(n)]
+    table = FeatureTable.build(
+        sft, {"dtg": base + rng.integers(0, 86400000, n), "geom": wkt})
+    host_idx = XZ2Index(sft, table)
+    monkeypatch.setattr(spatial, "DEVICE_SORT_MIN_ROWS", 1)
+    dev_idx = XZ2Index(sft, table)
+    np.testing.assert_array_equal(dev_idx.perm, host_idx.perm)
+    planner = QueryPlanner(sft, table, [dev_idx])
+    got = planner.count("BBOX(geom, -30, -20, 40, 35)")
+    # envelope-overlap brute force
+    hit = ((np.minimum(x0, x0 + 1) <= 40) & (np.maximum(x0, x0 + 1) >= -30)
+           & (np.minimum(y0, y0 + 2) <= 35) & (np.maximum(y0, y0 + 2) >= -20))
+    assert got == int(hit.sum())
+
+
+def test_prepared_query_matches_count():
+    sft, table, raw = _point_table()
+    idx = Z3Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    pq = planner.prepare(ECQL)
+    expect = int(_brute(*raw).sum())
+    assert pq.device_exact
+    assert pq.count() == expect
+    assert pq.count() == expect          # re-dispatch, no re-plan
+    assert int(pq.count_async()) == expect
+    np.testing.assert_array_equal(pq.select_indices(),
+                                  planner.select_indices(ECQL))
+
+
+def test_prepared_query_empty_and_host_paths():
+    sft, table, raw = _point_table()
+    idx = Z3Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    # no matches (disjoint interval)
+    pq = planner.prepare(
+        "BBOX(geom,0,0,1,1) AND dtg DURING 2031-01-01T00:00:00Z/2031-01-02T00:00:00Z")
+    assert pq.count() == 0
+    # host-residual path (Double cmp is inexact on device -> host refine)
+    sft2 = SimpleFeatureType.from_spec(
+        "t2", "score:Double,dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    rng = np.random.default_rng(5)
+    n = 500
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    table2 = FeatureTable.build(sft2, {
+        "score": rng.uniform(0, 1, n),
+        "dtg": base + rng.integers(0, 86400000, n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+    idx2 = Z3Index(sft2, table2)
+    planner2 = QueryPlanner(sft2, table2, [idx2])
+    q = "BBOX(geom,-10,-10,10,10) AND score > 0.5"
+    pq2 = planner2.prepare(q)
+    assert not pq2.device_exact
+    assert pq2.count() == planner2.count(q)
