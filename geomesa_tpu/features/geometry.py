@@ -37,6 +37,25 @@ TYPE_NAMES = {
 NAME_TYPES = {v: k for k, v in TYPE_NAMES.items()}
 
 
+def _exclusive_cumsum(counts: np.ndarray) -> np.ndarray:
+    out = np.empty(len(counts), dtype=np.int64)
+    if len(counts):
+        out[0] = 0
+        np.cumsum(counts[:-1], out=out[1:])
+    return out
+
+
+def expand_slices(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate the index ranges [starts[i], starts[i]+counts[i]) without a
+    Python loop (the workhorse for every ragged-buffer gather)."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    base = np.repeat(np.asarray(starts, dtype=np.int64)
+                     - _exclusive_cumsum(counts), counts)
+    return base + np.arange(total, dtype=np.int64)
+
+
 @dataclass
 class GeometryArray:
     """Columnar geometry collection of length N."""
@@ -150,21 +169,27 @@ class GeometryArray:
         return self.coords[:, 0], self.coords[:, 1]
 
     def bboxes(self) -> np.ndarray:
-        """(N, 4) per-feature [xmin, ymin, xmax, ymax].
+        """(N, 4) per-feature [xmin, ymin, xmax, ymax] — computed once and
+        cached (the array is treated as immutable; every filter/index path
+        reads this column).
 
         Features own contiguous coordinate slices by construction, so
         ``reduceat`` over the per-feature start offsets reduces exactly each
         feature's coords (the last segment runs to the end of the buffer).
         """
+        cached = getattr(self, "_bboxes", None)
+        if cached is not None:
+            return cached
         n = len(self)
         out = np.empty((n, 4), dtype=np.float64)
-        if n == 0:
-            return out
-        starts = self.ring_offsets[self.part_offsets[self.geom_offsets[:-1]]]
-        out[:, 0] = np.minimum.reduceat(self.coords[:, 0], starts)
-        out[:, 1] = np.minimum.reduceat(self.coords[:, 1], starts)
-        out[:, 2] = np.maximum.reduceat(self.coords[:, 0], starts)
-        out[:, 3] = np.maximum.reduceat(self.coords[:, 1], starts)
+        if n:
+            starts = self.ring_offsets[self.part_offsets[self.geom_offsets[:-1]]]
+            out[:, 0] = np.minimum.reduceat(self.coords[:, 0], starts)
+            out[:, 1] = np.minimum.reduceat(self.coords[:, 1], starts)
+            out[:, 2] = np.maximum.reduceat(self.coords[:, 0], starts)
+            out[:, 3] = np.maximum.reduceat(self.coords[:, 1], starts)
+        out.setflags(write=False)  # shared cache — guard against mutation
+        self._bboxes = out
         return out
 
     def feature_coords(self, i: int) -> np.ndarray:
@@ -173,9 +198,23 @@ class GeometryArray:
         return self.coords[s:e]
 
     def take(self, idx: np.ndarray) -> "GeometryArray":
-        """Gather a subset (host-side)."""
-        shapes = [self.shape(i) for i in np.asarray(idx, dtype=np.int64)]
-        return GeometryArray.from_shapes(shapes)
+        """Gather a subset — vectorized offset rebuild, no per-feature loop."""
+        idx = np.asarray(idx, dtype=np.int64)
+        nparts = self.geom_offsets[idx + 1] - self.geom_offsets[idx]
+        parts = expand_slices(self.geom_offsets[idx], nparts)
+        nrings = self.part_offsets[parts + 1] - self.part_offsets[parts]
+        rings = expand_slices(self.part_offsets[parts], nrings)
+        ncoords = self.ring_offsets[rings + 1] - self.ring_offsets[rings]
+        sel = expand_slices(self.ring_offsets[rings], ncoords)
+
+        def offsets(counts):
+            out = np.zeros(len(counts) + 1, dtype=np.int64)
+            np.cumsum(counts, out=out[1:])
+            return out
+
+        return GeometryArray(
+            self.type_codes[idx], offsets(nparts), offsets(nrings),
+            offsets(ncoords), self.coords[sel])
 
     def shape(self, i: int):
         """(type_code, nested lists) for feature i (inverse of from_shapes)."""

@@ -62,6 +62,13 @@ class FeatureTable:
             self._fids = np.array([str(i) for i in range(self._n)], dtype=object)
         return self._fids
 
+    def fids_at(self, rows) -> np.ndarray:
+        """Fids for the given rows without materializing the full array (the
+        implicit scheme is fid == str(row); this is its single home)."""
+        if self._fids is None:
+            return np.array([str(i) for i in rows], dtype=object)
+        return self._fids[rows]
+
     def __len__(self) -> int:
         return self._n if self._fids is None else len(self._fids)
 
@@ -149,7 +156,9 @@ class FeatureTable:
                 cols[name] = col[idx]
         vis = StringColumn(self.visibility.codes[idx], self.visibility.vocab) \
             if self.visibility is not None else None
-        return FeatureTable(self.sft, self.fids[idx], cols, vis, _n=len(idx))
+        # with implicit fids, build only the selected ones — materializing
+        # the full array costs ~60s of Python string building at 100M rows
+        return FeatureTable(self.sft, self.fids_at(idx), cols, vis, _n=len(idx))
 
     def to_dicts(self) -> List[dict]:
         """Materialize as a list of {attr: value} dicts (tests / export)."""

@@ -2,22 +2,44 @@
 
 ≙ the reference's client-side fallback evaluation path
 (LocalQueryRunner.scala:49 — filter → visibility → transform chain, minus
-visibility), and the test oracle for all device kernels. Returns a boolean
-mask over the table's rows.
+visibility), and the test oracle for all device kernels. ``evaluate`` returns
+a boolean mask over the table's rows; ``evaluate_at`` evaluates only at the
+given candidate rows (the residual-refine hot path: no sub-table
+materialization, geometry predicates batched via ``geom_batch``).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import numpy as np
 
 from geomesa_tpu.features import geometry as geo
 from geomesa_tpu.features.table import FeatureTable, StringColumn
+from geomesa_tpu.filter import geom_batch as gb
 from geomesa_tpu.filter import geom_numpy as gn
 from geomesa_tpu.filter import ir
 
 
 def evaluate(f: ir.Filter, table: FeatureTable) -> np.ndarray:
-    n = len(table)
+    """Boolean mask over all table rows."""
+    return _eval(f, table, None)
+
+
+def evaluate_at(f: ir.Filter, table: FeatureTable,
+                rows: np.ndarray) -> np.ndarray:
+    """Boolean mask over ``rows`` (indices into the table) — the refine path:
+    evaluates in place, never materializing a sub-table."""
+    return _eval(f, table, np.asarray(rows, dtype=np.int64))
+
+
+def _nrows(table: FeatureTable, rows: Optional[np.ndarray]) -> int:
+    return len(table) if rows is None else len(rows)
+
+
+def _eval(f: ir.Filter, table: FeatureTable,
+          rows: Optional[np.ndarray]) -> np.ndarray:
+    n = _nrows(table, rows)
     if isinstance(f, ir.Include):
         return np.ones(n, dtype=bool)
     if isinstance(f, ir.Exclude):
@@ -25,44 +47,50 @@ def evaluate(f: ir.Filter, table: FeatureTable) -> np.ndarray:
     if isinstance(f, ir.And):
         mask = np.ones(n, dtype=bool)
         for c in f.children:
-            mask &= evaluate(c, table)
+            mask &= _eval(c, table, rows)
         return mask
     if isinstance(f, ir.Or):
         mask = np.zeros(n, dtype=bool)
         for c in f.children:
-            mask |= evaluate(c, table)
+            mask |= _eval(c, table, rows)
         return mask
     if isinstance(f, ir.Not):
-        return ~evaluate(f.child, table)
+        return ~_eval(f.child, table, rows)
     if isinstance(f, ir.BBox):
-        return _bbox(f, table)
+        return _bbox(f, table, rows)
     if isinstance(f, (ir.Intersects, ir.Contains, ir.Within)):
-        return _spatial(f, table)
+        return _spatial(f, table, rows)
     if isinstance(f, ir.Dwithin):
-        return _dwithin(f, table)
+        return _dwithin(f, table, rows)
     if isinstance(f, ir.During):
         col = np.asarray(table.column(f.attr), dtype=np.int64)
+        if rows is not None:
+            col = col[rows]
         lo = (col >= f.lo) if f.lo_inclusive else (col > f.lo)
         hi = (col <= f.hi) if f.hi_inclusive else (col < f.hi)
         return lo & hi
     if isinstance(f, ir.Cmp):
-        return _cmp(f, table)
+        return _cmp(f, table, rows)
     if isinstance(f, ir.In):
         col = table.column(f.attr)
         if isinstance(col, StringColumn):
+            codes = col.codes if rows is None else col.codes[rows]
             wanted = {v for v in f.values}
-            codes = {i for i, v in enumerate(col.vocab) if v in wanted}
-            return np.isin(col.codes, list(codes))
-        return np.isin(np.asarray(col), list(f.values))
+            keep = {i for i, v in enumerate(col.vocab) if v in wanted}
+            return np.isin(codes, list(keep))
+        arr = np.asarray(col) if rows is None else np.asarray(col)[rows]
+        return np.isin(arr, list(f.values))
     if isinstance(f, ir.IsNull):
         col = table.column(f.attr)
         if isinstance(col, StringColumn):
-            return np.array([col.vocab[c] == "" for c in col.codes])
-        arr = np.asarray(col)
+            codes = col.codes if rows is None else col.codes[rows]
+            return np.array([col.vocab[c] == "" for c in codes])
+        arr = np.asarray(col) if rows is None else np.asarray(col)[rows]
         return np.isnan(arr) if arr.dtype.kind == "f" else np.zeros(len(arr), dtype=bool)
     if isinstance(f, ir.FidFilter):
         wanted = set(f.fids)
-        return np.array([fid in wanted for fid in table.fids], dtype=bool)
+        fids = table.fids if rows is None else table.fids_at(rows)
+        return np.array([fid in wanted for fid in fids], dtype=bool)
     raise NotImplementedError(f"Cannot evaluate {type(f).__name__}")
 
 
@@ -73,82 +101,96 @@ def _geom_col(table: FeatureTable, attr: str) -> geo.GeometryArray:
     return col
 
 
-def _bbox(f: ir.BBox, table: FeatureTable) -> np.ndarray:
+def _bbox(f: ir.BBox, table: FeatureTable,
+          rows: Optional[np.ndarray]) -> np.ndarray:
     """Envelope-overlap semantics (the reference's loose-bbox behavior, exact
     for points — Z3IndexKeySpace.useFullFilter:235-249 discussion)."""
     arr = _geom_col(table, f.attr)
     bb = arr.bboxes()
+    if rows is not None:
+        bb = bb[rows]
     return (
         (bb[:, 0] <= f.xmax) & (bb[:, 2] >= f.xmin)
         & (bb[:, 1] <= f.ymax) & (bb[:, 3] >= f.ymin)
     )
 
 
-def _spatial(f, table: FeatureTable) -> np.ndarray:
+def _spatial(f, table: FeatureTable,
+             rows: Optional[np.ndarray]) -> np.ndarray:
     arr = _geom_col(table, f.attr)
     lit = f.geometry
-    n = len(table)
+    n = _nrows(table, rows)
     out = np.zeros(n, dtype=bool)
     # bbox prefilter
     lx0, ly0, lx1, ly1 = gn.literal_bbox(lit)
     bb = arr.bboxes()
+    if rows is not None:
+        bb = bb[rows]
     cand = np.nonzero(
         (bb[:, 0] <= lx1) & (bb[:, 2] >= lx0) & (bb[:, 1] <= ly1) & (bb[:, 3] >= ly0))[0]
     if len(cand) == 0:
         return out
+    cand_rows = cand if rows is None else rows[cand]
     if arr.is_points and lit[0] in (geo.POLYGON, geo.MULTIPOLYGON):
         # vectorized fast path for point layers
         x, y = arr.point_xy()
-        res = gn.points_in_polygon(x[cand], y[cand], lit)
-        out[cand] = res
+        out[cand] = gn.points_in_polygon(x[cand_rows], y[cand_rows], lit)
         return out
-    for i in cand:
-        if isinstance(f, ir.Intersects):
-            out[i] = gn.geometry_intersects(arr, int(i), lit)
-        elif isinstance(f, (ir.Within, ir.Contains)):
-            # Within: feature within literal; Contains: literal contains
-            # feature — same relation from the feature's perspective
-            out[i] = gn.geometry_within(arr, int(i), lit)
+    if isinstance(f, ir.Intersects):
+        out[cand] = gb.batch_intersects(arr, cand_rows, lit)
+    else:
+        # Within: feature within literal; Contains: literal contains
+        # feature — same relation from the feature's perspective
+        out[cand] = gb.batch_within(arr, cand_rows, lit)
     return out
 
 
-def _dwithin(f: ir.Dwithin, table: FeatureTable) -> np.ndarray:
+def _dwithin(f: ir.Dwithin, table: FeatureTable,
+             rows: Optional[np.ndarray]) -> np.ndarray:
     arr = _geom_col(table, f.attr)
-    n = len(table)
+    n = _nrows(table, rows)
     out = np.zeros(n, dtype=bool)
     lx0, ly0, lx1, ly1 = gn.literal_bbox(f.geometry)
     d = f.distance
     bb = arr.bboxes()
+    if rows is not None:
+        bb = bb[rows]
     cand = np.nonzero(
         (bb[:, 0] <= lx1 + d) & (bb[:, 2] >= lx0 - d)
         & (bb[:, 1] <= ly1 + d) & (bb[:, 3] >= ly0 - d))[0]
-    if arr.is_points and f.geometry[0] in (geo.POLYGON, geo.MULTIPOLYGON, geo.LINESTRING,
-                                           geo.MULTILINESTRING):
+    if len(cand) == 0:
+        return out
+    cand_rows = cand if rows is None else rows[cand]
+    if arr.is_points and f.geometry[0] in (geo.POLYGON, geo.MULTIPOLYGON,
+                                           geo.LINESTRING, geo.MULTILINESTRING):
         x, y = arr.point_xy()
-        inside = gn.points_in_polygon(x[cand], y[cand], f.geometry) \
-            if f.geometry[0] in (geo.POLYGON, geo.MULTIPOLYGON) else np.zeros(len(cand), bool)
-        dist = gn.point_segment_distance(x[cand], y[cand], gn.literal_segments(f.geometry))
+        inside = gn.points_in_polygon(x[cand_rows], y[cand_rows], f.geometry) \
+            if f.geometry[0] in (geo.POLYGON, geo.MULTIPOLYGON) \
+            else np.zeros(len(cand), bool)
+        dist = gn.point_segment_distance(x[cand_rows], y[cand_rows],
+                                         gn.literal_segments(f.geometry))
         out[cand] = inside | (dist <= d)
         return out
-    for i in cand:
-        out[i] = gn.geometry_distance(arr, int(i), f.geometry) <= d
+    out[cand] = gb.batch_distance(arr, cand_rows, f.geometry) <= d
     return out
 
 
-def _cmp(f: ir.Cmp, table: FeatureTable) -> np.ndarray:
+def _cmp(f: ir.Cmp, table: FeatureTable,
+         rows: Optional[np.ndarray]) -> np.ndarray:
     col = table.column(f.attr)
     if isinstance(col, StringColumn):
+        codes = col.codes if rows is None else col.codes[rows]
         if f.op in ("=", "<>"):
             try:
                 code = col.vocab.index(f.value)
-                mask = col.codes == code
+                mask = codes == code
             except ValueError:
-                mask = np.zeros(len(col), dtype=bool)
+                mask = np.zeros(len(codes), dtype=bool)
             return mask if f.op == "=" else ~mask
         # ordered string comparison against the vocab
-        vals = np.array(col.vocab, dtype=object)[col.codes]
+        vals = np.array(col.vocab, dtype=object)[codes]
         return _apply_op(f.op, vals, f.value)
-    arr = np.asarray(col)
+    arr = np.asarray(col) if rows is None else np.asarray(col)[rows]
     return _apply_op(f.op, arr, f.value)
 
 

@@ -24,6 +24,7 @@ import numpy as np
 
 from geomesa_tpu.features.table import FeatureTable
 from geomesa_tpu.filter.evaluate import evaluate as _evaluate
+from geomesa_tpu.filter.evaluate import evaluate_at as _evaluate_at
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.index.api import IndexScanPlan, QueryResult
@@ -279,11 +280,12 @@ class QueryPlanner:
 
     def _refine(self, plan: IndexScanPlan, rows: np.ndarray) -> np.ndarray:
         """Host f64 re-evaluation of device candidates against the residual
-        (≙ the reference's full-filter path over overlapping-range rows)."""
+        (≙ the reference's full-filter path over overlapping-range rows).
+        Evaluates in place at the candidate rows — no sub-table, and geometry
+        predicates run batched (geom_batch) rather than per-feature."""
         if len(rows) == 0 or plan.residual_host is None:
             return rows
-        sub = self.table.take(rows)
-        mask = _evaluate(plan.residual_host, sub)
+        mask = _evaluate_at(plan.residual_host, self.table, rows)
         return rows[mask]
 
 
