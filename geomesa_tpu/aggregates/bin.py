@@ -45,14 +45,15 @@ def _label_ids(col) -> np.ndarray:
 
 
 def bin_records(planner, f, track: str, label: Optional[str] = None,
-                sort: bool = False) -> np.ndarray:
+                sort: bool = False, auths=None) -> np.ndarray:
     """Matching rows as a packed structured array (``.tobytes()`` is the wire
-    form). sort=True orders by dtg (≙ the BinSorter merge phase)."""
+    form). sort=True orders by dtg (≙ the BinSorter merge phase); ``auths``
+    restricts to visible rows."""
     sft = planner.sft
     dtg_attr = sft.dtg_attribute
     if dtg_attr is None:
         raise ValueError("BIN encoding requires a date attribute")
-    rows = planner.select_indices(f)
+    rows = planner.select_indices(f, auths=auths)
     sub = planner.table.take(rows)
     x, y = sub.geometry().point_xy() if sub.geometry().is_points else _centroids(sub)
     out = np.empty(len(rows), dtype=BIN_LABEL_DTYPE if label else BIN_DTYPE)

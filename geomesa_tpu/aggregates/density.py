@@ -52,35 +52,69 @@ def density_kernel(mask: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray,
     return jnp.zeros((height, width), dtype=jnp.float32).at[iy, ix].add(w)
 
 
-def density(planner, f, bbox, width: int = 256, height: int = 256,
-            weight_attr: Optional[str] = None) -> DensityGrid:
-    """Run a density query through the planner's chosen strategy.
+def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
+                    weight_attr: Optional[str] = None, auths=None):
+    """Plan once, stage constants, return a zero-arg callable producing a
+    DensityGrid per call (≙ a configured DensityScan handed to the servers).
 
-    Device path when the plan needs no host refinement (loose-boundary snap
-    differences are inside one grid cell for any realistic grid); host
-    fallback mirrors LocalQueryRunner's density transform.
+    The returned callable carries ``.dispatch()`` — async device dispatch
+    returning the (H, W) device array without readback — so many density
+    renders pipeline over a single round trip. Device path when the plan is
+    device-exact; host fallback mirrors LocalQueryRunner's density transform.
     """
-    plan, mask = planner.scan_mask(f)
-    grid = np.asarray(bbox, dtype=np.float32)
+    plan = planner._apply_auths(planner.plan(f), auths)
+    shape = (height, width)
     if plan.empty:
-        return DensityGrid(tuple(bbox), width, height, np.zeros((height, width), np.float32))
+        def run_empty():
+            return DensityGrid(tuple(bbox), width, height,
+                               np.zeros(shape, np.float32))
+        return run_empty
 
     idx = plan.index
-    if mask is not None and "xf" in idx.device.columns:
+    device_ok = (plan.primary_kind != "fid" and plan.residual_host is None
+                 and plan.candidate_slices is None and idx is not None
+                 and "xf" in idx.device.columns)
+    if device_ok:
         cols = idx.device.columns
         wcol = cols.get(weight_attr) if weight_attr else None
-        out = _jit_density(mask, cols["xf"], cols["yf"], jnp.asarray(grid),
-                           width, height, wcol)
-        return DensityGrid(tuple(bbox), width, height, np.asarray(out))
+        disp = idx.kernels.prepare_mask(plan.primary_kind, plan.boxes_loose,
+                                        plan.windows, plan.residual_device)
+        grid = jnp.asarray(np.asarray(bbox, dtype=np.float32))
 
-    # host fallback (≙ LocalQueryRunner.transform density path)
-    rows = planner.select_indices(f, plan=plan)
-    sub = planner.table.take(rows)
-    garr = sub.geometry()
-    bbs = garr.bboxes()
+        def dispatch():
+            return _jit_density_fn(disp(), cols["xf"], cols["yf"], grid,
+                                   width, height, wcol)
+
+        def run():
+            return DensityGrid(tuple(bbox), width, height,
+                               np.asarray(dispatch()))
+        run.dispatch = dispatch
+        return run
+
+    def run_host():
+        return _host_density(planner, f, plan, bbox, width, height,
+                             weight_attr, auths)
+    return run_host
+
+
+def density(planner, f, bbox, width: int = 256, height: int = 256,
+            weight_attr: Optional[str] = None, auths=None) -> DensityGrid:
+    """One-shot density query (plan + execute). Repeated renders should hold
+    onto ``prepare_density`` instead — it skips re-planning and re-staging."""
+    return prepare_density(planner, f, bbox, width, height, weight_attr,
+                           auths)()
+
+
+def _host_density(planner, f, plan, bbox, width, height, weight_attr,
+                  auths) -> DensityGrid:
+    """Host fallback (≙ LocalQueryRunner.transform density path)."""
+    rows = planner.select_indices(f, plan=plan, auths=auths)
+    garr = planner.table.geometry()
+    bbs = garr.bboxes()[rows]
     x = (bbs[:, 0] + bbs[:, 2]) / 2
     y = (bbs[:, 1] + bbs[:, 3]) / 2
-    w = np.asarray(sub.column(weight_attr), dtype=np.float64) if weight_attr else None
+    w = np.asarray(planner.table.column(weight_attr), dtype=np.float64)[rows] \
+        if weight_attr else None
     xmin, ymin, xmax, ymax = bbox
     fx = (x - xmin) / (xmax - xmin)
     fy = (y - ymin) / (ymax - ymin)
@@ -93,7 +127,3 @@ def density(planner, f, bbox, width: int = 256, height: int = 256,
 
 
 _jit_density_fn = jax.jit(density_kernel, static_argnames=("width", "height"))
-
-
-def _jit_density(mask, x, y, grid, width, height, weight):
-    return _jit_density_fn(mask, x, y, grid, width, height, weight)

@@ -17,10 +17,11 @@ from geomesa_tpu.features.table import StringColumn
 
 
 def sample_rows(planner, f, n: int, by: Optional[str] = None,
-                plan=None) -> np.ndarray:
+                plan=None, auths=None) -> np.ndarray:
     """Row indices of a 1-in-n sample of matches (per ``by``-group when set).
-    Pass a precomputed plan to avoid re-planning."""
-    rows = planner.select_indices(f, plan=plan)
+    Pass a precomputed plan to avoid re-planning; ``auths`` restricts to
+    visible rows."""
+    rows = planner.select_indices(f, plan=plan, auths=auths)
     if n <= 1:
         return rows
     if len(rows) == 0 or by is None:

@@ -99,12 +99,14 @@ def observe_on_device(leaf: sk.Stat, index, mask) -> bool:
     return False
 
 
-def run_stat(planner, spec: str, f=None) -> sk.Stat:
+def run_stat(planner, spec: str, f=None, auths=None) -> sk.Stat:
     """Compute a stat spec over matching rows, device reductions first.
 
-    The scan mask is evaluated once; device-supported leaves reduce against
-    it, the rest share one select+observe pass (≙ the coprocessor running
-    some aggregations region-side while the client computes the rest)."""
+    The scan mask is evaluated once (auths fold into it as a visibility-code
+    residual, ≙ VisibilityFilter riding the server scan); device-supported
+    leaves reduce against it, the rest share one select+observe pass (≙ the
+    coprocessor running some aggregations region-side while the client
+    computes the rest)."""
     from geomesa_tpu.filter import ir
     from geomesa_tpu.filter.parser import parse_ecql
     from geomesa_tpu.stats.dsl import observe_table, parse_stat
@@ -116,8 +118,9 @@ def run_stat(planner, spec: str, f=None) -> sk.Stat:
         f = parse_ecql(f)
 
     leaves = stat.stats if isinstance(stat, sk.SeqStat) else [stat]
-    include = isinstance(f, ir.Include)
-    plan, mask = planner.scan_mask(f)
+    restricted = auths is not None and planner.table.visibility is not None
+    include = isinstance(f, ir.Include) and not restricted
+    plan, mask = planner.scan_mask(f, auths=auths)
     host_leaves = list(leaves)
     if mask is not None:
         host_leaves = [l for l in leaves
@@ -126,7 +129,8 @@ def run_stat(planner, spec: str, f=None) -> sk.Stat:
         # one shared pass for every host-path leaf; INCLUDE observes the
         # master table directly (no select, no copy)
         sub = planner.table if include else \
-            planner.table.take(planner.select_indices(f, plan=plan))
+            planner.table.take(planner.select_indices(f, plan=plan,
+                                                      auths=auths))
         for l in host_leaves:
             observe_table(l, sub)
     return stat

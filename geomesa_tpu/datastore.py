@@ -221,31 +221,30 @@ class TpuDataStore:
         planner = self.planner(type_name)
         if not hints:
             return planner.query(f, auths=auths)
-        if auths is not None:
-            # aggregate hint paths enforce visibility via the shared
-            # scan-mask/select machinery only when threaded; reject rather
-            # than silently ignore the caller's auth restriction
-            raise NotImplementedError(
-                "auths with aggregation hints: use planner.select_indices("
-                "f, auths=...) + the aggregate functions directly")
+        # auths compose with every aggregation hint: the visibility-code
+        # mask folds into the device scan (planner._apply_auths) exactly as
+        # VisibilityFilter rides the reference's server-side scans
         if "density" in hints:
             from geomesa_tpu.aggregates.density import density
             d = dict(hints["density"])
             return density(planner, f, d["bbox"], d.get("width", 256),
-                           d.get("height", 256), d.get("weight"))
+                           d.get("height", 256), d.get("weight"),
+                           auths=auths)
         if "bin" in hints:
             from geomesa_tpu.aggregates.bin import bin_records
             b = dict(hints["bin"])
             return bin_records(planner, f, b["track"], b.get("label"),
-                               b.get("sort", False))
+                               b.get("sort", False), auths=auths)
         if "stats" in hints:
-            return self.stats(type_name).run_stat(hints["stats"], f)
+            return self.stats(type_name).run_stat(hints["stats"], f,
+                                                  auths=auths)
         if "sample" in hints:
             from geomesa_tpu.aggregates.sampling import sample_rows
             s = hints["sample"]
             s = {"n": s} if isinstance(s, int) else dict(s)
             plan = planner.plan(f)
-            rows = sample_rows(planner, f, s["n"], s.get("by"), plan=plan)
+            rows = sample_rows(planner, f, s["n"], s.get("by"), plan=plan,
+                               auths=auths)
             return QueryResult(rows, planner.table.take(rows), plan)
         raise ValueError(f"Unknown hints: {sorted(hints)}")
 

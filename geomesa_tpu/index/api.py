@@ -38,6 +38,16 @@ class IndexScanPlan:
     candidate_slices: Optional[List[Tuple[int, int]]] = None
 
     @property
+    def device_exact(self) -> bool:
+        """True when the plan resolves entirely on device: a primary/residual
+        mask scan with no host refinement, candidate pruning, or fid lookup.
+        The single home of this predicate — prepared queries, density,
+        scan_mask, and KNN pipelining all branch on it."""
+        return (not self.empty and self.primary_kind != "fid"
+                and self.residual_host is None
+                and self.candidate_slices is None and self.index is not None)
+
+    @property
     def n_candidates(self) -> Optional[int]:
         if self.candidate_slices is None:
             return None

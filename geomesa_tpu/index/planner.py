@@ -30,6 +30,19 @@ from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.index.api import IndexScanPlan, QueryResult
 
 _SELECT_CAP = 1 << 16
+# select-capacity tiers: each distinct capacity compiles its own packed
+# select kernel (seconds of XLA time through the tunnel), so capacity hints
+# quantize UP to a coarse tier instead of the exact power of two
+_SELECT_TIERS = (1 << 10, 1 << 13, _SELECT_CAP, 1 << 19, 1 << 22)
+
+
+def _select_tier(capacity) -> int:
+    if capacity is None:
+        return _SELECT_CAP
+    for t in _SELECT_TIERS:
+        if capacity <= t:
+            return t
+    return 1 << max(0, (int(capacity) - 1)).bit_length()
 
 
 def _pad_pow2(arr: np.ndarray, fill: int) -> np.ndarray:
@@ -224,8 +237,11 @@ class QueryPlanner:
 
     def select_indices(self, f: Union[str, ir.Filter],
                        plan: Optional[IndexScanPlan] = None,
-                       auths=None) -> np.ndarray:
-        """Matching row indices (ascending) into the master table."""
+                       auths=None, capacity: Optional[int] = None) -> np.ndarray:
+        """Matching row indices (ascending) into the master table.
+
+        ``capacity``: expected match-count hint — sized from a prior count it
+        avoids the overflow-retry rescans (index/scan.py select)."""
         if plan is None:
             plan = self.plan(f)
         plan = self._apply_auths(plan, auths)
@@ -240,7 +256,7 @@ class QueryPlanner:
         else:
             idx, _ = plan.index.kernels.select(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
-                plan.residual_device, _SELECT_CAP)
+                plan.residual_device, _select_tier(capacity))
         rows = plan.index.perm[idx]
         if plan.residual_host is None:
             return np.sort(rows)
@@ -327,11 +343,21 @@ class PreparedQuery:
         return self._count_disp()
 
     def count(self) -> int:
+        """Blocking count. Audited like planner.count (plan time 0) and
+        subject to the planner's cooperative deadline."""
+        from geomesa_tpu.index.guards import Deadline
+        dl = Deadline(self.planner.timeout_ms)
+        t0 = time.perf_counter()
         if self.plan.empty:
-            return 0
-        if self._count_disp is not None:
-            return int(self._count_disp())
-        return self.planner._count(self.plan, self.filter, self.auths)
+            n = 0
+        elif self._count_disp is not None:
+            n = int(self._count_disp())
+        else:
+            n = self.planner._count(self.plan, self.filter, self.auths)
+        dl.check("scan")
+        self.planner._write_audit(self.plan, self.filter, 0.0,
+                                  (time.perf_counter() - t0) * 1000, n)
+        return n
 
     def select_indices(self) -> np.ndarray:
         return self.planner.select_indices(self.filter, plan=self.plan,

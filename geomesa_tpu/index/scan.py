@@ -249,12 +249,39 @@ def _mask_kernel(primary_kind: str, has_time: bool, residual_key: str, n_boxes: 
     return mask
 
 
+_TRANSFER_SHAPES_WARMED = False
+
+
+def warm_transfer_shapes() -> None:
+    """Pre-touch the small host→device transfer shapes queries use.
+
+    Through the axon RPC tunnel the FIRST device_put of each new array shape
+    blocks ~140ms (per-shape channel setup); afterwards the same shape
+    transfers in sub-ms. Warming the power-of-two box/window/param shapes at
+    index-build time moves that cost out of the cold-query path (the r2 bench
+    showed plan+stage at 265ms — all of it was two cold transfer shapes)."""
+    global _TRANSFER_SHAPES_WARMED
+    if _TRANSFER_SHAPES_WARMED:
+        return
+    _TRANSFER_SHAPES_WARMED = True
+    import jax
+    puts = []
+    for b in (1, 2, 4, 8, 16):
+        puts.append(jax.device_put(np.zeros((b, 8), np.int32)))   # boxes
+        puts.append(jax.device_put(np.zeros((b, 4), np.int32)))   # windows
+        puts.append(jax.device_put(np.zeros((b,), np.int32)))     # params
+    puts.append(jax.device_put(np.zeros((), np.int32)))
+    puts.append(jax.device_put(np.zeros((), np.float32)))
+    jax.block_until_ready(puts)
+
+
 class ScanKernels:
     """Compiled-scan cache for one DeviceTable (one index)."""
 
     def __init__(self, device_cols: Dict[str, jnp.ndarray]):
         self.cols = device_cols
         self._jitted: Dict[tuple, Callable] = {}
+        warm_transfer_shapes()
 
     def _get(self, mode: str, primary_kind: str, has_time: bool,
              residual_key: str, residual_fn, n_boxes: int, n_windows: int,
@@ -287,6 +314,30 @@ class ScanKernels:
                 sel = jnp.nonzero(m, size=idxs.shape[0], fill_value=idxs.shape[0])[0]
                 return jnp.concatenate([
                     jnp.sum(m)[None].astype(jnp.int32), sel.astype(jnp.int32)])
+        elif mode == "count_multi":
+            # per-box counts in ONE kernel: the non-box constraints evaluate
+            # once, then lax.map runs one fused box-count pass per box (B
+            # sequential bandwidth-bound scans — no (N, B) materialization).
+            # The expanding-radius KNN schedule rides this: every radius
+            # costs one extra scan, the whole schedule one round trip.
+            from jax import lax
+
+            def run(cols, boxes, windows, rparams):
+                base = None
+                if has_time:
+                    base = _time_mask(cols, windows)
+                if residual_fn is not None:
+                    rm = residual_fn(cols, rparams)
+                    base = rm if base is None else (base & rm)
+                if "__valid__" in cols:
+                    v = cols["__valid__"]
+                    base = v if base is None else (base & v)
+
+                def one(b):
+                    m = PRIMARY_FNS[primary_kind](cols, b[None, :])
+                    return jnp.sum(m if base is None else (m & base))
+
+                return lax.map(one, boxes)
         elif mode == "select_packed":
             # single-roundtrip select: [count, idx...] in ONE int32 array so
             # the host pays a single device-fetch latency (transfers/dispatch
@@ -356,6 +407,21 @@ class ScanKernels:
         cnt = int(out[0])
         sel = out[1: 1 + cnt].astype(np.int64)
         return positions[sel], cnt
+
+    def counts_multi(self, primary_kind, boxes: np.ndarray, windows,
+                     residual) -> np.ndarray:
+        """Per-box counts for a (B, 8) box array: one upload, one kernel,
+        one readback — B counts for the price of one round trip. B pads to a
+        power of two (EMPTY_BOX rows count zero) to share compilations."""
+        b = pad_boxes(boxes)
+        fn = self._get("count_multi", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       b.shape[0],
+                       0 if windows is None else windows.shape[0])
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        out = np.asarray(fn(self.cols, _dev(b), _dev(windows), rp))
+        return out[: len(boxes)]
 
     def prepare_count(self, primary_kind, boxes, windows, residual):
         """Zero-arg async count dispatcher with all constants pre-staged on

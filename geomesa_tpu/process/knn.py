@@ -2,11 +2,17 @@
 
 ≙ reference `KNearestNeighborSearchProcess` (geomesa-process/.../query/
 KNearestNeighborSearchProcess.scala): iterative expanding-radius queries
-against the index until enough candidates exist, then exact distance
-ranking. The radius doubling runs cheap device COUNTS (one fused scan each);
-only the final candidate set is pulled to the host for ranking — and the
-guarantee pass re-queries at the k-th distance so no closer feature outside
-the last bbox is missed."""
+against the index until enough candidates exist, then exact distance ranking.
+
+TPU shape of the search: the radius-doubling "loop" is not a loop of blocking
+queries — every candidate radius shares one compiled count kernel (same box
+shape), so ALL radii dispatch asynchronously up front and a single stacked
+readback returns every count (one host↔device round trip for the whole
+doubling schedule). The final candidate pull sizes its select capacity from
+the already-known count, so no overflow-retry rescans happen; the guarantee
+pass re-queries at the k-th distance so no closer feature outside the last
+bbox is missed.
+"""
 
 from __future__ import annotations
 
@@ -17,6 +23,8 @@ import numpy as np
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.process.geo import expand_bbox, haversine_m
+
+_WORLD = (-180.0, -90.0, 180.0, 90.0)
 
 
 def knn(planner, x: float, y: float, k: int,
@@ -35,20 +43,29 @@ def knn(planner, x: float, y: float, k: int,
         return bbox if f is None or isinstance(f, ir.Include) \
             else ir.and_filters([f, bbox])
 
-    # expanding-radius count loop (device-side counts)
-    radius = float(initial_radius_m)
-    whole_world = False
+    # doubling schedule (stops once a bbox covers the world)
+    radii = []
+    r = float(initial_radius_m)
     for _ in range(max_doublings):
-        if planner.count(with_bbox(radius)) >= k:
+        radii.append(r)
+        if expand_bbox(x, y, r) == _WORLD:
             break
-        radius *= 2
-        xmin, ymin, xmax, ymax = expand_bbox(x, y, radius)
-        if (xmin, ymin, xmax, ymax) == (-180.0, -90.0, 180.0, 90.0):
-            whole_world = True
-            break
+        r *= 2
 
-    rows, dists = _rank(planner, with_bbox(radius) if not whole_world else
-                        (f or ir.Include()), x, y, k)
+    counts = _pipelined_counts(planner, with_bbox, radii)
+    enough = np.nonzero(counts >= k)[0]
+    if len(enough) == 0:
+        # even the widest bbox held < k — rank whatever the widest query has
+        radius, expected = radii[-1], int(counts[-1])
+        whole_world = expand_bbox(x, y, radius) == _WORLD
+    else:
+        i = int(enough[0])
+        radius, expected = radii[i], int(counts[i])
+        whole_world = False
+
+    rows, dists = _rank(planner,
+                        (f or ir.Include()) if whole_world else with_bbox(radius),
+                        x, y, k, capacity=expected)
     if len(rows) == 0 or whole_world:
         return rows, dists
     # guarantee: the k-th distance may exceed the bbox's inscribed circle —
@@ -59,16 +76,38 @@ def knn(planner, x: float, y: float, k: int,
     return rows, dists
 
 
-def _rank(planner, f, x, y, k):
-    rows = planner.select_indices(f)
+def _pipelined_counts(planner, with_bbox, radii) -> np.ndarray:
+    """Counts for every radius in ONE round trip when the plan allows it
+    (device-exact primary boxes); otherwise sequential blocking counts."""
+    plan = planner.plan(with_bbox(radii[0]))
+    if (not plan.empty and plan.primary_kind in ("point_boxes", "bbox_overlap")
+            and plan.residual_host is None and plan.candidate_slices is None
+            and plan.index is not None):
+        from geomesa_tpu.filter.extract import extract_bboxes
+        from geomesa_tpu.index.spatial import _boxes_fp62
+        geom = planner.sft.geometry_attribute.name
+        # rebuild only the box constants per radius; a radius whose bbox
+        # splits (antimeridian) falls back to the sequential path
+        raws = [_boxes_fp62(extract_bboxes(with_bbox(r), geom).boxes)
+                for r in radii]
+        if all(len(b) == 1 for b in raws):
+            boxes = np.concatenate(raws, axis=0)
+            return plan.index.kernels.counts_multi(
+                plan.primary_kind, boxes, plan.windows,
+                plan.residual_device)
+    return np.array([planner.count(with_bbox(r)) for r in radii])
+
+
+def _rank(planner, f, x, y, k, capacity: Optional[int] = None):
+    rows = planner.select_indices(f, capacity=capacity)
     if len(rows) == 0:
         return rows, np.empty(0)
-    sub = planner.table.take(rows)
-    garr = sub.geometry()
+    garr = planner.table.geometry()
     if garr.is_points:
         gx, gy = garr.point_xy()
+        gx, gy = gx[rows], gy[rows]
     else:
-        bb = garr.bboxes()
+        bb = garr.bboxes()[rows]
         gx, gy = (bb[:, 0] + bb[:, 2]) / 2, (bb[:, 1] + bb[:, 3]) / 2
     d = haversine_m(gx, gy, x, y)
     take = min(k, len(d))

@@ -143,14 +143,15 @@ class GeoMesaStats:
 
     # -- exact stat scans (≙ StatsScan) --------------------------------------
 
-    def run_stat(self, spec: str, f=None) -> sk.Stat:
+    def run_stat(self, spec: str, f=None, auths=None) -> sk.Stat:
         """Compute a stat over rows matching ``f`` (≙ StatsScan): device
         reductions where the sketch kind supports them, select+observe for
-        the rest (see aggregates.stats_scan)."""
+        the rest (see aggregates.stats_scan). ``auths`` restricts to visible
+        rows via the device visibility mask."""
         from geomesa_tpu.aggregates.stats_scan import run_stat as _run
         if self.planner is None:
             raise ValueError("stats not attached to a planner")
-        return _run(self.planner, spec, self._filter(f))
+        return _run(self.planner, spec, self._filter(f), auths=auths)
 
     # -- helpers -------------------------------------------------------------
 

@@ -151,3 +151,21 @@ def test_density_respects_attribute_index_plan():
 def test_unknown_hint_raises(store):
     with pytest.raises(ValueError):
         store.query("tr", "INCLUDE", hints={"bogus": 1})
+
+
+def test_prepare_density_matches_oneshot(store, data):
+    """Prepared density == one-shot density, and repeated calls reuse the
+    staged plan (the r2 bench re-planned per call at ~1s/query)."""
+    from geomesa_tpu.aggregates.density import density, prepare_density
+    planner = store.planner("tr")
+    bbox = (-60, -30, 60, 30)
+    f = "BBOX(geom, -60, -30, 60, 30)"
+    pd = prepare_density(planner, f, bbox, 32, 16)
+    g1 = pd()
+    g2 = density(planner, f, bbox, 32, 16)
+    np.testing.assert_allclose(g1.weights, g2.weights)
+    assert hasattr(pd, "dispatch")  # async device path was chosen
+    # pipelined dispatches agree with blocking
+    outs = [pd.dispatch() for _ in range(4)]
+    for o in outs:
+        np.testing.assert_allclose(np.asarray(o), g1.weights)

@@ -144,3 +144,64 @@ def test_fid_query_respects_auths(store):
     assert ds.count("sec", ir.FidFilter((secret_fid,)), auths=["admin"]) == 0
     assert ds.count("sec", ir.FidFilter((secret_fid,)),
                     auths=["admin", "ops"]) == 1
+
+
+# -- auths x aggregation hints (≙ VisibilityFilter riding server-side scans) --
+
+
+def test_density_respects_auths(store):
+    ds, vis = store
+    bbox = (-50, -50, 50, 50)
+    grid = ds.query("sec", "INCLUDE",
+                    hints={"density": {"bbox": bbox, "width": 32,
+                                       "height": 32}}, auths=["admin"])
+    t = ds.tables["sec"]
+    x, y = t.geometry().point_xy()
+    ref = _visible(vis, ["admin"]) & (x >= -50) & (x < 50) \
+        & (y >= -50) & (y < 50)
+    assert int(grid.weights.sum()) == int(ref.sum())
+
+
+def test_stats_respect_auths(store):
+    ds, vis = store
+    stat = ds.query("sec", "INCLUDE", hints={"stats": "Count()"},
+                    auths=["ops"])
+    assert stat.count == int(_visible(vis, ["ops"]).sum())
+
+
+def test_bin_respects_auths(store):
+    ds, vis = store
+    recs = ds.query("sec", "INCLUDE",
+                    hints={"bin": {"track": "name"}}, auths=["user"])
+    assert len(recs) == int(_visible(vis, ["user"]).sum())
+
+
+def test_sample_respects_auths(store):
+    ds, vis = store
+    res = ds.query("sec", "INCLUDE", hints={"sample": 1}, auths=["admin"])
+    assert res.count == int(_visible(vis, ["admin"]).sum())
+    res2 = ds.query("sec", "INCLUDE", hints={"sample": 4}, auths=["admin"])
+    visible_rows = set(np.nonzero(_visible(vis, ["admin"]))[0])
+    assert set(res2.indices) <= visible_rows
+
+
+def test_density_auths_equal_posthoc(store):
+    """Auth-restricted density == density over the post-hoc-filtered rows
+    (the VERDICT r2 'done' criterion for auths x aggregation)."""
+    from geomesa_tpu.aggregates.density import density
+    ds, vis = store
+    planner = ds.planner("sec")
+    bbox = (-50, -50, 50, 50)
+    g1 = density(planner, "v < 50", bbox, 16, 16, auths=["admin", "ops"])
+    rows = planner.select_indices("v < 50", auths=["admin", "ops"])
+    t = ds.tables["sec"]
+    x, y = t.geometry().point_xy()
+    import numpy as _np
+    w = _np.zeros((16, 16), _np.float32)
+    fx = (x[rows] + 50) / 100
+    fy = (y[rows] + 50) / 100
+    inb = (fx >= 0) & (fx < 1) & (fy >= 0) & (fy < 1)
+    ix = _np.clip((fx[inb] * 16).astype(int), 0, 15)
+    iy = _np.clip((fy[inb] * 16).astype(int), 0, 15)
+    _np.add.at(w, (iy, ix), 1.0)
+    assert _np.allclose(g1.weights, w)
