@@ -1,0 +1,197 @@
+"""Device-side index-key encoding: fp62 planes, curve cells, Morton planes.
+
+The reference encodes index keys row-by-row on the ingest host
+(Z3IndexKeySpace.toIndexKey, /root/reference/geomesa-index-api/src/main/scala/
+org/locationtech/geomesa/index/index/z3/Z3IndexKeySpace.scala:64-96). On a
+single-core host that pass costs minutes at 100M rows, so here the whole
+encode runs on the accelerator:
+
+  host                         device (one jitted kernel)
+  ----                         --------------------------
+  u = x - dom_lo  (1 pass)  →  IEEE-decode u bits → fp62 hi/lo int32 planes
+  f32 casts       (1 pass)  →  21-bit curve cells (f32 mul+floor)
+                            →  Morton spread → 3×21-bit sort planes
+                            →  lax.sort → permutation → fused gather
+
+fp62 semantics (shared host/device contract — device.fp62 implements the
+same formula in f64): ``v = clamp(floor(u * 2^shift), 0, span*2^shift)`` where
+``shift = 62 - ceil(log2(domain_span))``. Because the scale is a power of two,
+the device can compute v EXACTLY from the raw IEEE-754 bits of u (mantissa
+funnel-shift by exponent) — no f64 arithmetic needed on TPU. The quantum
+(2^-53 deg for lon) is finer than the f64 ulp of any in-domain coordinate, so
+lexicographic (hi, lo) int32 comparison reproduces the host's f64 predicate
+exactly.
+
+Curve cells intentionally use f32 math (`cells_f32`), identically on host and
+device: the ±1-cell difference vs the exact f64 SFC normalize is absorbed by
+padding query covers by one cell per dimension (`curves/ranges` callers).
+Cells only place rows in the sorted layout; exactness comes from fp62 masks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# fp62 shift per domain: lon span 360 ⊂ [0, 512) → shift 53; lat span 180 ⊂
+# [0, 256) → shift 54. Both yield v < 2^62 (31+31 bit planes).
+LON_SHIFT = 53
+LAT_SHIFT = 54
+
+_M31 = (1 << 31) - 1
+_M21 = (1 << 21) - 1
+
+
+# -- shared f32 cell quantization (host numpy == device jnp, op for op) -----
+
+
+def cells_f32(xp, v_f32, lo: float, inv_cell: float, max_index: int):
+    """Curve cell of each coordinate: floor((v - lo) * inv_cell) clamped.
+
+    ``xp`` is the array namespace (numpy or jax.numpy); all math is f32 so the
+    host build path and the device build path place every row in the same
+    cell (IEEE f32 ops round identically)."""
+    f = (v_f32 - xp.float32(lo)) * xp.float32(inv_cell)
+    c = xp.floor(f).astype(xp.int32)
+    return xp.clip(c, 0, max_index)
+
+
+def lon_cells(xp, x_f32, bits: int = 21):
+    return cells_f32(xp, x_f32, -180.0, (1 << bits) / 360.0, (1 << bits) - 1)
+
+
+def lat_cells(xp, y_f32, bits: int = 21):
+    return cells_f32(xp, y_f32, -90.0, (1 << bits) / 180.0, (1 << bits) - 1)
+
+
+def time_cells(xp, off_f32, max_offset: int, bits: int = 21):
+    """Offsets are int period-units < 2^24 → exact in f32."""
+    return cells_f32(xp, off_f32, 0.0, (1 << bits) / float(max_offset),
+                     (1 << bits) - 1)
+
+
+# The f32 cell can differ from the exact f64 SFC normalize by at most
+# ceil(2^bits * 2^-23) cells (f32 relative error through one subtract and one
+# multiply) — covers pad their normalized query boxes by this much.
+def cell_pad(bits: int) -> int:
+    return max(1, 1 << max(0, bits - 22))
+
+
+# -- host fp62 (f64 reference formula; device bit-math must match exactly) --
+
+
+def fp62_host(u: np.ndarray, shift: int, span: float) -> Tuple[np.ndarray, np.ndarray]:
+    """``u`` = coordinate minus domain min, already f64-rounded. Returns
+    (hi, lo) int32 planes of v = clamp(floor(u * 2^shift), 0, span*2^shift)."""
+    v = np.floor(np.ldexp(np.asarray(u, dtype=np.float64), shift)).astype(np.int64)
+    np.clip(v, 0, int(span * (1 << shift)), out=v)
+    return (v >> 31).astype(np.int32), (v & _M31).astype(np.int32)
+
+
+# -- device fp62 from IEEE-754 bits -----------------------------------------
+
+
+def f64_bits_u32(u: np.ndarray) -> np.ndarray:
+    """Host view of an f64 array as little-endian uint32 pairs, shape (n, 2)
+    — a zero-copy reinterpret, uploaded as one contiguous buffer."""
+    u = np.ascontiguousarray(u, dtype=np.float64)
+    return u.view(np.uint32).reshape(-1, 2)
+
+
+def fp62_from_bits(jnp, bits_lo, bits_hi, shift: int, span: float):
+    """Device: (hi, lo) int32 fp62 planes from the raw IEEE-754 bits of u.
+
+    v = clamp(floor(u * 2^shift), 0, span << shift) computed exactly with
+    uint32 ops: u = m * 2^(e-1075) (m = 53-bit mantissa incl. implicit bit,
+    e = biased exponent), so floor(u * 2^shift) is m funnel-shifted by
+    s = e - 1075 + shift. Negative u (sign bit) clamps to 0; u > span clamps
+    to the top plane pair. Works for every finite input the host formula
+    accepts (subnormals have e=0 → shift ≤ -1022+shift ≪ 0 → v=0)."""
+    bl = bits_lo.astype(jnp.uint32)
+    bh = bits_hi.astype(jnp.uint32)
+    sign = (bh >> 31) != 0
+    e = ((bh >> 20) & 0x7FF).astype(jnp.int32)
+    m_hi = ((bh & 0xFFFFF) | jnp.where(e > 0, jnp.uint32(1 << 20), jnp.uint32(0)))
+    # mantissa = m_hi (21 bits, z-bits 32..52) : bl (32 bits, z-bits 0..31)
+    s = e - 1075 + shift  # net left-shift of the 53-bit mantissa
+
+    # left shift by s ∈ [0, 9] (u >= 0.5 after scale): v spans ≤ 62 bits
+    sl = jnp.clip(s, 0, 31).astype(jnp.uint32)
+    lo_l = bl << sl                                  # low 32 of (bl << s)
+    carry = jnp.where(sl > 0, bl >> (32 - sl), jnp.uint32(0))
+    hi_l = (m_hi << sl) | carry                      # bits 32..62 of v
+    # right shift by -s ∈ [1, 53+] (u < 0.5 after scale)
+    sr = jnp.clip(-s, 0, 31).astype(jnp.uint32)
+    lo_r = jnp.where(
+        sr < 32,
+        (bl >> sr) | jnp.where(sr > 0, m_hi << (32 - sr), jnp.uint32(0)),
+        m_hi >> jnp.clip(sr - 32, 0, 31))
+    lo_r = jnp.where(-s > 52, jnp.uint32(0), lo_r)
+    hi_r = jnp.where(sr < 32, m_hi >> sr, jnp.uint32(0))
+
+    v_lo32 = jnp.where(s >= 0, lo_l, lo_r)           # v bits 0..31
+    v_hi = jnp.where(s >= 0, hi_l, hi_r)             # v bits 32..62
+    # repack 64-bit (v_hi:v_lo32) into 31-bit planes: hi31 = v >> 31
+    hi31 = ((v_hi << 1) | (v_lo32 >> 31)) & jnp.uint32(_M31)
+    lo31 = v_lo32 & jnp.uint32(_M31)
+    # clamps: negative → 0; overflow (v > span<<shift) → top
+    top = int(span * (1 << shift))
+    top_hi, top_lo = top >> 31, top & _M31
+    over = (hi31 > top_hi) | ((hi31 == top_hi) & (lo31 > top_lo))
+    zero = sign | (e == 0)
+    hi31 = jnp.where(zero, jnp.uint32(0), jnp.where(over, jnp.uint32(top_hi), hi31))
+    lo31 = jnp.where(zero, jnp.uint32(0), jnp.where(over, jnp.uint32(top_lo), lo31))
+    return hi31.astype(jnp.int32), lo31.astype(jnp.int32)
+
+
+# -- Morton plane spread (device) -------------------------------------------
+
+
+def spread3_7(jnp, v):
+    """Spread a 7-bit uint32 so bit i lands at bit 3i (standard magic masks,
+    32-bit variant of curves/zorder spread3)."""
+    v = v.astype(jnp.uint32) & jnp.uint32(0x7F)
+    v = (v | (v << 8)) & jnp.uint32(0x0700F)
+    v = (v | (v << 4)) & jnp.uint32(0x430C3)
+    v = (v | (v << 2)) & jnp.uint32(0x49249)
+    return v
+
+
+def z3_planes(jnp, xi21, yi21, ti21):
+    """(p0, p1, p2) int32 21-bit planes of z3_encode(xi, yi, ti), major→minor
+    — p0 = z >> 42, matching spatial._split63 of the host curves/zorder path
+    (z bit 3i+0 = x bit i, +1 = y, +2 = t)."""
+    out = []
+    for sh in (14, 7, 0):
+        px = spread3_7(jnp, (xi21 >> sh))
+        py = spread3_7(jnp, (yi21 >> sh))
+        pt = spread3_7(jnp, (ti21 >> sh))
+        out.append((px | (py << 1) | (pt << 2)).astype(jnp.int32))
+    return tuple(out)
+
+
+def spread2_16(jnp, v):
+    """Spread a 16-bit uint32 so bit i lands at bit 2i."""
+    v = v.astype(jnp.uint32) & jnp.uint32(0xFFFF)
+    v = (v | (v << 8)) & jnp.uint32(0x00FF00FF)
+    v = (v | (v << 4)) & jnp.uint32(0x0F0F0F0F)
+    v = (v | (v << 2)) & jnp.uint32(0x33333333)
+    v = (v | (v << 1)) & jnp.uint32(0x55555555)
+    return v
+
+
+def z2_planes(jnp, xi, yi, bits: int = 21):
+    """(p0, p1, p2) int32 21-bit planes of z2_encode(xi, yi) (≤ 21-bit dims,
+    42-bit z; p0 = z >> 42 = 0 for 21-bit inputs — kept for a uniform
+    3-plane sort signature)."""
+    ex_lo = spread2_16(jnp, xi)
+    ex_hi = spread2_16(jnp, xi >> 16)
+    ey_lo = spread2_16(jnp, yi)
+    ey_hi = spread2_16(jnp, yi >> 16)
+    lo = ex_lo | (ey_lo << 1)        # z bits 0..31
+    hi = ex_hi | (ey_hi << 1)        # z bits 32..61 (stored at 0..29)
+    p2 = (lo & jnp.uint32(_M21)).astype(jnp.int32)
+    p1 = (((lo >> 21) | (hi << 11)) & jnp.uint32(_M21)).astype(jnp.int32)
+    p0 = ((hi >> 10) & jnp.uint32(_M21)).astype(jnp.int32)
+    return p0, p1, p2
