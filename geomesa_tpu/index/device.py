@@ -119,12 +119,19 @@ class DeviceTable:
 
 
 def host_planes(table: FeatureTable,
-                period: Optional[TimePeriod] = None) -> Dict[str, np.ndarray]:
+                period: Optional[TimePeriod] = None,
+                skip_geom: bool = False,
+                skip_dtg: bool = False) -> Dict[str, np.ndarray]:
     """Unsorted numpy projection of ``table`` onto the device column layout
-    (row order = table order; the caller applies the index sort)."""
+    (row order = table order; the caller applies the index sort).
+
+    ``skip_geom``/``skip_dtg`` omit the geometry / binned-time planes when the
+    caller already produced them (the native fused-encode build path)."""
     cols: Dict[str, np.ndarray] = {}
 
     geom_attr = table.sft.geometry_attribute
+    if skip_geom:
+        geom_attr = None
     if geom_attr is not None:
         garr: GeometryArray = table.columns[geom_attr.name]
         if garr.is_points:
@@ -151,7 +158,7 @@ def host_planes(table: FeatureTable,
                 cols[name + "_l"] = lo
 
     dtg_attr = table.sft.dtg_attribute
-    if dtg_attr is not None and period is not None:
+    if dtg_attr is not None and period is not None and not skip_dtg:
         ms = np.asarray(table.columns[dtg_attr.name], dtype=np.int64)
         bins, offs = time_to_binned_time(ms, period)
         cols["bin"] = np.asarray(bins, dtype=np.int32)
@@ -168,8 +175,11 @@ def host_planes(table: FeatureTable,
         if isinstance(raw, StringColumn):
             cols[attr.name] = np.asarray(raw.codes, dtype=np.int32)
         elif attr.type_name == "Date":
-            # seconds resolution on device; exact ms compare via (bin,off)
-            # when this is the primary dtg, else host refine
+            if dtg_attr is not None and attr.name == dtg_attr.name \
+                    and period is not None:
+                continue  # (bin, off) planes carry the primary dtg exactly
+            # secondary date attrs: seconds resolution on device (residual
+            # date predicates are host-refined; this column is advisory)
             cols[attr.name] = (np.asarray(raw, dtype=np.int64) // 1000).astype(np.int32)
         elif attr.type_name == "Long":
             cols[attr.name] = np.asarray(raw).astype(np.float64).astype(np.float32)

@@ -54,16 +54,38 @@ def _split63(v: np.ndarray) -> List[np.ndarray]:
             (v & _MASK21).astype(np.int32)]
 
 
+def _sort_perm_fn(ks):
+    """Stable sort permutation from padded key planes (row iota rides as the
+    final key, making the order total == a stable host lexsort)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    iota = lax.iota(jnp.int32, ks[0].shape[0])
+    out = lax.sort(tuple(ks) + (iota,), num_keys=len(ks) + 1)
+    return out[-1]
+
+
+_sort_perm_jit = None
+
+
+def _sort_perm(padded_keys):
+    """Module-level jit (one compilation per padded signature, shared across
+    every index build in the process — the per-call-closure version re-traced
+    on each build)."""
+    global _sort_perm_jit
+    if _sort_perm_jit is None:
+        import jax
+        _sort_perm_jit = jax.jit(_sort_perm_fn)
+    return _sort_perm_jit(tuple(padded_keys))
+
+
 def device_sort_perm(keys: List[np.ndarray]):
     """Sort permutation computed on device from int32 key planes.
 
     Keys are padded to a power of two with int32-max sentinels (shared jit
-    signatures across sizes); the row iota rides as the final sort key, which
-    makes the order total and exactly equal to a stable host lexsort.
+    signatures across sizes).
     """
-    import jax
     import jax.numpy as jnp
-    from jax import lax
 
     n = len(keys[0])
     cap = 1 << max(0, (n - 1)).bit_length()
@@ -72,14 +94,46 @@ def device_sort_perm(keys: List[np.ndarray]):
         p = np.full(cap, np.iinfo(np.int32).max, dtype=np.int32)
         p[:n] = k
         padded.append(jnp.asarray(p))
+    return _sort_perm(padded)[:n]
 
-    @jax.jit
-    def sort_fn(ks):
-        iota = lax.iota(jnp.int32, ks[0].shape[0])
-        out = lax.sort(tuple(ks) + (iota,), num_keys=len(ks) + 1)
-        return out[-1]
 
-    return sort_fn(tuple(padded))[:n]
+def _as_query_column(name: str, gathered, xp):
+    """Shared build-plane → device-column rename/cast rule (one home for both
+    the host small-table gather and the traced device gather): bin16 lands as
+    an int32 ``bin`` column; sort-key planes (zhi/zlo) are not query columns."""
+    if name in ("zhi", "zlo"):
+        return None, None
+    if name == "bin16":
+        return "bin", gathered.astype(xp.int32)
+    return name, gathered
+
+
+_native_sort_gather_jit = None
+
+
+def _native_sort_gather(keys, cols, n: int):
+    """One fused device program: sort padded keys → perm, gather every query
+    column through it, cast bin16 → int32. Module-level jit keyed by
+    (shapes, n) so repeated builds share compilations."""
+    global _native_sort_gather_jit
+    if _native_sort_gather_jit is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def fn(keys, cols, n):
+            perm = _sort_perm_fn(keys)[:n]
+            out = {}
+            for name, v in cols.items():
+                out_name, g = _as_query_column(name, v[perm], jnp)
+                if out_name is not None:
+                    out[out_name] = g
+            return perm, out
+
+        _native_sort_gather_jit = fn
+    return _native_sort_gather_jit(keys, cols, n)
 
 
 def _strip_handled(f: ir.Filter, geom: Optional[str], dtg: Optional[str],
@@ -147,20 +201,21 @@ class BaseSpatialIndex:
         self.period = TimePeriod.parse(sft.z3_interval) if self.dtg else None
         self._perm_cache: Optional[np.ndarray] = None
         self._dev_perm = None
-        keys = self._sort_keys()
         n = len(table)
-        if keys is None:
-            self._perm_cache = np.arange(n, dtype=np.int64)
-            self.device = DeviceTable.build(table, self._perm_cache, self.period)
-        elif n >= DEVICE_SORT_MIN_ROWS and all(
-                k.dtype == np.int32 for k in keys):
-            self._dev_perm = device_sort_perm(keys)
-            self.device = DeviceTable.build_on_device(
-                table, self._dev_perm, self.period)
-        else:
-            # np.lexsort sorts by LAST key first → reverse to major-first
-            self._perm_cache = np.lexsort(tuple(reversed(keys))).astype(np.int64)
-            self.device = DeviceTable.build(table, self._perm_cache, self.period)
+        if not self._build_native():
+            keys = self._sort_keys()
+            if keys is None:
+                self._perm_cache = np.arange(n, dtype=np.int64)
+                self.device = DeviceTable.build(table, self._perm_cache, self.period)
+            elif n >= DEVICE_SORT_MIN_ROWS and all(
+                    k.dtype == np.int32 for k in keys):
+                self._dev_perm = device_sort_perm(keys)
+                self.device = DeviceTable.build_on_device(
+                    table, self._dev_perm, self.period)
+            else:
+                # np.lexsort sorts by LAST key first → reverse to major-first
+                self._perm_cache = np.lexsort(tuple(reversed(keys))).astype(np.int64)
+                self.device = DeviceTable.build(table, self._perm_cache, self.period)
         self.kernels = ScanKernels(self.device.columns)
         self.vocabs = {
             name: col.vocab for name, col in table.columns.items()
@@ -180,6 +235,59 @@ class BaseSpatialIndex:
     def _sort_keys(self) -> Optional[List[np.ndarray]]:
         """Int32 key planes, major → minor (None = natural table order)."""
         raise NotImplementedError
+
+    def _build_native(self) -> bool:
+        """Fused native-encode build (geomesa_tpu.native): the host runs one
+        C++ pass producing every device plane + sort key, so the table builds
+        with a single upload + one device sort/gather program. Returns False
+        when unsupported — the numpy path runs instead."""
+        return False
+
+    def _finish_native(self, enc: dict, key_names: List[str],
+                       extra: Dict[str, np.ndarray]) -> None:
+        """Upload native-encoded planes, sort on device, gather.
+
+        ``enc``: native encode output; ``key_names``: sort-key entries of
+        ``enc`` major→minor (padded host-side to a power of two with max
+        sentinels so jit signatures are shared per size tier); ``extra``:
+        remaining host planes (attributes, visibility)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self.table)
+        upload = dict(enc)
+        upload.pop("z", None)  # host-only (range-pruning searchsorted)
+        upload.update(extra)
+
+        if n < DEVICE_SORT_MIN_ROWS:
+            # small tables: host lexsort + host gather (device sort overhead
+            # isn't worth it; keeps the native path exercised by unit tests)
+            keys = [upload[name] for name in key_names]
+            perm = np.lexsort(tuple(reversed(keys)))
+            self._perm_cache = perm.astype(np.int64)
+            cols = {}
+            for name, v in upload.items():
+                out_name, g = _as_query_column(name, v[perm], np)
+                if out_name is not None:
+                    cols[out_name] = jnp.asarray(g)
+            self.device = DeviceTable(n, cols)
+            return
+
+        cap = 1 << max(0, (n - 1)).bit_length()
+        padded_keys = []
+        for name in key_names:
+            k = upload.pop(name) if name in ("zhi", "zlo") else upload[name]
+            p = np.full(cap, np.iinfo(k.dtype).max, dtype=k.dtype)
+            p[:n] = k
+            padded_keys.append(p)
+
+        # async uploads: dispatch all puts, block inside the build program
+        dev_keys = [jax.device_put(p) for p in padded_keys]
+        dev_cols = {k: jax.device_put(v) for k, v in upload.items()}
+
+        self._dev_perm, cols = _native_sort_gather(
+            tuple(dev_keys), dev_cols, n)
+        self.device = DeviceTable(n, cols)
 
     @classmethod
     def supports(cls, sft) -> bool:
@@ -278,6 +386,24 @@ class Z3Index(BaseSpatialIndex):
         self._bins = bins
         return [np.asarray(bins, dtype=np.int32)] + _split63(self._z)
 
+    def _build_native(self) -> bool:
+        from geomesa_tpu import native
+        garr = self.table.geometry()
+        if not (garr.is_points and native.available()):
+            return False
+        x, y = garr.point_xy()
+        ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
+        enc = native.z3_encode(x, y, ms, self.period.value)
+        if enc is None:  # calendar periods stay on the numpy path
+            return False
+        self._sfc = Z3SFC.apply(self.period)
+        self._z = enc["z"]
+        self._bins = enc["bin16"]
+        extra = host_planes(self.table, self.period,
+                            skip_geom=True, skip_dtg=True)
+        self._finish_native(enc, ["bin16", "zhi", "zlo"], extra)
+        return True
+
     @property
     def sorted_z(self) -> np.ndarray:
         if getattr(self, "_sorted_z", None) is None:
@@ -321,6 +447,20 @@ class Z2Index(BaseSpatialIndex):
         x, y = self.table.geometry().point_xy()
         self._z = Z2SFC().index(x, y, lenient=True)
         return _split63(self._z)
+
+    def _build_native(self) -> bool:
+        from geomesa_tpu import native
+        garr = self.table.geometry()
+        if not (garr.is_points and native.available()):
+            return False
+        x, y = garr.point_xy()
+        enc = native.z2_encode(x, y)
+        if enc is None:
+            return False
+        self._z = enc["z"]
+        extra = host_planes(self.table, self.period, skip_geom=True)
+        self._finish_native(enc, ["zhi", "zlo"], extra)
+        return True
 
     @property
     def sorted_z(self) -> np.ndarray:

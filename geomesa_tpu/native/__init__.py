@@ -1,0 +1,170 @@
+"""Native (C++) runtime kernels for host-side hot loops.
+
+The TPU compute path is JAX/XLA; this package holds the *host* runtime work
+that the reference implements on the JVM — the ingest key-encode hot loop
+(Z3IndexKeySpace.toIndexKey, SURVEY.md §3.2) — as a fused C++ pass bound via
+ctypes (no pybind11 in this image). The shared object compiles on first use
+with g++ and is cached next to the source; every entry point has a numpy
+fallback, so the package works (slower) without a toolchain.
+
+Parity contract: bit-identical outputs to the numpy paths (device.py fp62,
+curves/normalize.py, curves/binnedtime.py, curves/zorder.py), pinned by
+tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "encode.cpp")
+_SO = os.path.join(_DIR, "_encode.so")
+
+_lib = None
+_lock = threading.Lock()
+_load_failed = False
+
+
+def _nthreads() -> int:
+    try:
+        return max(1, min(os.cpu_count() or 1, 16))
+    except Exception:
+        return 1
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-pthread", _SRC, "-o", _SO + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        try:  # -march=native can fail on exotic hosts; retry generic
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(_SO + ".tmp", _SO)
+            return True
+        except Exception:
+            return False
+
+
+def _load():
+    """The compiled library, or None when unavailable (numpy fallback)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+            _load_failed = True
+            return None
+        try:
+            fresh = os.path.exists(_SO) and (
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+            if not fresh and not _build():
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(_SO)
+            i64, i32, i16, u32, f64, f32 = (
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            )
+            lib.gm_z3_encode.argtypes = [
+                f64, f64, i64, ctypes.c_int64, ctypes.c_int32,
+                i32, i32, i32, i32, f32, f32, i16, i32, u32, u32, i64,
+                ctypes.c_int32]
+            lib.gm_z2_encode.argtypes = [
+                f64, f64, ctypes.c_int64,
+                i32, i32, i32, i32, f32, f32, u32, u32, i64, ctypes.c_int32]
+            lib.gm_fp62.argtypes = [
+                f64, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
+                i32, i32, ctypes.c_int32]
+            _lib = lib
+        except Exception:
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_PERIOD_CODES = {"day": 0, "week": 1}
+
+
+def z3_encode(x: np.ndarray, y: np.ndarray, ms: np.ndarray, period: str):
+    """Fused Z3 build encode. Returns a dict of all build planes, or None
+    when the native library or the period (calendar months/years) is
+    unsupported — callers fall back to the numpy path."""
+    lib = _load()
+    code = _PERIOD_CODES.get(str(period).lower())
+    if lib is None or code is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    ms = np.ascontiguousarray(ms, dtype=np.int64)
+    n = len(x)
+    if n:
+        # bins ride as int16 (the reference's Short bins, BinnedTime.MAX_BIN);
+        # out-of-range epochs (pre-1970 / >2059 for days) take the numpy path
+        period_ms = 86_400_000 if code == 0 else 604_800_000
+        if not (0 <= int(ms.min()) and int(ms.max()) // period_ms <= 32767):
+            return None
+    out = {
+        "xi": np.empty(n, np.int32), "xl": np.empty(n, np.int32),
+        "yi": np.empty(n, np.int32), "yl": np.empty(n, np.int32),
+        "xf": np.empty(n, np.float32), "yf": np.empty(n, np.float32),
+        "bin16": np.empty(n, np.int16), "off": np.empty(n, np.int32),
+        "zhi": np.empty(n, np.uint32), "zlo": np.empty(n, np.uint32),
+        "z": np.empty(n, np.int64),
+    }
+    lib.gm_z3_encode(x, y, ms, n, code, out["xi"], out["xl"], out["yi"],
+                     out["yl"], out["xf"], out["yf"], out["bin16"],
+                     out["off"], out["zhi"], out["zlo"], out["z"],
+                     _nthreads())
+    return out
+
+
+def z2_encode(x: np.ndarray, y: np.ndarray):
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    n = len(x)
+    out = {
+        "xi": np.empty(n, np.int32), "xl": np.empty(n, np.int32),
+        "yi": np.empty(n, np.int32), "yl": np.empty(n, np.int32),
+        "xf": np.empty(n, np.float32), "yf": np.empty(n, np.float32),
+        "zhi": np.empty(n, np.uint32), "zlo": np.empty(n, np.uint32),
+        "z": np.empty(n, np.int64),
+    }
+    lib.gm_z2_encode(x, y, n, out["xi"], out["xl"], out["yi"], out["yl"],
+                     out["xf"], out["yf"], out["zhi"], out["zlo"], out["z"],
+                     _nthreads())
+    return out
+
+
+def fp62_planes(x: np.ndarray, lo: float, hi: float):
+    """(hi_plane, lo_plane) int32 — native fp62, or None for numpy fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    n = len(x)
+    phi = np.empty(n, np.int32)
+    plo = np.empty(n, np.int32)
+    lib.gm_fp62(x, n, lo, hi, phi, plo, _nthreads())
+    return phi, plo

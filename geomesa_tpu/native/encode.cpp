@@ -1,0 +1,199 @@
+// Native one-pass index-key encoder (the framework's ingest hot loop).
+//
+// ≙ the reference's per-feature write path Z3IndexKeySpace.toIndexKey
+// (/root/reference/geomesa-index-api/src/main/scala/org/locationtech/geomesa/
+//  index/index/z3/Z3IndexKeySpace.scala:64-96): BinnedTime split + SFC
+// interleave + key assembly. There it runs per feature on the JVM; here it is
+// a fused single pass over columnar arrays producing every device plane the
+// TPU table needs, so the host never touches the data twice:
+//
+//   x, y (f64), dtg (i64 ms)  ->  fp62 hi/lo planes (exact device predicates),
+//                                 (bin, off) exact binned time,
+//                                 z3 Morton key (+ its two u32 sort planes)
+//
+// Semantics are bit-identical to the numpy reference implementations
+// (geomesa_tpu/index/device.py fp62, curves/normalize.py, curves/binnedtime.py,
+// curves/zorder.py): same IEEE-754 double operations in the same order. The
+// numpy paths remain canonical; parity is pinned by tests/test_native.py.
+//
+// Built with plain g++ -O3 (no external deps); bound via ctypes. Threaded
+// with std::thread — a no-op on single-core hosts, linear speedup elsewhere.
+
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kFp62Max = (int64_t(1) << 62) - 1;
+
+// fp62: mirrors device.py fp62() — frac = clip((x-lo)/(hi-lo), 0, 1);
+// v = min(floor(ldexp(frac, 62)), 2^62-1); planes (v>>31, v&(2^31-1)).
+// Branchless (min/max/ternaries lower to vector blends under -O3); the
+// ldexp is an exact power-of-two scale, so a multiply matches it bitwise,
+// and frac >= 0 makes int64 truncation identical to floor.
+static inline int64_t fp62(double x, double lo, double hi) {
+  double frac = (x - lo) / (hi - lo);
+  frac = std::min(std::max(frac, 0.0), 1.0);
+  int64_t v = (int64_t)(frac * 4611686018427387904.0);  // 2^62
+  return std::min(v, kFp62Max);
+}
+
+// BitNormalizedDimension.normalize (normalize.py:39-43) with the lenient
+// clamp applied first (sfc _check): floor((x - min) * bins/(max-min)),
+// x >= max -> max_index. Post-clamp (x - mn) >= 0, so truncation == floor.
+static inline int64_t norm_bits(double x, double mn, double mx,
+                                double normalizer, int64_t max_index) {
+  x = std::max(x, mn);
+  int64_t r = (int64_t)((x - mn) * normalizer);
+  return x >= mx ? max_index : r;
+}
+
+// Morton spreads — same magic masks as curves/zorder.py.
+static inline uint64_t spread3(uint64_t x) {
+  x &= 0x00000000001FFFFFULL;
+  x = (x | (x << 32)) & 0x001F00000000FFFFULL;
+  x = (x | (x << 16)) & 0x001F0000FF0000FFULL;
+  x = (x | (x << 8)) & 0x100F00F00F00F00FULL;
+  x = (x | (x << 4)) & 0x10C30C30C30C30C3ULL;
+  x = (x | (x << 2)) & 0x1249249249249249ULL;
+  return x;
+}
+
+static inline uint64_t spread2(uint64_t x) {
+  x &= 0x00000000FFFFFFFFULL;
+  x = (x | (x << 16)) & 0x0000FFFF0000FFFFULL;
+  x = (x | (x << 8)) & 0x00FF00FF00FF00FFULL;
+  x = (x | (x << 4)) & 0x0F0F0F0F0F0F0F0FULL;
+  x = (x | (x << 2)) & 0x3333333333333333ULL;
+  x = (x | (x << 1)) & 0x5555555555555555ULL;
+  return x;
+}
+
+static inline int64_t floordiv(int64_t a, int64_t b) {
+  int64_t q = a / b;
+  q -= (int64_t)((a % b != 0) & ((a < 0) != (b < 0)));
+  return q;
+}
+
+template <typename F>
+void parallel_for(int64_t n, int nthreads, F&& body) {
+  if (nthreads <= 1 || n < (1 << 18)) {
+    body(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back([=, &body] { body(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// period: 0 = day (offset ms), 1 = week (offset seconds). Calendar periods
+// (month/year) stay on the numpy path.
+//
+// Outputs (all length n, caller-allocated):
+//   xi/xl/yi/yl : int32 fp62 planes        bin : int16   off : int32
+//   xf/yf       : float32 raw coords (aggregation columns)
+//   zhi/zlo     : uint32 z3-key sort planes (z >> 31, z & 0x7FFFFFFF)
+//   z           : int64 full z3 key (host range pruning)
+void gm_z3_encode(const double* x, const double* y, const int64_t* ms,
+                  int64_t n, int32_t period, int32_t* xi, int32_t* xl,
+                  int32_t* yi, int32_t* yl, float* xf, float* yf,
+                  int16_t* bin, int32_t* off,
+                  uint32_t* zhi, uint32_t* zlo, int64_t* z, int32_t nthreads) {
+  const int64_t period_ms = period == 0 ? 86400000LL : 604800000LL;
+  const int64_t off_div = period == 0 ? 1 : 1000;
+  const double max_off = period == 0 ? 86400000.0 : 604800.0;
+  const double norm_lon = 2097152.0 / 360.0;   // 2^21 / (max-min)
+  const double norm_lat = 2097152.0 / 180.0;
+  const double norm_t = 2097152.0 / max_off;
+  const int64_t max_idx = (1 << 21) - 1;
+
+  parallel_for(n, nthreads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      // lenient clamp (sfc _check) — fp62 clips internally already
+      double px = std::min(std::max(x[i], -180.0), 180.0);
+      double py = std::min(std::max(y[i], -90.0), 90.0);
+      int64_t vx = fp62(px, -180.0, 180.0);
+      int64_t vy = fp62(py, -90.0, 90.0);
+      xi[i] = (int32_t)(vx >> 31);
+      xl[i] = (int32_t)(vx & 0x7FFFFFFF);
+      yi[i] = (int32_t)(vy >> 31);
+      yl[i] = (int32_t)(vy & 0x7FFFFFFF);
+      xf[i] = (float)x[i];
+      yf[i] = (float)y[i];
+
+      int64_t b = floordiv(ms[i], period_ms);
+      int64_t o = (ms[i] - b * period_ms) / off_div;
+      bin[i] = (int16_t)b;
+      off[i] = (int32_t)o;
+
+      // Z3Index._sort_keys: t = min(off, time.max), then Z3SFC.index
+      double t = (double)o;
+      if (t > max_off) t = max_off;
+      uint64_t nx = (uint64_t)norm_bits(px, -180.0, 180.0, norm_lon, max_idx);
+      uint64_t ny = (uint64_t)norm_bits(py, -90.0, 90.0, norm_lat, max_idx);
+      uint64_t nt = (uint64_t)norm_bits(t, 0.0, max_off, norm_t, max_idx);
+      uint64_t zz = spread3(nx) | (spread3(ny) << 1) | (spread3(nt) << 2);
+      z[i] = (int64_t)zz;
+      zhi[i] = (uint32_t)(zz >> 31);
+      zlo[i] = (uint32_t)(zz & 0x7FFFFFFF);
+    }
+  });
+}
+
+// Z2 variant: 31-bit normalization, 62-bit Morton key.
+void gm_z2_encode(const double* x, const double* y, int64_t n, int32_t* xi,
+                  int32_t* xl, int32_t* yi, int32_t* yl, float* xf, float* yf,
+                  uint32_t* zhi, uint32_t* zlo, int64_t* z, int32_t nthreads) {
+  const double norm_lon = 2147483648.0 / 360.0;  // 2^31 / (max-min)
+  const double norm_lat = 2147483648.0 / 180.0;
+  const int64_t max_idx = (int64_t(1) << 31) - 1;
+
+  parallel_for(n, nthreads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      double px = std::min(std::max(x[i], -180.0), 180.0);
+      double py = std::min(std::max(y[i], -90.0), 90.0);
+      int64_t vx = fp62(px, -180.0, 180.0);
+      int64_t vy = fp62(py, -90.0, 90.0);
+      xi[i] = (int32_t)(vx >> 31);
+      xl[i] = (int32_t)(vx & 0x7FFFFFFF);
+      yi[i] = (int32_t)(vy >> 31);
+      yl[i] = (int32_t)(vy & 0x7FFFFFFF);
+      xf[i] = (float)x[i];
+      yf[i] = (float)y[i];
+
+      uint64_t nx = (uint64_t)norm_bits(px, -180.0, 180.0, norm_lon, max_idx);
+      uint64_t ny = (uint64_t)norm_bits(py, -90.0, 90.0, norm_lat, max_idx);
+      uint64_t zz = spread2(nx) | (spread2(ny) << 1);
+      z[i] = (int64_t)zz;
+      zhi[i] = (uint32_t)(zz >> 31);
+      zlo[i] = (uint32_t)(zz & 0x7FFFFFFF);
+    }
+  });
+}
+
+// fp62 planes only (extent envelope planes, standalone column encodes).
+void gm_fp62(const double* x, int64_t n, double lo, double hi, int32_t* phi,
+             int32_t* plo, int32_t nthreads) {
+  parallel_for(n, nthreads, [&](int64_t a, int64_t b) {
+    for (int64_t i = a; i < b; ++i) {
+      int64_t v = fp62(x[i], lo, hi);
+      phi[i] = (int32_t)(v >> 31);
+      plo[i] = (int32_t)(v & 0x7FFFFFFF);
+    }
+  });
+}
+
+}  // extern "C"
