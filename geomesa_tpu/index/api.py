@@ -36,6 +36,9 @@ class IndexScanPlan:
     # only these rows (≙ a contiguous key-range scan instead of a full-table
     # scan). Positions materialize lazily — pricing needs only the count.
     candidate_slices: Optional[List[Tuple[int, int]]] = None
+    # range-pruning cache (planner._pruned_blocks): False = not yet computed,
+    # None = pruning declined (full scan), ndarray = candidate block ids
+    blocks: object = False
 
     @property
     def device_exact(self) -> bool:

@@ -28,6 +28,7 @@ from geomesa_tpu.filter.evaluate import evaluate_at as _evaluate_at
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.index.api import IndexScanPlan, QueryResult
+from geomesa_tpu.index import prune as _prune
 
 _SELECT_CAP = 1 << 16
 # select-capacity tiers: each distinct capacity compiles its own packed
@@ -128,7 +129,9 @@ class QueryPlanner:
     def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:
         """Hierarchical plan description (≙ Explainer / CLI explain)."""
         plan = self.plan(f)
+        blocks = self._pruned_blocks(plan)  # surface the pruning decision
         out = dict(plan.explain)
+        out["scan"] = "range-pruned" if blocks is not None else "full-mask"
         out.update({
             "type": self.sft.name,
             "strategy": plan.primary_kind,
@@ -180,6 +183,24 @@ class QueryPlanner:
         allowed = allowed_codes(self.table.visibility.vocab, auths)
         return rows[np.isin(self.table.visibility.codes[rows], allowed)]
 
+    # -- range pruning -------------------------------------------------------
+
+    def _pruned_blocks(self, plan: IndexScanPlan):
+        """Candidate gather-blocks for a plan (cached on the plan), or None
+        when the full-table fused mask is the better scan. ≙ choosing ranged
+        scans over a full-table scan (QueryProperties.BlockFullTableScans)."""
+        import os
+        if os.environ.get("GEOMESA_TPU_PRUNE", "1") == "0":
+            return None
+        if plan.blocks is False:
+            blocks = None
+            if (not plan.empty and plan.index is not None
+                    and plan.candidate_slices is None
+                    and hasattr(plan.index, "candidate_blocks")):
+                blocks = plan.index.candidate_blocks(plan)
+            plan.blocks = blocks
+        return plan.blocks
+
     # -- execution ----------------------------------------------------------
 
     def _write_audit(self, plan, f, plan_ms: float, scan_ms: float,
@@ -228,6 +249,13 @@ class QueryPlanner:
                 return plan.index.kernels.count_at(
                     plan.primary_kind, plan.boxes_loose, plan.windows,
                     plan.residual_device, plan.candidate_positions())
+            blocks = self._pruned_blocks(plan)
+            if blocks is not None:
+                if len(blocks) == 0:
+                    return 0
+                return plan.index.kernels.count_blocks(
+                    plan.primary_kind, plan.boxes_loose, plan.windows,
+                    plan.residual_device, blocks, _prune.BLOCK_SIZE)
             return plan.index.kernels.count(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
@@ -254,9 +282,18 @@ class QueryPlanner:
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device, plan.candidate_positions())
         else:
-            idx, _ = plan.index.kernels.select(
-                plan.primary_kind, plan.boxes_loose, plan.windows,
-                plan.residual_device, _select_tier(capacity))
+            blocks = self._pruned_blocks(plan)
+            if blocks is not None:
+                if len(blocks) == 0:
+                    return np.empty(0, dtype=np.int64)
+                idx, _ = plan.index.kernels.select_blocks(
+                    plan.primary_kind, plan.boxes_loose, plan.windows,
+                    plan.residual_device, blocks, _prune.BLOCK_SIZE,
+                    _select_tier(capacity))
+            else:
+                idx, _ = plan.index.kernels.select(
+                    plan.primary_kind, plan.boxes_loose, plan.windows,
+                    plan.residual_device, _select_tier(capacity))
         rows = plan.index.perm[idx]
         if plan.residual_host is None:
             return np.sort(rows)
@@ -325,9 +362,17 @@ class PreparedQuery:
         if (not plan.empty and plan.primary_kind != "fid"
                 and plan.residual_host is None
                 and plan.candidate_slices is None and plan.index is not None):
-            self._count_disp = plan.index.kernels.prepare_count(
-                plan.primary_kind, plan.boxes_loose, plan.windows,
-                plan.residual_device)
+            blocks = planner._pruned_blocks(plan)
+            if blocks is not None and len(blocks) > 0:
+                self._count_disp = plan.index.kernels.prepare_count_blocks(
+                    plan.primary_kind, plan.boxes_loose, plan.windows,
+                    plan.residual_device, blocks, _prune.BLOCK_SIZE)
+            elif blocks is None:
+                self._count_disp = plan.index.kernels.prepare_count(
+                    plan.primary_kind, plan.boxes_loose, plan.windows,
+                    plan.residual_device)
+            else:  # provably-empty candidate set
+                self._count_disp = lambda: np.zeros((), dtype=np.int32)
 
     @property
     def device_exact(self) -> bool:

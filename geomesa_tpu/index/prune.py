@@ -1,0 +1,146 @@
+"""Range-pruned scan execution (host planning side).
+
+≙ the reference's core query model: decompose the query region into at most
+``geomesa.scan.ranges.target`` (2000) key ranges and scan only those
+(Z3IndexKeySpace.getRanges, /root/reference/geomesa-index-api/src/main/scala/
+org/locationtech/geomesa/index/index/z3/Z3IndexKeySpace.scala:162-189;
+QueryProperties.scala:22). Here the "tablet ranges" become row intervals of
+the index's sorted order, found by binary search over the host-resident
+sorted key arrays, then converted to fixed-size *blocks* — small int32 ids
+the device turns back into row indices with an iota, so a pruned scan ships
+a few hundred ints instead of millions of row positions. The device kernel
+gathers candidate blocks and re-applies the full exact mask, so the cover
+only ever needs to be a superset (block granularity and cover slop are
+harmless).
+
+The planner prefers the pruned path when the candidate fraction is small
+(``PRUNE_MAX_FRACTION``); above that a full-table fused mask scan is faster
+than gathering (sequential HBM beats scattered gathers once most blocks are
+touched anyway).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curves.ranges import IndexRange
+
+# ≙ geomesa.scan.ranges.target (QueryProperties.scala:22)
+MAX_RANGES = int(os.environ.get("GEOMESA_TPU_SCAN_RANGES_TARGET", 2000))
+# rows per gather block: big enough for coalesced HBM reads, small enough
+# that cover slop stays low (0.5-4K rows per reference tablet-range is the
+# same ballpark the 2000-range target implies)
+BLOCK_SIZE = int(os.environ.get("GEOMESA_TPU_PRUNE_BLOCK", 4096))
+# above this candidate fraction, full-table streaming wins over gathering
+PRUNE_MAX_FRACTION = float(os.environ.get("GEOMESA_TPU_PRUNE_MAX_FRAC", 0.25))
+# cap on per-query interval decomposition (bins), mirroring the reference's
+# per-epoch range decomposition limits
+MAX_BINS = 512
+
+
+def ranges_to_slices(sorted_keys: np.ndarray,
+                     ranges: Sequence[IndexRange],
+                     base: int = 0,
+                     lo: int = 0,
+                     hi: Optional[int] = None) -> np.ndarray:
+    """Inclusive key ranges → [lo, hi) row slices via binary search over one
+    contiguous segment of a sorted key array. Returns (S, 2) int64."""
+    if hi is None:
+        hi = len(sorted_keys)
+    if not ranges or lo >= hi:
+        return np.empty((0, 2), dtype=np.int64)
+    seg = sorted_keys[lo:hi]
+    lowers = np.fromiter((r.lower for r in ranges), np.int64, len(ranges))
+    uppers = np.fromiter((r.upper for r in ranges), np.int64, len(ranges))
+    starts = np.searchsorted(seg, lowers, side="left") + lo + base
+    stops = np.searchsorted(seg, uppers, side="right") + lo + base
+    keep = stops > starts
+    return np.stack([starts[keep], stops[keep]], axis=1)
+
+
+def slices_to_blocks(slices: np.ndarray, n_rows: int,
+                     block_size: Optional[int] = None) -> Optional[np.ndarray]:
+    """Row slices → sorted unique block ids (int32). None when the expansion
+    would be degenerate (no slices). ``block_size`` defaults to the *current*
+    module BLOCK_SIZE (late-bound so runtime/test overrides take effect)."""
+    if block_size is None:
+        block_size = BLOCK_SIZE
+    if len(slices) == 0:
+        return None
+    lo_b = slices[:, 0] // block_size
+    hi_b = (slices[:, 1] - 1) // block_size
+    counts = (hi_b - lo_b + 1)
+    total = int(counts.sum())
+    # expand each [lo_b, hi_b] run with a ragged iota
+    offsets = np.repeat(np.cumsum(counts) - counts, counts)
+    ids = np.repeat(lo_b, counts) + (np.arange(total) - offsets)
+    return np.unique(ids).astype(np.int32)
+
+
+def candidate_stats(slices: np.ndarray, blocks: Optional[np.ndarray],
+                    n_rows: int, block_size: Optional[int] = None) -> dict:
+    """Explain payload for a pruned plan."""
+    if block_size is None:
+        block_size = BLOCK_SIZE
+    rows = int((slices[:, 1] - slices[:, 0]).sum()) if len(slices) else 0
+    nb = 0 if blocks is None else len(blocks)
+    return {
+        "candidate_rows": rows,
+        "candidate_blocks": nb,
+        "scanned_rows": nb * block_size,
+        "scanned_fraction": round(nb * block_size / max(1, n_rows), 5),
+    }
+
+
+def bin_windows(intervals, period) -> Optional[List[Tuple[int, Tuple[int, int]]]]:
+    """Decompose time intervals into per-bin in-bin offset windows:
+    [(bin, (t_lo, t_hi))...], t in period offset units, inclusive.
+
+    ≙ Z3IndexKeySpace.getIndexValues' per-epoch time decomposition
+    (Z3IndexKeySpace.scala:98-160). None when the decomposition explodes
+    (> MAX_BINS bins) — callers fall back to the unpruned scan.
+    """
+    from geomesa_tpu.curves.binnedtime import max_offset, time_to_binned_time
+
+    out: List[Tuple[int, Tuple[int, int]]] = []
+    mo = max_offset(period) - 1
+    for lo, hi in intervals:
+        blo, olo = time_to_binned_time(int(lo), period)
+        bhi, ohi = time_to_binned_time(int(hi), period)
+        blo, olo, bhi, ohi = int(blo), int(olo), int(bhi), int(ohi)
+        if bhi - blo + 1 > MAX_BINS or len(out) + (bhi - blo + 1) > MAX_BINS:
+            return None
+        for b in range(blo, bhi + 1):
+            t0 = olo if b == blo else 0
+            t1 = ohi if b == bhi else mo
+            out.append((b, (t0, min(t1, mo))))
+    return out
+
+
+class BinSegments:
+    """Per-bin contiguous row segments of an epoch-major sorted index
+    (lazy; one linear pass over the sorted bins array, cached)."""
+
+    def __init__(self, sorted_bins: np.ndarray):
+        bins = np.asarray(sorted_bins)
+        if len(bins) == 0:
+            self.bins = np.empty(0, np.int64)
+            self.starts = np.zeros(1, np.int64)
+            return
+        change = np.flatnonzero(np.diff(bins)) + 1
+        self.bins = np.concatenate([[bins[0]], bins[change]]).astype(np.int64)
+        self.starts = np.concatenate(
+            [[0], change, [len(bins)]]).astype(np.int64)
+
+    def segment(self, b: int) -> Tuple[int, int]:
+        """[lo, hi) rows of bin ``b`` (empty slice when absent)."""
+        i = int(np.searchsorted(self.bins, b))
+        if i == len(self.bins) or self.bins[i] != b:
+            return 0, 0
+        return int(self.starts[i]), int(self.starts[i + 1])
+
+    def all_bins(self) -> np.ndarray:
+        return self.bins

@@ -212,6 +212,7 @@ class BaseSpatialIndex:
                 self._dev_perm = device_sort_perm(keys)
                 self.device = DeviceTable.build_on_device(
                     table, self._dev_perm, self.period)
+                self._prefetch_perm()
             else:
                 # np.lexsort sorts by LAST key first → reverse to major-first
                 self._perm_cache = np.lexsort(tuple(reversed(keys))).astype(np.int64)
@@ -225,10 +226,31 @@ class BaseSpatialIndex:
     @property
     def perm(self) -> np.ndarray:
         """Host copy of the index sort permutation (sorted pos → table row);
-        downloaded from the device lazily on the large-table build path."""
+        downloaded from the device lazily on the large-table build path (a
+        background prefetch started at build time usually has it ready)."""
         if self._perm_cache is None:
-            self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
+            t = getattr(self, "_perm_thread", None)
+            if t is not None:
+                t.join()
+                self._perm_thread = None
+            if self._perm_cache is None:
+                self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
         return self._perm_cache
+
+    def _prefetch_perm(self) -> None:
+        """Overlap the device→host perm readback (the one sizeable download
+        the range-pruning host keys need) with whatever the caller does next
+        after the build."""
+        import threading
+
+        def fetch():
+            try:
+                self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
+            except Exception:
+                pass  # the lazy property will retry synchronously
+
+        self._perm_thread = threading.Thread(target=fetch, daemon=True)
+        self._perm_thread.start()
 
     # subclasses supply the sort keys ---------------------------------------
 
@@ -288,6 +310,7 @@ class BaseSpatialIndex:
         self._dev_perm, cols = _native_sort_gather(
             tuple(dev_keys), dev_cols, n)
         self.device = DeviceTable(n, cols)
+        self._prefetch_perm()
 
     @classmethod
     def supports(cls, sft) -> bool:
@@ -352,6 +375,85 @@ class BaseSpatialIndex:
         if temporal and self.temporal:
             return 3.0
         return 10.0  # full scan
+
+    # range pruning ---------------------------------------------------------
+
+    def candidate_blocks(self, plan: IndexScanPlan):
+        """Sorted unique gather-block ids covering every possibly-matching
+        row, or None when pruning doesn't apply or wouldn't pay (the device
+        re-applies the full exact mask to gathered blocks, so this only ever
+        needs to be a superset). ≙ the reference's ≤2000-range scan plans
+        (Z3IndexKeySpace.getRanges:162-189); the decision threshold mirrors
+        full-table-scan avoidance (QueryProperties.BlockFullTableScans)."""
+        from geomesa_tpu.index import prune as _p
+
+        if plan.empty or plan.boxes_loose is None:
+            return None  # no spatial constraint → nothing to cover
+        boxes = plan.explain.get("boxes")
+        if not boxes or len(boxes) > 16:
+            return None
+        n = len(self.table)
+        if n < 4 * _p.BLOCK_SIZE:
+            return None  # tiny tables: full mask is a single fused pass
+        # plan.windows is None iff the temporal extraction was unconstrained —
+        # the explain intervals then hold the open-ended sentinel, which must
+        # read as "no temporal constraint", not as a 146-million-bin interval
+        intervals = plan.explain.get("intervals") if plan.windows is not None else None
+        slices = self._row_slices(list(boxes), intervals)
+        if slices is None:
+            return None
+        total = int((slices[:, 1] - slices[:, 0]).sum()) if len(slices) else 0
+        if total > _p.PRUNE_MAX_FRACTION * n:
+            return None
+        blocks = _p.slices_to_blocks(slices, n)
+        if blocks is not None and len(blocks) * _p.BLOCK_SIZE > _p.PRUNE_MAX_FRACTION * n:
+            return None
+        plan.explain.update(_p.candidate_stats(slices, blocks, n))
+        if blocks is None:
+            # provably empty candidate set — still exact (superset of nothing)
+            blocks = np.empty(0, dtype=np.int32)
+        return blocks
+
+    def _row_slices(self, boxes, intervals) -> Optional[np.ndarray]:
+        """Candidate [lo, hi) row slices in this index's sorted order (a
+        superset of matches), or None when unsupported."""
+        return None
+
+    def _bin_segments(self):
+        from geomesa_tpu.index.prune import BinSegments
+        if getattr(self, "_bin_segs", None) is None:
+            self._bin_segs = BinSegments(self.sorted_bins)
+        return self._bin_segs
+
+    def _binned_row_slices(self, boxes, intervals, sorted_keys,
+                           cover_fn) -> Optional[np.ndarray]:
+        """Shared epoch-major pruning: per-bin segments × per-window covers
+        (covers dedup by in-bin window, so a multi-bin interval costs at most
+        three distinct covers: head, whole-period, tail)."""
+        from geomesa_tpu.index import prune as _p
+        from geomesa_tpu.curves.binnedtime import max_offset
+
+        segs = self._bin_segments()
+        mo = max_offset(self.period) - 1
+        if intervals:
+            bw = _p.bin_windows(intervals, self.period)
+            if bw is None:
+                return None
+        else:
+            bins = segs.all_bins()
+            if len(bins) > _p.MAX_BINS:
+                return None
+            bw = [(int(b), (0, mo)) for b in bins]
+        covers = {}
+        out = []
+        for b, w in bw:
+            lo, hi = segs.segment(b)
+            if lo >= hi:
+                continue
+            if w not in covers:
+                covers[w] = cover_fn(boxes, w)
+            out.append(_p.ranges_to_slices(sorted_keys, covers[w], lo=lo, hi=hi))
+        return np.concatenate(out) if out else np.empty((0, 2), dtype=np.int64)
 
     # explain ---------------------------------------------------------------
 
@@ -430,6 +532,12 @@ class Z3Index(BaseSpatialIndex):
                 ranges.append((b, rs))
         return ranges
 
+    def _row_slices(self, boxes, intervals):
+        from geomesa_tpu.index.prune import MAX_RANGES
+        return self._binned_row_slices(
+            boxes, intervals, self.sorted_z,
+            lambda bx, w: self._sfc.ranges(bx, [w], max_ranges=MAX_RANGES))
+
 
 class Z2Index(BaseSpatialIndex):
     """Point, no time: z2 order (≙ Z2IndexKeySpace.scala:29)."""
@@ -468,6 +576,11 @@ class Z2Index(BaseSpatialIndex):
             self._sorted_z = self._z[self.perm]
         return self._sorted_z
 
+    def _row_slices(self, boxes, intervals):
+        from geomesa_tpu.index.prune import MAX_RANGES, ranges_to_slices
+        rs = Z2SFC().ranges(boxes, max_ranges=MAX_RANGES)
+        return ranges_to_slices(self.sorted_z, rs)
+
 
 class XZ3Index(BaseSpatialIndex):
     """Extent + time: (bin, xz3) order (≙ XZ3IndexKeySpace.scala:33)."""
@@ -504,6 +617,17 @@ class XZ3Index(BaseSpatialIndex):
             self._sorted_bins = self._bins[self.perm]
         return self._sorted_bins
 
+    def _row_slices(self, boxes, intervals):
+        from geomesa_tpu.index.prune import MAX_RANGES
+        sfc = XZ3SFC.apply(self.sft.xz_precision, self.period)
+
+        def cover(bx, w):
+            qs = [(xmin, ymin, float(w[0]), xmax, ymax, float(w[1]))
+                  for xmin, ymin, xmax, ymax in bx]
+            return sfc.ranges(qs, max_ranges=MAX_RANGES)
+
+        return self._binned_row_slices(boxes, intervals, self.sorted_xz, cover)
+
 
 class XZ2Index(BaseSpatialIndex):
     """Extent, no time: xz2 order (≙ XZ2IndexKeySpace.scala:28)."""
@@ -528,6 +652,12 @@ class XZ2Index(BaseSpatialIndex):
         if getattr(self, "_sorted_xz", None) is None:
             self._sorted_xz = self._xz[self.perm]
         return self._sorted_xz
+
+    def _row_slices(self, boxes, intervals):
+        from geomesa_tpu.index.prune import MAX_RANGES, ranges_to_slices
+        sfc = XZ2SFC.apply(self.sft.xz_precision)
+        rs = sfc.ranges_bbox(boxes, max_ranges=MAX_RANGES)
+        return ranges_to_slices(self.sorted_xz, rs)
 
 
 class FullScanIndex(BaseSpatialIndex):

@@ -37,22 +37,33 @@ def _nthreads() -> int:
         return 1
 
 
+def _src_digest() -> str:
+    import hashlib
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
 def _build() -> bool:
+    """Compile the shared object (per-process temp name + atomic rename, so
+    concurrent first-use from several processes can't install a torn file);
+    records the source digest next to it for freshness checks."""
     cxx = os.environ.get("CXX", "g++")
-    cmd = [cxx, "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-pthread", _SRC, "-o", _SO + ".tmp"]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)
-        return True
-    except Exception:
-        try:  # -march=native can fail on exotic hosts; retry generic
-            cmd.remove("-march=native")
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    for flags in (["-O3", "-march=native"], ["-O3"]):  # native may not exist
+        cmd = [cxx, *flags, "-shared", "-fPIC", "-std=c++17", "-pthread",
+               _SRC, "-o", tmp]
+        try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(_SO + ".tmp", _SO)
+            os.replace(tmp, _SO)
+            with open(_SO + ".sha", "w") as f:
+                f.write(_src_digest())
             return True
         except Exception:
-            return False
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return False
 
 
 def _load():
@@ -67,12 +78,22 @@ def _load():
             _load_failed = True
             return None
         try:
-            fresh = os.path.exists(_SO) and (
-                os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+            digest = _src_digest()
+            try:
+                with open(_SO + ".sha") as f:
+                    fresh = os.path.exists(_SO) and f.read().strip() == digest
+            except OSError:
+                fresh = False
             if not fresh and not _build():
                 _load_failed = True
                 return None
-            lib = ctypes.CDLL(_SO)
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                # stale/foreign binary (different arch or glibc): rebuild once
+                if not _build():
+                    raise
+                lib = ctypes.CDLL(_SO)
             i64, i32, i16, u32, f64, f32 = (
                 np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
