@@ -52,38 +52,60 @@ def density_kernel(mask: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray,
     return jnp.zeros((height, width), dtype=jnp.float32).at[iy, ix].add(w)
 
 
+_COMPACT_TIERS = (1 << 17, 1 << 20, 1 << 23)
+
+
 def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
                     weight_attr: Optional[str] = None, auths=None):
     """Plan once, stage constants, return a zero-arg callable producing a
     DensityGrid per call (≙ a configured DensityScan handed to the servers).
 
-    The returned callable carries ``.dispatch()`` — async device dispatch
-    returning the (H, W) device array without readback — so many density
-    renders pipeline over a single round trip. Device path when the plan is
-    device-exact; host fallback mirrors LocalQueryRunner's density transform.
+    Device path (plan fully device-exact): range-pruned block gather+scatter
+    when the planner has a cover, else mask → compact → scatter (a TPU
+    scatter prices per update, so compacting ~matches beats scattering all N
+    rows by ~N/matches). The returned callable carries ``.dispatch()`` —
+    async device dispatch returning the (H, W) device array without readback
+    — so renders pipeline. Host fallback mirrors LocalQueryRunner's density
+    transform.
     """
     plan = planner._apply_auths(planner.plan(f), auths)
     shape = (height, width)
+
+    def run_empty():
+        return DensityGrid(tuple(bbox), width, height,
+                           np.zeros(shape, np.float32))
+
     if plan.empty:
-        def run_empty():
-            return DensityGrid(tuple(bbox), width, height,
-                               np.zeros(shape, np.float32))
         return run_empty
 
     idx = plan.index
     device_ok = (plan.primary_kind != "fid" and plan.residual_host is None
                  and plan.candidate_slices is None and idx is not None
-                 and "xf" in idx.device.columns)
+                 and "xf" in idx.device.columns
+                 and (weight_attr is None or weight_attr in idx.device.columns))
     if device_ok:
-        cols = idx.device.columns
-        wcol = cols.get(weight_attr) if weight_attr else None
-        disp = idx.kernels.prepare_mask(plan.primary_kind, plan.boxes_loose,
-                                        plan.windows, plan.residual_device)
-        grid = jnp.asarray(np.asarray(bbox, dtype=np.float32))
+        from geomesa_tpu.index import prune as _prune
+
+        blocks = planner._pruned_blocks(plan)
+        if blocks is not None and len(blocks) == 0:
+            return run_empty  # provably-empty cover
+        if blocks is not None:
+            disp0 = idx.kernels.prepare_density_blocks(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device, bbox, width, height, blocks,
+                _prune.BLOCK_SIZE, weight_attr)
+        else:
+            # size the compaction from an exact count (static data — the
+            # capacity can then never overflow)
+            cnt = planner._count(plan, f, auths)
+            cap = next((t for t in _COMPACT_TIERS if cnt <= t),
+                       1 << max(0, (max(cnt, 1) - 1)).bit_length())
+            disp0 = idx.kernels.prepare_density_compact(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device, bbox, width, height, cap, weight_attr)
 
         def dispatch():
-            return _jit_density_fn(disp(), cols["xf"], cols["yf"], grid,
-                                   width, height, wcol)
+            return disp0()[0]
 
         def run():
             return DensityGrid(tuple(bbox), width, height,

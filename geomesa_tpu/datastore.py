@@ -94,6 +94,11 @@ class TpuDataStore:
         self.schemas: Dict[str, SimpleFeatureType] = {}
         self.tables: Dict[str, FeatureTable] = {}
         self.planners: Dict[str, QueryPlanner] = {}
+        # LSM delta tier: recent appends held as a small host-side run that
+        # queries merge in exactly; flushed into the device-indexed main
+        # table when it grows past the flush threshold (≙ the Lambda store's
+        # hot tier shadowing the cold tier, LambdaDataStore.scala:180)
+        self.deltas: Dict[str, Optional[FeatureTable]] = {}
         self._stats: Dict[str, object] = {}
         self._counters: Dict[str, int] = {}
         self._interceptors: Dict[str, list] = {}
@@ -135,7 +140,8 @@ class TpuDataStore:
         return list(self.schemas)
 
     def remove_schema(self, type_name: str) -> None:
-        for d in (self.schemas, self.tables, self.planners, self._stats):
+        for d in (self.schemas, self.tables, self.planners, self._stats,
+                  self.deltas):
             d.pop(type_name, None)
 
     # -- writes -------------------------------------------------------------
@@ -154,10 +160,70 @@ class TpuDataStore:
 
     def _append(self, type_name: str, batch: FeatureTable,
                 stats_cached: Optional[dict] = None) -> None:
+        """Append path with LSM tiering: small batches land in the host-side
+        delta run (cost ~ O(batch), not O(table)); the main device index
+        rebuilds only on the first load or when the delta crosses the flush
+        threshold. Queries merge main + delta exactly (see count/query)."""
+        import os
+
         current = self.tables.get(type_name)
-        table = batch if current is None else FeatureTable.concat([current, batch])
-        self.tables[type_name] = table
-        self._rebuild_indexes(type_name, stats_cached)
+        if current is None:
+            self.tables[type_name] = batch
+            self.deltas[type_name] = None
+            self._rebuild_indexes(type_name, stats_cached)
+            return
+        delta = self.deltas.get(type_name)
+        merged_delta = batch if delta is None else FeatureTable.concat([delta, batch])
+        frac = float(os.environ.get("GEOMESA_TPU_LSM_MAX_FRAC", 0.02))
+        threshold = max(50_000, int(frac * len(current)))
+        if stats_cached is not None or len(merged_delta) > threshold:
+            # flush-through (large batch, or a checkpoint restore that must
+            # land its cached sketches against the merged table)
+            self.deltas[type_name] = None
+            self.tables[type_name] = FeatureTable.concat([current, merged_delta])
+            self._rebuild_indexes(type_name, stats_cached)
+        else:
+            # stat sketches stay main-table-only while a delta is pending
+            # (GeoMesaStats.update REPLACES the battery — re-observing just
+            # the batch would swap whole-table estimates for batch-only
+            # ones); the estimator drifts by at most the flush threshold
+            # (~2%), and the next flush re-observes everything
+            self.deltas[type_name] = merged_delta
+
+    def flush(self, type_name: str) -> None:
+        """Merge the delta run into the main device index (≙ the Lambda
+        tier's persistence flush). No-op when the delta is empty."""
+        delta = self.deltas.get(type_name)
+        if delta is None:
+            return
+        self.deltas[type_name] = None
+        self.tables[type_name] = FeatureTable.concat(
+            [self.tables[type_name], delta])
+        self._rebuild_indexes(type_name)
+
+    def _delta_rows(self, type_name: str, f, auths) -> "np.ndarray":
+        """Matching row indices WITHIN the delta run (host f64 evaluation —
+        the delta is bounded small, so brute force is exact and cheap)."""
+        import numpy as np
+
+        from geomesa_tpu.filter.evaluate import evaluate as _evaluate
+        from geomesa_tpu.filter.parser import parse_ecql
+
+        delta = self.deltas.get(type_name)
+        if delta is None:
+            return np.empty(0, dtype=np.int64)
+        fir = parse_ecql(f) if isinstance(f, str) else f
+        if isinstance(fir, ir.FidFilter):
+            fids = set(fir.fids)
+            rows = np.array([i for i, fid in enumerate(delta.fids)
+                             if fid in fids], dtype=np.int64)
+        else:
+            rows = np.flatnonzero(_evaluate(fir, delta))
+        if auths is not None and delta.visibility is not None and len(rows):
+            from geomesa_tpu.security.visibility import allowed_codes
+            allowed = allowed_codes(delta.visibility.vocab, auths)
+            rows = rows[np.isin(delta.visibility.codes[rows], allowed)]
+        return rows
 
     def _rebuild_indexes(self, type_name: str,
                          stats_cached: Optional[dict] = None) -> None:
@@ -200,6 +266,14 @@ class TpuDataStore:
     # -- queries ------------------------------------------------------------
 
     def planner(self, type_name: str) -> QueryPlanner:
+        """The type's QueryPlanner over a fully-merged view: any pending
+        delta run flushes first, so external consumers (processes, exports,
+        aggregation helpers) always see exact state. Datastore-level
+        count/query merge the delta inline instead and never force a flush."""
+        self.flush(type_name)
+        return self._main_planner(type_name)
+
+    def _main_planner(self, type_name: str) -> QueryPlanner:
         if type_name not in self.planners:
             if self.tables.get(type_name) is None:
                 raise ValueError(f"No data written to {type_name}")
@@ -217,10 +291,65 @@ class TpuDataStore:
                                                        → packed BIN records
           hints["stats"]   = stat spec string          → Stat sketch
           hints["sample"]  = n | {"n": n, "by": attr?} → sampled QueryResult
+
+        Result-shaping hints compose on the plain path (≙ sort/maxFeatures/
+        transform/reprojection of QueryPlanner.runQuery:56-94):
+
+          hints["sort"]      = attr | "-attr" | [specs]   (stable, major-first)
+          hints["limit"]     = n                          (applied pre-hydration)
+          hints["transform"] = ["attr", "out=expr(...)"]  (projected type)
+          hints["crs"]       = "EPSG:3857"                (output reprojection)
         """
-        planner = self.planner(type_name)
         if not hints:
-            return planner.query(f, auths=auths)
+            planner = self._main_planner(type_name)
+            res = planner.query(f, auths=auths)
+            delta = self.deltas.get(type_name)
+            if delta is None:
+                return res
+            drows = self._delta_rows(type_name, f, auths)
+            # stacked row space: delta rows ride above the main table
+            # (QueryResult.indices document this via the plan's explain;
+            # res.table holds the fully-hydrated rows either way)
+            n_main = len(planner.table)
+            rows = np.concatenate([res.indices, drows + n_main])
+            sub = FeatureTable.concat([res.table, delta.take(drows)]) \
+                if len(drows) else res.table
+            out = QueryResult(rows, sub, res.plan)
+            if res.plan is not None:
+                res.plan.explain["stacked_rows_base"] = n_main
+            return out
+        shaping_keys = {"sort", "limit", "transform", "crs"}
+        if shaping_keys.issuperset(hints):
+            # shaping merges any pending delta INLINE (sort/limit/transform
+            # are host-side anyway) — no flush, the LSM tier stays warm
+            from geomesa_tpu.index.shaping import (reproject_table,
+                                                   shape_local,
+                                                   transform_table)
+            planner = self._main_planner(type_name)
+            plan = planner.plan(f)
+            rows = planner.select_indices(f, plan=plan, auths=auths)
+            delta = self.deltas.get(type_name)
+            if delta is None:
+                from geomesa_tpu.index.shaping import shape_rows
+                rows = shape_rows(planner.table, rows, hints.get("sort"),
+                                  hints.get("limit"))
+                sub = planner.table.take(rows)
+            else:
+                drows = self._delta_rows(type_name, f, auths)
+                sub = FeatureTable.concat(
+                    [planner.table.take(rows), delta.take(drows)])
+                rows = np.concatenate(
+                    [rows, drows + len(planner.table)])
+                local = shape_local(sub, hints.get("sort"),
+                                    hints.get("limit"))
+                rows = rows[local]
+                sub = sub.take(local)
+            if "transform" in hints:
+                sub = transform_table(sub, hints["transform"])
+            if "crs" in hints:
+                sub = reproject_table(sub, hints["crs"])
+            return QueryResult(rows, sub, plan)
+        planner = self.planner(type_name)  # aggregation scans see merged state
         # auths compose with every aggregation hint: the visibility-code
         # mask folds into the device scan (planner._apply_auths) exactly as
         # VisibilityFilter rides the reference's server-side scans
@@ -250,10 +379,17 @@ class TpuDataStore:
 
     def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
               auths: Optional[list] = None) -> int:
-        return self.planner(type_name).count(f, auths=auths)
+        c = self._main_planner(type_name).count(f, auths=auths)
+        if self.deltas.get(type_name) is not None:
+            c += len(self._delta_rows(type_name, f, auths))
+        return c
 
     def explain(self, type_name: str, f: Union[str, ir.Filter]) -> dict:
-        return self.planner(type_name).explain(f)
+        out = self._main_planner(type_name).explain(f)
+        delta = self.deltas.get(type_name)
+        if delta is not None:
+            out["delta_rows"] = len(delta)  # unflushed LSM run merged inline
+        return out
 
     def stats(self, type_name: str):
         """Per-type stats API (≙ GeoMesaDataStore.stats)."""

@@ -197,6 +197,28 @@ class GeometryArray:
         e = self.ring_offsets[self.part_offsets[self.geom_offsets[i + 1]]]
         return self.coords[s:e]
 
+    @classmethod
+    def concat(cls, arrays: Sequence["GeometryArray"]) -> "GeometryArray":
+        """Vectorized concatenation: coords stack, offset levels shift by the
+        running totals (no per-shape Python; the LSM flush path depends on
+        this being O(coords))."""
+        tc = np.concatenate([a.type_codes for a in arrays])
+        go = [np.zeros(1, np.int64)]
+        po = [np.zeros(1, np.int64)]
+        ro = [np.zeros(1, np.int64)]
+        coords = []
+        g_base = p_base = r_base = 0
+        for a in arrays:
+            go.append(np.asarray(a.geom_offsets[1:], dtype=np.int64) + g_base)
+            po.append(np.asarray(a.part_offsets[1:], dtype=np.int64) + p_base)
+            ro.append(np.asarray(a.ring_offsets[1:], dtype=np.int64) + r_base)
+            coords.append(a.coords)
+            g_base += int(a.geom_offsets[-1]) if len(a) else 0
+            p_base += int(a.part_offsets[-1]) if len(a.part_offsets) else 0
+            r_base += int(a.ring_offsets[-1]) if len(a.ring_offsets) else 0
+        return cls(tc, np.concatenate(go), np.concatenate(po),
+                   np.concatenate(ro), np.vstack(coords))
+
     def take(self, idx: np.ndarray) -> "GeometryArray":
         """Gather a subset — vectorized offset rebuild, no per-feature loop."""
         idx = np.asarray(idx, dtype=np.int64)

@@ -41,6 +41,19 @@ class StringColumn:
         vocab, inverse = np.unique(np.asarray(values, dtype=object), return_inverse=True)
         return cls(inverse.astype(np.int32), [str(v) for v in vocab])
 
+    @classmethod
+    def concat(cls, parts: Sequence["StringColumn"]) -> "StringColumn":
+        """Merged-vocab concatenation: codes remap through searchsorted into
+        the sorted union vocab — O(codes), no per-value Python."""
+        union = sorted(set().union(*(p.vocab for p in parts)))
+        uarr = np.asarray(union, dtype=object)
+        out = []
+        for p in parts:
+            remap = np.searchsorted(uarr, np.asarray(p.vocab, dtype=object))
+            out.append(remap[p.codes].astype(np.int32))
+        return cls(np.concatenate(out) if out else np.empty(0, np.int32),
+                   [str(v) for v in union])
+
 
 @dataclass
 class FeatureTable:
@@ -188,24 +201,15 @@ class FeatureTable:
             parts = [t.columns[attr.name] for t in tables]
             first = parts[0]
             if isinstance(first, GeometryArray):
-                shapes = []
-                for p in parts:
-                    shapes.extend(p.shape(i) for i in range(len(p)))
-                cols[attr.name] = GeometryArray.from_shapes(shapes)
+                cols[attr.name] = GeometryArray.concat(parts)
             elif isinstance(first, StringColumn):
-                values = []
-                for p in parts:
-                    values.extend(p.vocab[c] for c in p.codes)
-                cols[attr.name] = StringColumn.encode(values)
+                cols[attr.name] = StringColumn.concat(parts)
             else:
                 cols[attr.name] = np.concatenate(parts)
         vis = None
         if any(t.visibility is not None for t in tables):
-            values: List[str] = []
-            for t in tables:
-                if t.visibility is None:
-                    values.extend([""] * len(t))
-                else:
-                    values.extend(t.visibility.vocab[c] for c in t.visibility.codes)
-            vis = StringColumn.encode(values)
+            vparts = [t.visibility if t.visibility is not None
+                      else StringColumn(np.zeros(len(t), np.int32), [""])
+                      for t in tables]
+            vis = StringColumn.concat(vparts)
         return FeatureTable(sft, fids, cols, vis, _n=len(fids))

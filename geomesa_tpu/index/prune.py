@@ -70,8 +70,9 @@ def slices_to_blocks(slices: np.ndarray, n_rows: int,
         block_size = BLOCK_SIZE
     if len(slices) == 0:
         return None
-    lo_b = slices[:, 0] // block_size
-    hi_b = (slices[:, 1] - 1) // block_size
+    last = max(0, (n_rows - 1) // block_size)
+    lo_b = np.minimum(slices[:, 0] // block_size, last)
+    hi_b = np.minimum((slices[:, 1] - 1) // block_size, last)
     counts = (hi_b - lo_b + 1)
     total = int(counts.sum())
     # expand each [lo_b, hi_b] run with a ragged iota

@@ -92,6 +92,21 @@ PRIMARY_FNS: Dict[str, Callable] = {
 }
 
 
+_EARTH_R_M = 6371008.8
+
+
+def _haversine_f32(lon, lat, qlon, qlat):
+    """Great-circle distance in meters, f32 (matches process/geo.haversine_m
+    up to f32 rounding — callers that need exact ranks re-check in f64)."""
+    rad = jnp.float32(np.pi / 180.0)
+    la1 = lat * rad
+    la2 = qlat * rad
+    dla = (qlat - lat) * rad
+    dlo = (qlon - lon) * rad
+    a = jnp.sin(dla / 2) ** 2 + jnp.cos(la1) * jnp.cos(la2) * jnp.sin(dlo / 2) ** 2
+    return jnp.float32(2 * _EARTH_R_M) * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
 # -- residual predicate compiler --------------------------------------------
 
 
@@ -249,6 +264,19 @@ def _mask_kernel(primary_kind: str, has_time: bool, residual_key: str, n_boxes: 
     return mask
 
 
+def _grid_scatter(xs, ys, mask, weight, grid, width: int, height: int):
+    """Masked scatter-add onto a (height, width) raster. grid =
+    [xmin, ymin, xmax, ymax] f32 (GridSnap.scala:23 snap semantics)."""
+    xmin, ymin, xmax, ymax = grid[0], grid[1], grid[2], grid[3]
+    fx = (xs - xmin) / (xmax - xmin)
+    fy = (ys - ymin) / (ymax - ymin)
+    inb = mask & (fx >= 0) & (fx < 1) & (fy >= 0) & (fy < 1)
+    ix = jnp.clip((fx * width).astype(jnp.int32), 0, width - 1)
+    iy = jnp.clip((fy * height).astype(jnp.int32), 0, height - 1)
+    w = jnp.where(inb, weight if weight is not None else 1.0, 0.0).astype(jnp.float32)
+    return jnp.zeros((height, width), dtype=jnp.float32).at[iy, ix].add(w)
+
+
 class _LazyBlockGather:
     """Dict-like view reading candidate blocks of a column on first access,
     so a pruned scan touches only the columns its mask needs.
@@ -372,14 +400,35 @@ class ScanKernels:
                     return jnp.sum(m if base is None else (m & base))
 
                 return lax.map(one, boxes)
-        elif mode in ("count_blocks", "select_blocks"):
+        elif mode == "density_compact":
+            # heat-map over a full-table mask: compact matching rows first
+            # (nonzero + gather), THEN scatter-add — a TPU scatter prices per
+            # update, so scattering 100M mostly-zero weights (the r3 design)
+            # cost ~1s where compact-then-scatter costs ~1ms. Returns
+            # (grid, true_count); the caller sizes `cap` from a count so
+            # overflow cannot occur on static data.
+            cap, width, height, wname = capacity
+            n = next(iter(self.cols.values())).shape[0]
+
+            def run(cols, boxes, windows, rparams, grid):
+                m = mask_fn(cols, boxes, windows, rparams, residual_fn)
+                sel = jnp.nonzero(m, size=cap, fill_value=n)[0]
+                ok = sel < n
+                seli = jnp.clip(sel, 0, n - 1)
+                xs = cols["xf"][seli]
+                ys = cols["yf"][seli]
+                w = cols[wname][seli].astype(jnp.float32) if wname else None
+                out = _grid_scatter(xs, ys, ok, w, grid, width, height)
+                return out, jnp.sum(m)
+        elif mode in ("count_blocks", "count_multi_blocks", "select_blocks",
+                      "density_blocks", "topk_blocks"):
             # range-pruned gather scan: block ids (pad = -1) expand to row
             # indices with an iota, candidate rows gather from HBM, and the
             # FULL exact mask re-applies — so the host cover only needs to be
             # a superset (≙ scanning the reference's ≤2000 key ranges instead
             # of the table; block granularity plays the tablet-range role).
             n = next(iter(self.cols.values())).shape[0]
-            nblk, bsz, sel_cap = capacity
+            nblk, bsz, sel_cap = capacity[:3]
 
             def blocks_mask(cols, boxes, windows, rparams, block_ids):
                 starts = block_ids * bsz
@@ -395,15 +444,74 @@ class ScanKernels:
                          & (rows < starts[:, None] + bsz)).reshape(-1)
                 g = _LazyBlockGather(cols, astart, bsz, astart.shape[0] * bsz)
                 m = mask_fn(g, boxes, windows, rparams, residual_fn) & valid
-                return m, rows.reshape(-1)
+                return m, rows.reshape(-1), g
 
             if mode == "count_blocks":
                 def run(cols, boxes, windows, rparams, block_ids):
-                    m, _ = blocks_mask(cols, boxes, windows, rparams, block_ids)
+                    m, _, _ = blocks_mask(cols, boxes, windows, rparams, block_ids)
                     return jnp.sum(m)
+            elif mode == "count_multi_blocks":
+                # batched serving: B independent box-queries against the
+                # UNION of their candidate blocks in one dispatch — the
+                # gather happens once, then each box is a cheap mask over
+                # the resident candidates. Per-query cost collapses to
+                # microseconds (the per-dispatch RPC overhead amortizes
+                # across the whole batch).
+                def run(cols, boxes, windows, rparams, block_ids):
+                    starts = block_ids * bsz
+                    astart = jnp.clip(starts, 0, max(0, n - bsz))
+                    rows = (astart[:, None]
+                            + jnp.arange(bsz, dtype=jnp.int32)[None, :])
+                    valid = ((block_ids >= 0)[:, None]
+                             & (rows >= starts[:, None])
+                             & (rows < starts[:, None] + bsz)).reshape(-1)
+                    g = _LazyBlockGather(cols, astart, bsz,
+                                         astart.shape[0] * bsz)
+                    base = valid
+                    if has_time:
+                        base = base & _time_mask(g, windows)
+                    if residual_fn is not None:
+                        base = base & residual_fn(g, rparams)
+                    if "__valid__" in g:
+                        base = base & g["__valid__"]
+
+                    def one(b):
+                        return jnp.sum(
+                            PRIMARY_FNS[primary_kind](g, b[None, :]) & base)
+
+                    from jax import lax
+                    return lax.map(one, boxes)
+            elif mode == "topk_blocks":
+                # pruned KNN: top_k over gathered candidate blocks only.
+                # lax.top_k lowers to a full sort of its operand on TPU, so
+                # shrinking the operand from N rows to nb*block_size is the
+                # entire win (~N/(nb*B) factor); the host drives the radius
+                # bound so the candidate set provably contains the true k
+                # nearest (guarantee re-check in process/knn.py).
+                m_cap = capacity[3]
+
+                def run(cols, boxes, windows, rparams, q, block_ids):
+                    m, rowids, g = blocks_mask(cols, boxes, windows, rparams,
+                                               block_ids)
+                    d = _haversine_f32(g["xf"], g["yf"], q[0], q[1])
+                    d = jnp.where(m, d, jnp.inf)
+                    vals, idxs = jax.lax.top_k(-d, m_cap)
+                    sel = rowids[jnp.clip(idxs, 0, rowids.shape[0] - 1)]
+                    return -vals, sel.astype(jnp.int32)
+            elif mode == "density_blocks":
+                # pruned heat-map: candidate blocks gather (contiguous HBM
+                # bursts) + masked scatter of only nb*block_size rows
+                width, height, wname = capacity[3:]
+
+                def run(cols, boxes, windows, rparams, grid, block_ids):
+                    m, _, g = blocks_mask(cols, boxes, windows, rparams, block_ids)
+                    w = g[wname].astype(jnp.float32) if wname else None
+                    out = _grid_scatter(g["xf"], g["yf"], m, w, grid,
+                                        width, height)
+                    return out, jnp.sum(m)
             else:
                 def run(cols, boxes, windows, rparams, block_ids):
-                    m, rowids = blocks_mask(cols, boxes, windows, rparams, block_ids)
+                    m, rowids, _ = blocks_mask(cols, boxes, windows, rparams, block_ids)
                     total = m.shape[0]
                     sel = jnp.nonzero(m, size=sel_cap, fill_value=total)[0]
                     rows = jnp.where(sel < total,
@@ -411,6 +519,22 @@ class ScanKernels:
                     return jnp.concatenate([
                         jnp.sum(m)[None].astype(jnp.int32),
                         rows.astype(jnp.int32)])
+        elif mode == "topk":
+            # device KNN: haversine distance + lax.top_k as ONE fused
+            # reduction over the table (the reference's expanding-radius
+            # iteration — KNearestNeighborSearchProcess — exists because
+            # storage scans price by range; a TPU prices by full-array
+            # reductions, so the whole search is a single kernel + one small
+            # readback). Distances are f32; callers re-rank the top-m margin
+            # exactly on host (m >= 2k makes f32 rank noise harmless).
+            m_cap = capacity
+
+            def run(cols, boxes, windows, rparams, q):
+                m = mask_fn(cols, boxes, windows, rparams, residual_fn)
+                d = _haversine_f32(cols["xf"], cols["yf"], q[0], q[1])
+                d = jnp.where(m, d, jnp.inf)
+                vals, idxs = jax.lax.top_k(-d, m_cap)
+                return -vals, idxs.astype(jnp.int32)
         elif mode == "select_packed":
             # single-roundtrip select: [count, idx...] in ONE int32 array so
             # the host pays a single device-fetch latency (transfers/dispatch
@@ -573,6 +697,106 @@ class ScanKernels:
             if cnt <= capacity:
                 return out[1: 1 + cnt].astype(np.int64), cnt
             capacity = 1 << int(np.ceil(np.log2(cnt)))
+
+    def prepare_counts_multi_blocks(self, primary_kind, boxes: np.ndarray,
+                                    windows, residual, blocks: np.ndarray,
+                                    block_size: int):
+        """Zero-arg async dispatcher → per-box count device array for a
+        whole batch of box-queries over their union candidate blocks (the
+        batched serving path — per-query device cost is microseconds once
+        the per-dispatch overhead amortizes; pipeline several batches to
+        amortize the round trip too)."""
+        b = self._pad_blocks(blocks)
+        bx = pad_boxes(boxes)
+        fn = self._get("count_multi_blocks", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       bx.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       (b.shape[0], block_size, 0))
+        cols = self.cols
+        dbx, w = _dev(bx), _dev(windows)
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        db = jnp.asarray(b)
+        return lambda: fn(cols, dbx, w, rp, db)
+
+    def counts_multi_blocks(self, primary_kind, boxes: np.ndarray, windows,
+                            residual, blocks: np.ndarray,
+                            block_size: int) -> np.ndarray:
+        """Blocking counterpart of ``prepare_counts_multi_blocks``."""
+        out = np.asarray(self.prepare_counts_multi_blocks(
+            primary_kind, boxes, windows, residual, blocks, block_size)())
+        return out[: len(boxes)]
+
+    def prepare_density_compact(self, primary_kind, boxes, windows, residual,
+                                grid_bbox, width: int, height: int,
+                                cap: int, wname: Optional[str]):
+        """Zero-arg dispatcher → ((H, W) grid device array, count scalar).
+        ``cap`` must be >= the match count (size it from a count query)."""
+        fn = self._get("density_compact", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       (cap, width, height, wname))
+        cols = self.cols
+        bx, w = _dev(boxes), _dev(windows)
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        g = jnp.asarray(np.asarray(grid_bbox, dtype=np.float32))
+        return lambda: fn(cols, bx, w, rp, g)
+
+    def prepare_density_blocks(self, primary_kind, boxes, windows, residual,
+                               grid_bbox, width: int, height: int,
+                               blocks: np.ndarray, block_size: int,
+                               wname: Optional[str]):
+        """Zero-arg dispatcher for the range-pruned heat-map."""
+        b = self._pad_blocks(blocks)
+        fn = self._get("density_blocks", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       (b.shape[0], block_size, 0, width, height, wname))
+        cols = self.cols
+        bx, w = _dev(boxes), _dev(windows)
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        g = jnp.asarray(np.asarray(grid_bbox, dtype=np.float32))
+        db = jnp.asarray(b)
+        return lambda: fn(cols, bx, w, rp, g, db)
+
+    def topk_nearest_blocks(self, primary_kind, boxes, windows, residual,
+                            qx: float, qy: float, m: int,
+                            blocks: np.ndarray, block_size: int):
+        """Pruned variant of ``topk_nearest``: distances + top_k over the
+        candidate blocks only."""
+        b = self._pad_blocks(blocks)
+        m = min(m, b.shape[0] * block_size)
+        fn = self._get("topk_blocks", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       (b.shape[0], block_size, 0, m))
+        q = jnp.asarray(np.array([qx, qy], dtype=np.float32))
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        vals, idxs = fn(self.cols, _dev(boxes), _dev(windows), rp, q,
+                        jnp.asarray(b))
+        return np.asarray(vals), np.asarray(idxs)
+
+    def topk_nearest(self, primary_kind, boxes, windows, residual,
+                     qx: float, qy: float, m: int):
+        """(distances_m f32, sorted-order positions int32) of the m nearest
+        masked rows to (qx, qy) — one kernel, one small readback. Distances
+        are +inf past the number of matching rows."""
+        fn = self._get("topk", primary_kind, windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0], m)
+        q = jnp.asarray(np.array([qx, qy], dtype=np.float32))
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        vals, idxs = fn(self.cols, _dev(boxes), _dev(windows), rp, q)
+        return np.asarray(vals), np.asarray(idxs)
 
     def select(self, primary_kind, boxes, windows, residual, capacity: int):
         """Returns (sorted-row indices ndarray, true_count) in one roundtrip.

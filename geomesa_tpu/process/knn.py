@@ -1,17 +1,21 @@
 """K-nearest-neighbor search.
 
 ≙ reference `KNearestNeighborSearchProcess` (geomesa-process/.../query/
-KNearestNeighborSearchProcess.scala): iterative expanding-radius queries
-against the index until enough candidates exist, then exact distance ranking.
+KNearestNeighborSearchProcess.scala): the reference iterates expanding-radius
+index queries because a storage scan prices by key range. A TPU prices by
+full-array reductions, so the whole search is ONE fused kernel: mask (the
+optional filter) → haversine distance → `lax.top_k` → a k-sized readback.
+No radius schedule, no candidate pull, no guarantee re-query.
 
-TPU shape of the search: the radius-doubling "loop" is not a loop of blocking
-queries — every candidate radius shares one compiled count kernel (same box
-shape), so ALL radii dispatch asynchronously up front and a single stacked
-readback returns every count (one host↔device round trip for the whole
-doubling schedule). The final candidate pull sizes its select capacity from
-the already-known count, so no overflow-retry rescans happen; the guarantee
-pass re-queries at the k-th distance so no closer feature outside the last
-bbox is missed.
+Exactness: device distances are f32, so the kernel returns a top-`m` margin
+(m >= 2k) and the host re-ranks those m candidates in f64 — rank noise from
+f32 rounding (~1e-7 relative) cannot push a true top-k member out of a 2k
+margin unless distances tie at that precision, in which case either ordering
+is a correct KNN result.
+
+The expanding-radius path survives as the fallback for plans the device
+kernel can't serve (extent layers without point coords, host residuals,
+k beyond the kernel tier cap).
 """
 
 from __future__ import annotations
@@ -25,6 +29,7 @@ from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.process.geo import expand_bbox, haversine_m
 
 _WORLD = (-180.0, -90.0, 180.0, 90.0)
+_MAX_DEVICE_K = 2048
 
 
 def knn(planner, x: float, y: float, k: int,
@@ -37,16 +42,112 @@ def knn(planner, x: float, y: float, k: int,
     geom = planner.sft.geometry_attribute
     if geom is None:
         raise ValueError("KNN requires a geometry attribute")
+    if k <= 0:
+        return np.empty(0, dtype=np.int64), np.empty(0)
+
+    plan = planner.plan(f if f is not None else ir.Include())
+    device_ok = (not plan.empty and plan.primary_kind != "fid"
+                 and plan.residual_host is None
+                 and plan.candidate_slices is None and plan.index is not None
+                 and "xf" in plan.index.device.columns
+                 and k <= _MAX_DEVICE_K)
+    if device_ok:
+        return _device_knn(planner, plan, x, y, k, f=f,
+                           initial_radius_m=initial_radius_m)
+    if plan.empty:
+        return np.empty(0, dtype=np.int64), np.empty(0)
+    return _radius_knn(planner, x, y, k, f, initial_radius_m, max_doublings)
+
+
+def _device_knn(planner, plan, x: float, y: float, k: int,
+                f=None, initial_radius_m: float = 1000.0):
+    """Device KNN with a host-driven radius bound.
+
+    The search radius grows HOST-SIDE: the range cover's candidate-row count
+    (pure host binary searches over the sorted keys — zero device traffic)
+    tells us when a bbox plausibly holds >= k matches. One device dispatch
+    then runs distance + top_k over just the candidate blocks (lax.top_k is
+    a full sort on TPU, so operand size is everything: candidate blocks make
+    KNN cost flat in table size). The classic inscribed-circle guarantee
+    re-runs wider when the k-th distance exceeds the radius — so results are
+    exactly the global k nearest."""
+    m = max(16, 1 << (max(2 * k, k + 16) - 1).bit_length())
+    geom = planner.sft.geometry_attribute
+    index = plan.index
 
     def with_bbox(radius_m):
         bbox = ir.BBox(geom.name, *expand_bbox(x, y, radius_m))
         return bbox if f is None or isinstance(f, ir.Include) \
             else ir.and_filters([f, bbox])
 
-    # doubling schedule (stops once a bbox covers the world)
+    r = float(initial_radius_m)
+    for _ in range(40):
+        whole_world = expand_bbox(x, y, r) == _WORLD
+        plan_r = planner.plan(plan.full_filter if whole_world else with_bbox(r))
+        if not (plan_r.residual_host is None and plan_r.candidate_slices is None
+                and plan_r.index is index):
+            break  # composition changed the plan shape: full-table kernel
+        blocks = planner._pruned_blocks(plan_r)
+        if blocks is None:
+            break  # no cover (wide bbox / tiny table): full-table kernel
+        # candidate rows are free to evaluate (host binary searches), so aim
+        # well past k: a generous candidate set makes the inscribed-circle
+        # guarantee pass on the FIRST dispatch almost always — each failed
+        # guarantee costs a full device round trip, each extra radius step
+        # only ~5ms of host cover work
+        enough = plan_r.explain.get("candidate_rows", 0) >= max(32 * k, 2048)
+        if not (enough or whole_world):
+            r *= 8
+            continue
+        from geomesa_tpu.index import prune as _prune
+        dists, pos = index.kernels.topk_nearest_blocks(
+            plan_r.primary_kind, plan_r.boxes_loose, plan_r.windows,
+            plan_r.residual_device, x, y, m, blocks, _prune.BLOCK_SIZE)
+        valid = np.isfinite(dists)
+        kth_ok = valid.sum() >= k and float(np.sort(dists[valid])[k - 1]) <= r
+        if whole_world or kth_ok:
+            return _exact_rerank(planner, index, pos[valid], x, y, k)
+        # fewer than k in radius, or the k-th may lie outside the bbox
+        r = max(r * 4, float(np.sort(dists[valid])[min(valid.sum(), k) - 1])
+                * 1.001 if valid.any() else r * 4)
+    else:
+        return np.empty(0, dtype=np.int64), np.empty(0)
+
+    dists, pos = index.kernels.topk_nearest(
+        plan.primary_kind, plan.boxes_loose, plan.windows,
+        plan.residual_device, x, y, m)
+    valid = np.isfinite(dists)
+    return _exact_rerank(planner, index, pos[valid], x, y, k)
+
+
+def _exact_rerank(planner, index, pos: np.ndarray, x: float, y: float, k: int):
+    rows = index.perm[pos.astype(np.int64)]
+    if len(rows) == 0:
+        return rows, np.empty(0)
+    gx, gy = planner.table.geometry().point_xy()
+    d = haversine_m(gx[rows], gy[rows], x, y)
+    take = min(k, len(d))
+    part = np.argpartition(d, take - 1)[:take]
+    order = part[np.argsort(d[part], kind="stable")]
+    return rows[order], d[order]
+
+
+# -- expanding-radius fallback (reference-shaped) ---------------------------
+
+
+def _radius_knn(planner, x, y, k, f, initial_radius_m, max_doublings):
+    geom = planner.sft.geometry_attribute
+
+    def with_bbox(radius_m):
+        bbox = ir.BBox(geom.name, *expand_bbox(x, y, radius_m))
+        return bbox if f is None or isinstance(f, ir.Include) \
+            else ir.and_filters([f, bbox])
+
+    # doubling schedule (stops once a bbox covers the world); always at
+    # least the initial radius, so max_doublings < 1 degrades gracefully
     radii = []
     r = float(initial_radius_m)
-    for _ in range(max_doublings):
+    for _ in range(max(1, max_doublings)):
         radii.append(r)
         if expand_bbox(x, y, r) == _WORLD:
             break

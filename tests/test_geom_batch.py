@@ -2,6 +2,7 @@
 perf budget pin so the XZ2-refine pathology can't regress (VERDICT r2 weak #2:
 the per-feature Python refine made st_intersects 215x slower than CPU)."""
 
+import os
 import time
 
 import numpy as np
@@ -112,10 +113,13 @@ def test_batch_subset_and_empty(arr):
     assert gb.batch_intersects(arr, np.empty(0, np.int64), lit).shape == (0,)
 
 
+@pytest.mark.skipif(os.environ.get("GEOMESA_TPU_SKIP_PERF") == "1",
+                    reason="wall-clock pin skipped on loaded hosts")
 def test_refine_perf_budget():
     """100k 2-vertex linestrings refined against a polygon within a 500ms
     budget (typ. ~60ms; the scalar loop took ~0.18ms/feature = 18s) — pins
-    the vectorized refine against regression to per-feature evaluation."""
+    the vectorized refine against regression to per-feature evaluation.
+    Opt out with GEOMESA_TPU_SKIP_PERF=1 when the host is contended."""
     rng = np.random.default_rng(7)
     n = 100_000
     lx = rng.uniform(-30, 30, n)

@@ -1,0 +1,143 @@
+"""LSM delta tier: small appends land in a host-side delta run (no index
+rebuild); count/query stay exact across the main/delta boundary; the delta
+flushes into the device index past the threshold (≙ the Lambda store's hot
+tier shadowing the cold tier, LambdaDataStore.scala:180)."""
+
+import time
+
+import numpy as np
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+
+
+def _mk(n, seed, base_day=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-30, 30, n)
+    y = rng.uniform(-30, 30, n)
+    base = np.datetime64("2022-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + base_day * 86400000 + rng.integers(0, 5 * 86400000, n)
+    v = rng.integers(0, 100, n).astype(np.int32)
+    return x, y, dtg, v
+
+
+def _store(n=200_000, seed=1):
+    x, y, dtg, v = _mk(n, seed)
+    ds = TpuDataStore()
+    ds.create_schema("t", "v:Int,dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    ds.load("t", FeatureTable.build(ds.get_schema("t"),
+                                    {"v": v, "dtg": dtg, "geom": (x, y)}))
+    return ds, (x, y, dtg, v)
+
+
+Q = "BBOX(geom, -10, -10, 10, 10) AND v < 50"
+
+
+def _ref_count(parts):
+    tot = 0
+    for x, y, dtg, v in parts:
+        tot += int(np.sum((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)
+                          & (v < 50)))
+    return tot
+
+
+def test_delta_append_is_cheap_and_exact():
+    ds, main = _store()
+    t0 = time.perf_counter()
+    rebuild_s = None
+    # measure a full rebuild for comparison (load a same-size store)
+    ds2, _ = _store(seed=1)
+    rebuild_s = time.perf_counter() - t0
+
+    x2, y2, dtg2, v2 = _mk(2_000, 7)  # 1% append
+    t0 = time.perf_counter()
+    ds.load("t", FeatureTable.build(ds.get_schema("t"),
+                                    {"v": v2, "dtg": dtg2, "geom": (x2, y2)}))
+    append_s = time.perf_counter() - t0
+    assert ds.deltas["t"] is not None, "append did not take the delta path"
+    assert append_s < 0.25 * rebuild_s, (append_s, rebuild_s)
+
+    assert ds.count("t", Q) == _ref_count([main, (x2, y2, dtg2, v2)])
+    r = ds.query("t", Q)
+    assert r.count == ds.count("t", Q)
+    # hydrated rows include delta features
+    n_main = len(ds.tables["t"])
+    assert (r.indices >= n_main).sum() == _ref_count([(x2, y2, dtg2, v2)])
+
+
+def test_multiple_delta_appends_then_flush():
+    ds, main = _store(n=100_000)
+    parts = [main]
+    for i in range(3):
+        xb, yb, db, vb = _mk(500, 20 + i)
+        parts.append((xb, yb, db, vb))
+        ds.load("t", FeatureTable.build(
+            ds.get_schema("t"), {"v": vb, "dtg": db, "geom": (xb, yb)}))
+    assert len(ds.deltas["t"]) == 1500
+    expected = _ref_count(parts)
+    assert ds.count("t", Q) == expected
+    ds.flush("t")
+    assert ds.deltas["t"] is None
+    assert len(ds.tables["t"]) == 101_500
+    assert ds.count("t", Q) == expected
+
+
+def test_threshold_triggers_auto_flush():
+    ds, main = _store(n=100_000)
+    xb, yb, db, vb = _mk(60_000, 33)  # above the 50k floor
+    ds.load("t", FeatureTable.build(
+        ds.get_schema("t"), {"v": vb, "dtg": db, "geom": (xb, yb)}))
+    assert ds.deltas["t"] is None, "large batch should flush through"
+    assert len(ds.tables["t"]) == 160_000
+    assert ds.count("t", Q) == _ref_count([main, (xb, yb, db, vb)])
+
+
+def test_hint_queries_see_merged_state():
+    ds, main = _store(n=60_000)
+    xb, yb, db, vb = _mk(1_000, 41)
+    ds.load("t", FeatureTable.build(
+        ds.get_schema("t"), {"v": vb, "dtg": db, "geom": (xb, yb)}))
+    assert ds.deltas["t"] is not None
+    g = ds.query("t", "INCLUDE", hints={
+        "density": {"bbox": (-30, -30, 30, 30), "width": 16, "height": 16}})
+    assert int(g.weights.sum()) == 61_000  # delta flushed into the aggregate
+    assert ds.deltas["t"] is None
+
+
+def test_delta_respects_visibilities():
+    ds, _ = _store(n=60_000)
+    xb, yb, db, vb = _mk(300, 55)
+    ds.load("t", FeatureTable.build(
+        ds.get_schema("t"), {"v": vb, "dtg": db, "geom": (xb, yb)},
+        visibilities=["secret"] * 300))
+    n_public = ds.count("t", "INCLUDE", auths=[])
+    n_admin = ds.count("t", "INCLUDE", auths=["secret"])
+    assert n_admin - n_public == 300
+
+
+def test_writer_appends_take_delta_path():
+    ds, main = _store(n=80_000)
+    with ds.get_writer("t") as w:
+        for i in range(50):
+            w.write(v=int(i), dtg=np.datetime64("2022-01-02T00:00:00"),
+                    geom="POINT (1 2)")
+    assert ds.deltas["t"] is not None and len(ds.deltas["t"]) == 50
+    assert ds.count("t", "BBOX(geom, 0.9, 1.9, 1.1, 2.1) AND v < 50") == 50
+
+
+def test_shaping_merges_delta_inline():
+    """Sort/limit hints merge the delta without flushing (LSM stays warm)."""
+    ds, main = _store(n=60_000)
+    xb, yb, db, vb = _mk(400, 61)
+    ds.load("t", FeatureTable.build(
+        ds.get_schema("t"), {"v": vb, "dtg": db, "geom": (xb, yb)}))
+    assert ds.deltas["t"] is not None
+    r = ds.query("t", "INCLUDE", hints={"sort": "-v", "limit": 30})
+    assert ds.deltas["t"] is not None, "shaping must not flush"
+    assert r.count == 30
+    vals = np.asarray(r.table.columns["v"])
+    assert np.all(np.diff(vals) <= 0)
+    # the global top values must include delta rows when they qualify
+    allv = np.concatenate([main[3], vb])
+    np.testing.assert_array_equal(np.sort(vals)[::-1],
+                                  np.sort(allv)[::-1][:30])

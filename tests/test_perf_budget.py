@@ -1,0 +1,69 @@
+"""Wall-clock budget pins for the aggregate/process hot paths.
+
+Gated behind GEOMESA_TPU_PERF_TESTS=1 (absolute-time pins flake on loaded CI
+hosts — the advisor's r3 finding); bench.py enforces the real bars at 100M on
+TPU hardware every round. Run explicitly with:
+
+    GEOMESA_TPU_PERF_TESTS=1 python -m pytest tests/test_perf_budget.py
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("GEOMESA_TPU_PERF_TESTS") != "1",
+    reason="perf pins run only with GEOMESA_TPU_PERF_TESTS=1")
+
+
+@pytest.fixture(scope="module")
+def world():
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.features.table import FeatureTable
+    rng = np.random.default_rng(99)
+    n = 2_000_000
+    x = np.clip(rng.normal(0, 40, n), -180, 180)
+    y = np.clip(rng.normal(0, 20, n), -90, 90)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + rng.integers(0, 30 * 86400000, n)
+    ds = TpuDataStore()
+    ds.create_schema("perf", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    ds.load("perf", FeatureTable.build(ds.get_schema("perf"),
+                                       {"dtg": dtg, "geom": (x, y)}))
+    return ds.planner("perf")
+
+
+def _p50(fn, reps=5):
+    fn()  # warm (compiles excluded — the pins are steady-state budgets)
+    lat = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        lat.append(time.perf_counter() - t0)
+    return float(np.median(lat)) * 1000
+
+
+def test_density_budget(world):
+    from geomesa_tpu.aggregates.density import prepare_density
+    run = prepare_density(world, "BBOX(geom, -10, 5, 10, 25)",
+                          (-10, 5, 10, 25), 512, 512)
+    assert _p50(run) < 500, "density p50 budget (500ms at 2M steady-state)"
+
+
+def test_knn_budget(world):
+    from geomesa_tpu.process.knn import knn
+    knn(world, 2.0, 10.0, 10)  # warm
+    lat = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        knn(world, 2.0 + i * 0.1, 10.0, 10)
+        lat.append(time.perf_counter() - t0)
+    assert float(np.median(lat)) * 1000 < 2000, "knn p50 budget (2s bar)"
+
+
+def test_pruned_count_budget(world):
+    pq = world.prepare("BBOX(geom, -10, 5, 10, 25) AND "
+                       "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+    assert _p50(pq.count) < 500, "pruned count p50 budget"

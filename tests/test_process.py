@@ -160,3 +160,31 @@ def test_date_offset(world):
     rows = planner.select_indices("v = 1")
     assert np.array_equal(np.asarray(out.columns["dtg"]),
                           data["dtg"][rows] + 3600_000)
+
+
+def test_knn_zero_doublings_fallback(world):
+    """max_doublings < 1 must degrade to a single-radius query, not crash
+    (the radius schedule guarantees at least the initial radius)."""
+    planner, data, _ = world
+    from geomesa_tpu.process.knn import _radius_knn
+    rows, dists = _radius_knn(planner, 5.0, 5.0, 5, None,
+                              initial_radius_m=500_000.0, max_doublings=0)
+    ref_d = haversine_m(data["x"], data["y"], 5.0, 5.0)
+    ref_rows = np.argsort(ref_d, kind="stable")[:5]
+    assert np.array_equal(np.sort(rows), np.sort(ref_rows))
+
+
+def test_knn_host_residual_filter_falls_back(world):
+    """A filter the device can't fully evaluate (polygon intersects on a
+    point layer -> host residual) still returns exact KNN via the
+    expanding-radius path."""
+    planner, data, _ = world
+    f = "INTERSECTS(geom, POLYGON ((-20 -20, 20 -21, 21 20, -21 19, -20 -20)))"
+    rows, dists = knn(planner, 0.0, 0.0, 8, f=f)
+    assert len(rows) == 8
+    from geomesa_tpu.filter.parser import parse_ecql
+    from geomesa_tpu.filter.evaluate import evaluate
+    mask = evaluate(parse_ecql(f), planner.table)
+    ref_d = haversine_m(data["x"], data["y"], 0.0, 0.0)
+    ref = np.argsort(np.where(mask, ref_d, np.inf), kind="stable")[:8]
+    assert np.array_equal(np.sort(rows), np.sort(ref))

@@ -199,3 +199,24 @@ def test_fraction_gate_declines(monkeypatch):
     rows = planner.select_indices("BBOX(geom, -90, -45, 90, 45)")
     expected = np.flatnonzero((x >= -90) & (x <= 90) & (y >= -45) & (y <= 45))
     np.testing.assert_array_equal(rows, expected)
+
+
+def test_counts_multi_blocks_parity():
+    """Batched per-box counts over union candidate blocks == individual
+    pruned counts."""
+    sft, table, x, y, dtg = _z3_setup()
+    idx = Z3Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    qs = [f"BBOX(geom, {-10+i}, {30+i}, {10+i}, {45+i}) AND "
+          "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z"
+          for i in range(5)]
+    plans = [planner.plan(q) for q in qs]
+    blist = [planner._pruned_blocks(p) for p in plans]
+    assert all(b is not None for b in blist)
+    union = np.unique(np.concatenate([b for b in blist if len(b)]))
+    boxes = np.concatenate([p.boxes_loose[:1] for p in plans], axis=0)
+    counts = idx.kernels.counts_multi_blocks(
+        "point_boxes", boxes, plans[0].windows, plans[0].residual_device,
+        union, prune.BLOCK_SIZE)
+    singles = [planner.count(q) for q in qs]
+    np.testing.assert_array_equal(counts, singles)

@@ -1,0 +1,122 @@
+"""Query result shaping end-to-end through TpuDataStore.query: sort, limit,
+transform projection/derivation, and CRS reprojection (≙ the reference's
+QueryPlanner.runQuery client chain + QueryRunner hints)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(31)
+    n = 30_000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    base = np.datetime64("2021-03-01T00:00:00", "ms").astype(np.int64)
+    data = {
+        "name": rng.choice(["delta", "alpha", "charlie", "bravo"], n),
+        "v": rng.integers(-500, 500, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 20 * 86400000, n),
+        "geom": (x, y),
+    }
+    ds = TpuDataStore()
+    ds.create_schema("s", "name:String,v:Int,dtg:Date,*geom:Point")
+    ds.load("s", FeatureTable.build(ds.get_schema("s"), data))
+    return ds, data, x, y
+
+
+Q = "BBOX(geom, -20, -20, 20, 20)"
+
+
+def _mask(data, x, y):
+    return (x >= -20) & (x <= 20) & (y >= -20) & (y <= 20)
+
+
+def test_sort_ascending_and_descending(store):
+    ds, data, x, y = store
+    r = ds.query("s", Q, hints={"sort": "v"})
+    vals = np.asarray(r.table.columns["v"])
+    assert np.all(np.diff(vals) >= 0)
+    assert r.count == int(_mask(data, x, y).sum())
+    r2 = ds.query("s", Q, hints={"sort": "-v"})
+    assert np.all(np.diff(np.asarray(r2.table.columns["v"])) <= 0)
+
+
+def test_sort_by_string_attribute(store):
+    ds, data, x, y = store
+    r = ds.query("s", Q, hints={"sort": "name", "limit": 100})
+    names = r.table.columns["name"].decode(np.arange(r.count))
+    assert names == sorted(names)
+    assert r.count == 100
+
+
+def test_sort_multi_key_stable(store):
+    ds, data, x, y = store
+    r = ds.query("s", Q, hints={"sort": ["name", "v"]})
+    names = r.table.columns["name"].decode(np.arange(r.count))
+    vals = np.asarray(r.table.columns["v"])
+    for i in range(1, r.count):
+        assert (names[i - 1], vals[i - 1]) <= (names[i], vals[i])
+
+
+def test_limit_matches_head_of_sorted(store):
+    ds, data, x, y = store
+    full = ds.query("s", Q, hints={"sort": "v"})
+    lim = ds.query("s", Q, hints={"sort": "v", "limit": 17})
+    assert lim.count == 17
+    np.testing.assert_array_equal(lim.indices, full.indices[:17])
+
+
+def test_transform_projection_and_expression(store):
+    ds, data, x, y = store
+    r = ds.query("s", Q, hints={
+        "transform": ["name", "doubled=add($v,$v)"], "limit": 50})
+    assert [a.name for a in r.table.sft.attributes] == ["name", "doubled"]
+    vals = np.asarray(ds.planner("s").table.columns["v"])[r.indices]
+    np.testing.assert_allclose(np.asarray(r.table.columns["doubled"]),
+                               vals * 2.0)
+
+
+def test_crs_reprojection(store):
+    ds, data, x, y = store
+    r = ds.query("s", Q, hints={"crs": "EPSG:3857", "limit": 200})
+    gx, gy = r.table.geometry().point_xy()
+    sx = x[r.indices]
+    sy = y[r.indices]
+    R = 6378137.0
+    np.testing.assert_allclose(gx, R * np.radians(sx), rtol=1e-12)
+    np.testing.assert_allclose(
+        gy, R * np.log(np.tan(np.pi / 4 + np.radians(sy) / 2)), rtol=1e-12)
+
+
+def test_crs_roundtrip():
+    from geomesa_tpu.features.crs import transformer
+    x = np.array([-179.0, 0.0, 12.345, 179.0])
+    y = np.array([-80.0, 0.0, 45.0, 80.0])
+    fwd = transformer("EPSG:4326", "EPSG:3857")
+    inv = transformer("EPSG:3857", "EPSG:4326")
+    rx, ry = inv(*fwd(x, y))
+    np.testing.assert_allclose(rx, x, atol=1e-9)
+    np.testing.assert_allclose(ry, y, atol=1e-9)
+
+
+def test_shaping_composes_with_auths():
+    rng = np.random.default_rng(5)
+    n = 5000
+    x = rng.uniform(-10, 10, n)
+    y = rng.uniform(-10, 10, n)
+    vis = rng.choice(["admin", "", "secret&admin"], n)
+    ds = TpuDataStore()
+    ds.create_schema("va", "v:Int,*geom:Point")
+    ds.load("va", FeatureTable.build(
+        ds.get_schema("va"),
+        {"v": rng.integers(0, 9, n).astype(np.int32), "geom": (x, y)},
+        visibilities=list(vis)))
+    r = ds.query("va", "INCLUDE", hints={"sort": "v", "limit": 10},
+                 auths=["admin"])
+    assert r.count == 10
+    allowed = np.isin(vis, ["admin", ""])
+    assert np.all(allowed[r.indices])
