@@ -337,11 +337,14 @@ def main() -> None:
         ly = rng.uniform(-85, 80, n2)
         dx = rng.uniform(0.01, 2.0, n2)
         dy = rng.uniform(0.01, 2.0, n2)
-        from geomesa_tpu.features.geometry import GeometryArray, LINESTRING
+        from geomesa_tpu.features.geometry import GeometryArray
         t0 = time.perf_counter()
-        shapes = [(LINESTRING, [[lx[i], ly[i]], [lx[i] + dx[i], ly[i] + dy[i]]])
-                  for i in range(n2)]
-        garr = GeometryArray.from_shapes(shapes)
+        coords = np.empty((2 * n2, 2), dtype=np.float64)
+        coords[0::2, 0] = lx
+        coords[0::2, 1] = ly
+        coords[1::2, 0] = lx + dx
+        coords[1::2, 1] = ly + dy
+        garr = GeometryArray.linestrings(coords)
         table2 = FeatureTable.build(sft2, {"geom": garr})
         idx2 = XZ2Index(sft2, table2)
         jax.block_until_ready(idx2.device.columns["bxmin_i"])
@@ -411,9 +414,12 @@ def main() -> None:
             detail["cfg4_density_warm_s"] = round(time.perf_counter() - t0, 2)
             lat4 = _time_reps(drun, max(5, reps // 2))
             detail["cfg4_density_512_p50_ms"] = round(_p50(lat4), 2)
-            detail["cfg4_density_mass"] = int(dg.weights.sum())
-            assert detail["cfg4_density_mass"] == detail.get(
-                "cfg1_matched", detail["cfg4_density_mass"])
+            mass = int(dg.weights.sum(dtype=np.float64))
+            detail["cfg4_density_mass"] = mass
+            # f32 grid-snap vs exact fp62 mask may disagree on an O(1)-point
+            # band (~1 f32 ulp) along the bbox edge — bound, don't equate
+            ref_mass = detail.get("cfg1_matched", mass)
+            assert abs(mass - ref_mass) <= 16, (mass, ref_mass)
             # dispatch-only (device render cost; no 1MB grid readback)
             d0 = drun.dispatch()
             jax.block_until_ready(d0)

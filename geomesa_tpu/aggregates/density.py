@@ -41,15 +41,12 @@ class DensityGrid:
 def density_kernel(mask: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray,
                    grid: jnp.ndarray, width: int, height: int,
                    weight: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Pure scatter-add: (H, W) grid of weights. grid = [xmin,ymin,xmax,ymax]."""
-    xmin, ymin, xmax, ymax = grid[0], grid[1], grid[2], grid[3]
-    fx = (x - xmin) / (xmax - xmin)
-    fy = (y - ymin) / (ymax - ymin)
-    inb = (fx >= 0) & (fx < 1) & (fy >= 0) & (fy < 1)
-    ix = jnp.clip((fx * width).astype(jnp.int32), 0, width - 1)
-    iy = jnp.clip((fy * height).astype(jnp.int32), 0, height - 1)
-    w = jnp.where(mask & inb, weight if weight is not None else 1.0, 0.0).astype(jnp.float32)
-    return jnp.zeros((height, width), dtype=jnp.float32).at[iy, ix].add(w)
+    """Pure scatter-add: (H, W) grid of weights. grid = [xmin,ymin,xmax,ymax].
+    The snap semantics live in index/scan._grid_scatter (one home; the
+    compact/pruned device paths use it directly — this wrapper serves the
+    mesh/dist full-mask path)."""
+    from geomesa_tpu.index.scan import _grid_scatter
+    return _grid_scatter(x, y, mask, weight, grid, width, height)
 
 
 _COMPACT_TIERS = (1 << 17, 1 << 20, 1 << 23)
@@ -79,10 +76,13 @@ def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
         return run_empty
 
     idx = plan.index
+    weight_on_device = weight_attr is None or (
+        idx is not None and weight_attr in idx.device.columns
+        and planner.sft.attribute(weight_attr).type_name in
+        ("Int", "Integer", "Long", "Float", "Double"))
     device_ok = (plan.primary_kind != "fid" and plan.residual_host is None
                  and plan.candidate_slices is None and idx is not None
-                 and "xf" in idx.device.columns
-                 and (weight_attr is None or weight_attr in idx.device.columns))
+                 and "xf" in idx.device.columns and weight_on_device)
     if device_ok:
         from geomesa_tpu.index import prune as _prune
 
@@ -148,4 +148,3 @@ def _host_density(planner, f, plan, bbox, width, height, weight_attr,
     return DensityGrid(tuple(bbox), width, height, weights)
 
 
-_jit_density_fn = jax.jit(density_kernel, static_argnames=("width", "height"))

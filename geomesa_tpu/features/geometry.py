@@ -198,6 +198,28 @@ class GeometryArray:
         return self.coords[s:e]
 
     @classmethod
+    def linestrings(cls, coords: np.ndarray,
+                    offsets: Optional[np.ndarray] = None) -> "GeometryArray":
+        """Bulk LineString constructor from flat coordinate buffers — the
+        vectorized ingest path (building a Python shape list for millions of
+        segments costs minutes; this is O(coords) numpy).
+
+        coords: (M, 2) float64 vertices. offsets: (N+1,) int64 vertex
+        offsets per linestring; None = uniform 2-vertex segments (M/2
+        features)."""
+        coords = np.asarray(coords, dtype=np.float64)
+        if offsets is None:
+            if len(coords) % 2:
+                raise ValueError("odd vertex count for 2-point segments")
+            offsets = np.arange(0, len(coords) + 1, 2, dtype=np.int64)
+        else:
+            offsets = np.asarray(offsets, dtype=np.int64)
+        n = len(offsets) - 1
+        level = np.arange(n + 1, dtype=np.int64)
+        return cls(np.full(n, LINESTRING, dtype=np.int8),
+                   level, level.copy(), offsets, coords)
+
+    @classmethod
     def concat(cls, arrays: Sequence["GeometryArray"]) -> "GeometryArray":
         """Vectorized concatenation: coords stack, offset levels shift by the
         running totals (no per-shape Python; the LSM flush path depends on

@@ -63,6 +63,46 @@ class IndexScanPlan:
 
 
 @dataclass
+class UnionScanPlan:
+    """OR → multiple strategies: each OR branch plans independently and the
+    executor unions the row sets (≙ FilterSplitter's OR expansion,
+    planning/FilterSplitter.scala:61-103, where an Or becomes a FilterPlan
+    with several FilterStrategies). When every branch resolves to a
+    device-exact mask on the SAME index, the union is a single fused
+    OR-of-masks scan; otherwise row sets union on the host."""
+
+    branches: List[tuple]            # [(child_filter, IndexScanPlan), ...]
+    full_filter: Optional[ir.Filter] = None
+    cost: float = 0.0
+    empty: bool = False
+    explain: Dict[str, object] = field(default_factory=dict)
+
+    # duck-typed surface shared with IndexScanPlan consumers
+    primary_kind: str = "union"
+    candidate_slices = None
+    residual_host = None
+    index = None
+    blocks: object = None
+    boxes_loose = None
+    windows = None
+
+    @property
+    def device_exact(self) -> bool:
+        return False  # prepared/count fast paths run per-branch instead
+
+    def same_index_device_exact(self):
+        """The shared index when every branch is a device-exact mask scan on
+        one index, else None (enables the fused OR-of-masks path)."""
+        idxs = {id(p.index) for _, p in self.branches}
+        if len(idxs) != 1:
+            return None
+        for _, p in self.branches:
+            if not p.device_exact:
+                return None
+        return self.branches[0][1].index
+
+
+@dataclass
 class QueryResult:
     """Materialized query output (≙ the reader side of QueryPlanner.runQuery)."""
 

@@ -27,7 +27,7 @@ from geomesa_tpu.filter.evaluate import evaluate as _evaluate
 from geomesa_tpu.filter.evaluate import evaluate_at as _evaluate_at
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
-from geomesa_tpu.index.api import IndexScanPlan, QueryResult
+from geomesa_tpu.index.api import IndexScanPlan, QueryResult, UnionScanPlan
 from geomesa_tpu.index import prune as _prune
 
 _SELECT_CAP = 1 << 16
@@ -119,12 +119,47 @@ class QueryPlanner:
             chosen = min(plans, key=priced)
         else:
             chosen = min(plans, key=lambda p: p.cost)
+        if isinstance(f, ir.Or) and chosen.residual_host is not None:
+            # OR → multi-strategy (≙ FilterSplitter.getQueryOptions OR
+            # expansion): when every branch plans with real primary
+            # constraints, per-branch scans + row-set union beat the
+            # union-boxes prefilter + host residual the single plan needs
+            union = self._union_plan(f)
+            if union is not None:
+                chosen = union
         for ic in self.interceptors:   # ≙ query guards veto (QueryPlanner:148)
             msg = ic.guard(chosen, f, self.sft)
             if msg:
                 from geomesa_tpu.index.guards import QueryGuardError
                 raise QueryGuardError(msg)
         return chosen
+
+    def _union_plan(self, f: ir.Or) -> Optional[UnionScanPlan]:
+        """Per-branch plans for an OR filter, or None when any branch would
+        degenerate to an unconstrained scan (then the single superset plan
+        wins). Branch count is capped like the reference's DNF expansion."""
+        if len(f.children) > 8:
+            return None
+        branches = []
+        cost = 0.0
+        for c in f.children:
+            plans = [p for p in (idx.plan(c) for idx in self.indexes)
+                     if p is not None]
+            if not plans:
+                return None
+            bp = min(plans, key=lambda p: p.cost)
+            if bp.empty:
+                continue
+            if bp.primary_kind == "none" and bp.candidate_slices is None:
+                return None  # unconstrained branch: union buys nothing
+            branches.append((c, bp))
+            cost += bp.cost
+        return UnionScanPlan(
+            branches=branches, full_filter=f, cost=cost,
+            empty=not branches,
+            explain={"index": "union",
+                     "strategies": [p.explain.get("index")
+                                    for _, p in branches]})
 
     def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:
         """Hierarchical plan description (≙ Explainer / CLI explain)."""
@@ -150,6 +185,9 @@ class QueryPlanner:
         host; the device tests dictionary-code membership."""
         if auths is None or self.table.visibility is None or plan.empty \
                 or plan.explain.get("__vis_applied__"):
+            return plan
+        if isinstance(plan, UnionScanPlan):
+            # branches fold the auths mask individually at execution time
             return plan
         plan.explain["__vis_applied__"] = True
         import dataclasses
@@ -240,6 +278,22 @@ class QueryPlanner:
     def _count(self, plan: IndexScanPlan, f, auths) -> int:
         if plan.empty:
             return 0
+        if isinstance(plan, UnionScanPlan):
+            idx = plan.same_index_device_exact()
+            if idx is not None:
+                # fused OR-of-masks count: branch masks OR on device, one
+                # scalar readback (branch overlaps dedup in the OR itself)
+                import functools
+
+                import jax.numpy as jnp
+                masks = [idx.kernels.mask(
+                    bp2.primary_kind, bp2.boxes_loose, bp2.windows,
+                    bp2.residual_device)
+                    for bp2 in (self._apply_auths(bp, auths)
+                                for _, bp in plan.branches)]
+                return int(jnp.sum(functools.reduce(
+                    lambda a, b: a | b, masks)))
+            return len(self._union_select(plan, auths))
         if plan.primary_kind == "fid":
             return len(self._fid_vis_filter(
                 self._fid_rows(plan.full_filter), auths))
@@ -275,6 +329,8 @@ class QueryPlanner:
         plan = self._apply_auths(plan, auths)
         if plan.empty:
             return np.empty(0, dtype=np.int64)
+        if isinstance(plan, UnionScanPlan):
+            return self._union_select(plan, auths)
         if plan.primary_kind == "fid":
             return self._fid_vis_filter(self._fid_rows(plan.full_filter), auths)
         if plan.candidate_slices is not None:
@@ -299,12 +355,32 @@ class QueryPlanner:
             return np.sort(rows)
         return np.sort(self._refine(plan, rows))
 
+    def _union_select(self, plan: UnionScanPlan, auths) -> np.ndarray:
+        """Union of per-branch row sets (sorted unique — OR-branch overlaps
+        dedup here, ≙ the reference's de-duplication across strategies)."""
+        sets = [self.select_indices(c, plan=bp, auths=auths)
+                for c, bp in plan.branches]
+        if not sets:
+            return np.empty(0, dtype=np.int64)
+        return np.unique(np.concatenate(sets))
+
     def scan_mask(self, f: Union[str, ir.Filter], auths=None):
         """(plan, device mask over the plan index's sorted rows) — None mask
         when the plan needs host refinement or is candidate-pruned. The mask
         stays on device for aggregation kernels to consume (≙ the shared
         AggregatingScan validate step)."""
         plan = self._apply_auths(self.plan(f), auths)
+        if isinstance(plan, UnionScanPlan):
+            idx = plan.same_index_device_exact()
+            if idx is None or plan.empty:
+                return plan, None
+            import functools
+            masks = [idx.kernels.mask(
+                bp2.primary_kind, bp2.boxes_loose, bp2.windows,
+                bp2.residual_device)
+                for bp2 in (self._apply_auths(bp, auths)
+                            for _, bp in plan.branches)]
+            return plan, functools.reduce(lambda a, b: a | b, masks)
         if plan.empty or plan.primary_kind == "fid" or plan.residual_host is not None \
                 or plan.candidate_slices is not None or plan.index is None:
             return plan, None

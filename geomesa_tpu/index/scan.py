@@ -430,12 +430,14 @@ class ScanKernels:
             n = next(iter(self.cols.values())).shape[0]
             nblk, bsz, sel_cap = capacity[:3]
 
-            def blocks_mask(cols, boxes, windows, rparams, block_ids):
+            def expand_blocks(cols, block_ids):
+                """block ids → (valid membership mask, row ids, lazy gather).
+                dynamic_slice clamps out-of-range starts, so the last
+                partial block re-reads a suffix of the previous one; the
+                membership test (row belongs to ITS intended block) masks
+                those re-reads and the -1 pad blocks without double counts.
+                Single home for this logic — every block mode goes through it."""
                 starts = block_ids * bsz
-                # dynamic_slice clamps out-of-range starts, so the last
-                # partial block re-reads a suffix of the previous one; the
-                # membership test (row belongs to ITS intended block) masks
-                # those re-reads and the -1 pad blocks without double counts
                 astart = jnp.clip(starts, 0, max(0, n - bsz))
                 rows = (astart[:, None]
                         + jnp.arange(bsz, dtype=jnp.int32)[None, :])
@@ -443,8 +445,12 @@ class ScanKernels:
                          & (rows >= starts[:, None])
                          & (rows < starts[:, None] + bsz)).reshape(-1)
                 g = _LazyBlockGather(cols, astart, bsz, astart.shape[0] * bsz)
+                return valid, rows.reshape(-1), g
+
+            def blocks_mask(cols, boxes, windows, rparams, block_ids):
+                valid, rows, g = expand_blocks(cols, block_ids)
                 m = mask_fn(g, boxes, windows, rparams, residual_fn) & valid
-                return m, rows.reshape(-1), g
+                return m, rows, g
 
             if mode == "count_blocks":
                 def run(cols, boxes, windows, rparams, block_ids):
@@ -458,15 +464,7 @@ class ScanKernels:
                 # microseconds (the per-dispatch RPC overhead amortizes
                 # across the whole batch).
                 def run(cols, boxes, windows, rparams, block_ids):
-                    starts = block_ids * bsz
-                    astart = jnp.clip(starts, 0, max(0, n - bsz))
-                    rows = (astart[:, None]
-                            + jnp.arange(bsz, dtype=jnp.int32)[None, :])
-                    valid = ((block_ids >= 0)[:, None]
-                             & (rows >= starts[:, None])
-                             & (rows < starts[:, None] + bsz)).reshape(-1)
-                    g = _LazyBlockGather(cols, astart, bsz,
-                                         astart.shape[0] * bsz)
+                    valid, _, g = expand_blocks(cols, block_ids)
                     base = valid
                     if has_time:
                         base = base & _time_mask(g, windows)

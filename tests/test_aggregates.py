@@ -203,12 +203,19 @@ def test_density_pruned_blocks_path(monkeypatch):
 
 
 def test_density_weight_attr_not_on_device_uses_host(store, data):
-    """A weight attribute with no device column must take the exact host
-    path, not silently weight by 1.0."""
+    """A weight attribute with no usable numeric device column must take the
+    exact host path, not silently weight by 1.0 (or by dict codes)."""
     from geomesa_tpu.aggregates.density import prepare_density
     planner = store.planner("tr")
-    # 'track' is a String column: present on device as dict codes — weighting
-    # by it is nonsense numerically but exercises the host routing decision
+    # no weight -> device path
     run = prepare_density(planner, "INCLUDE", (-30, -30, 30, 30), 8, 8,
                           weight_attr=None)
-    assert hasattr(run, "dispatch")  # no weight -> device path
+    assert hasattr(run, "dispatch")
+    # 'dtg' has no device column (bin/off planes carry it) -> host path
+    run2 = prepare_density(planner, "INCLUDE", (-30, -30, 30, 30), 8, 8,
+                           weight_attr="dtg")
+    assert not hasattr(run2, "dispatch")
+    # 'track' is a String column (device dict codes are NOT weights) -> host
+    run3 = prepare_density(planner, "INCLUDE", (-30, -30, 30, 30), 8, 8,
+                           weight_attr="track")
+    assert not hasattr(run3, "dispatch")

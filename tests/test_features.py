@@ -112,3 +112,26 @@ class TestFeatureTable:
         sft = SimpleFeatureType.from_spec("t", "age:Int,*geom:Point")
         with pytest.raises(ValueError):
             FeatureTable.build(sft, {"age": [1, 2], "geom": (np.array([1.0]), np.array([2.0]))})
+
+
+def test_linestrings_bulk_constructor_matches_from_shapes():
+    import numpy as np
+    from geomesa_tpu.features.geometry import GeometryArray, LINESTRING
+    rng = np.random.default_rng(4)
+    n = 500
+    x0, y0 = rng.uniform(-50, 50, n), rng.uniform(-50, 50, n)
+    x1, y1 = x0 + rng.uniform(0.1, 2, n), y0 + rng.uniform(0.1, 2, n)
+    coords = np.empty((2 * n, 2))
+    coords[0::2, 0], coords[0::2, 1] = x0, y0
+    coords[1::2, 0], coords[1::2, 1] = x1, y1
+    bulk = GeometryArray.linestrings(coords)
+    ref = GeometryArray.from_shapes(
+        [(LINESTRING, [[x0[i], y0[i]], [x1[i], y1[i]]]) for i in range(n)])
+    np.testing.assert_array_equal(bulk.type_codes, ref.type_codes)
+    np.testing.assert_array_equal(bulk.bboxes(), ref.bboxes())
+    np.testing.assert_array_equal(bulk.coords, ref.coords)
+    np.testing.assert_array_equal(bulk.ring_offsets, ref.ring_offsets)
+    # ragged offsets variant
+    offs = np.array([0, 2, 5, 6], dtype=np.int64)
+    g2 = GeometryArray.linestrings(coords[:6], offs)
+    assert len(g2) == 3 and g2.shape(1)[1] == coords[2:5].tolist()

@@ -1,0 +1,153 @@
+"""S2 curve + S2/S3 indexes: roundtrip/locality invariants, covering
+superset property, and end-to-end query parity vs brute force."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import s2
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.index import prune
+from geomesa_tpu.index.planner import QueryPlanner
+from geomesa_tpu.index.spatial import S2Index, S3Index
+
+
+def test_hilbert_roundtrip():
+    rng = np.random.default_rng(1)
+    i = rng.integers(0, 1 << 30, 5000)
+    j = rng.integers(0, 1 << 30, 5000)
+    pos = s2.hilbert_pos(i, j)
+    i2, j2 = s2.hilbert_ij(pos)
+    np.testing.assert_array_equal(i, i2)
+    np.testing.assert_array_equal(j, j2)
+
+
+def test_hilbert_continuity():
+    """Consecutive Hilbert positions are 4-neighbors (true Hilbert curve,
+    not just any bijection)."""
+    level = 8
+    pos = np.arange(1 << (2 * level))
+    i, j = s2.hilbert_ij(pos, level)
+    d = np.abs(np.diff(i)) + np.abs(np.diff(j))
+    assert np.all(d == 1), f"discontinuities: {np.sum(d != 1)}"
+
+
+def test_cell_id_invert_accuracy():
+    rng = np.random.default_rng(2)
+    lon = rng.uniform(-180, 180, 20000)
+    lat = rng.uniform(-90, 90, 20000)
+    sfc = s2.S2SFC.apply()
+    ids = sfc.index(lon, lat)
+    assert np.all(ids >= 0) and len(np.unique(ids)) > 19990
+    lon2, lat2 = sfc.invert(ids)
+    # level-30 cells are ~centimeters; invert must land inside the cell.
+    # Longitude degrees stretch near the poles — measure metric error.
+    d = np.hypot((lon2 - lon) * np.cos(np.radians(lat)), lat2 - lat)
+    assert float(d.max()) < 1e-6
+
+
+def test_cover_contains_indexed_points():
+    """Covering superset property: every point inside a box has its cell id
+    inside some cover range (pruning-safety invariant)."""
+    rng = np.random.default_rng(3)
+    sfc = s2.S2SFC.apply()
+    for trial in range(25):
+        xmin = rng.uniform(-175, 150)
+        ymin = rng.uniform(-85, 60)
+        xmax = xmin + rng.uniform(0.05, 30)
+        ymax = ymin + rng.uniform(0.05, 25)
+        rs = sfc.ranges([(xmin, ymin, xmax, ymax)], max_ranges=2000)
+        assert 0 < len(rs) <= 2000
+        xs = rng.uniform(xmin, xmax, 400)
+        ys = rng.uniform(ymin, ymax, 400)
+        ids = sfc.index(xs, ys)
+        lows = np.array([r.lower for r in rs])
+        highs = np.array([r.upper for r in rs])
+        k = np.searchsorted(lows, ids, side="right") - 1
+        ok = (k >= 0) & (ids <= highs[np.clip(k, 0, len(rs) - 1)])
+        assert ok.all(), (trial, int((~ok).sum()))
+
+
+def test_cover_near_poles_and_antimeridian():
+    sfc = s2.S2SFC.apply()
+    rng = np.random.default_rng(4)
+    for box in [(-180.0, 85.0, 180.0, 90.0), (-180.0, -90.0, 180.0, -88.0),
+                (176.0, -10.0, 180.0, 10.0), (-180.0, -5.0, -176.0, 5.0)]:
+        rs = sfc.ranges([box], max_ranges=2000)
+        xs = rng.uniform(box[0], box[2], 300)
+        ys = rng.uniform(box[1], box[3], 300)
+        ids = sfc.index(xs, ys)
+        lows = np.array([r.lower for r in rs])
+        highs = np.array([r.upper for r in rs])
+        k = np.searchsorted(lows, ids, side="right") - 1
+        ok = (k >= 0) & (ids <= highs[np.clip(k, 0, len(rs) - 1)])
+        assert ok.all(), box
+
+
+@pytest.fixture(autouse=True)
+def small_blocks(monkeypatch):
+    monkeypatch.setattr(prune, "BLOCK_SIZE", 256)
+    monkeypatch.setattr(prune, "PRUNE_MAX_FRACTION", 1.0)
+
+
+def test_s2_index_query_parity():
+    rng = np.random.default_rng(5)
+    n = 50_000
+    x = np.clip(rng.normal(0, 50, n), -180, 180)
+    y = np.clip(rng.normal(0, 25, n), -90, 90)
+    sft = SimpleFeatureType.from_spec(
+        "p", "*geom:Point;geomesa.indices=s2")
+    table = FeatureTable.build(sft, {"geom": (x, y)})
+    idx = S2Index(sft, table)
+    assert S2Index.supports(sft)
+    planner = QueryPlanner(sft, table, [idx])
+    q = "BBOX(geom, -8, 20, 12, 40)"
+    plan = planner.plan(q)
+    assert plan.explain["index"] == "s2"
+    blocks = planner._pruned_blocks(plan)
+    assert blocks is not None and len(blocks) > 0
+    rows = planner.select_indices(q, plan=plan)
+    expected = np.flatnonzero((x >= -8) & (x <= 12) & (y >= 20) & (y <= 40))
+    np.testing.assert_array_equal(rows, expected)
+
+
+def test_s3_index_query_parity():
+    rng = np.random.default_rng(6)
+    n = 50_000
+    x = np.clip(rng.normal(0, 50, n), -180, 180)
+    y = np.clip(rng.normal(0, 25, n), -90, 90)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + rng.integers(0, 30 * 86400000, n)
+    sft = SimpleFeatureType.from_spec(
+        "p3", "dtg:Date,*geom:Point;geomesa.indices=s3,"
+        "geomesa.z3.interval=week")
+    table = FeatureTable.build(sft, {"dtg": dtg, "geom": (x, y)})
+    assert S3Index.supports(sft)
+    idx = S3Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    q = ("BBOX(geom, -8, 20, 12, 40) AND "
+         "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+    plan = planner.plan(q)
+    blocks = planner._pruned_blocks(plan)
+    assert blocks is not None and len(blocks) > 0
+    rows = planner.select_indices(q, plan=plan)
+    lo = np.datetime64("2020-01-05", "ms").astype(np.int64)
+    hi = np.datetime64("2020-01-12", "ms").astype(np.int64)
+    expected = np.flatnonzero((x >= -8) & (x <= 12) & (y >= 20) & (y <= 40)
+                              & (dtg > lo) & (dtg < hi))
+    np.testing.assert_array_equal(rows, expected)
+
+
+def test_s2_selectable_via_datastore():
+    from geomesa_tpu.datastore import TpuDataStore
+    rng = np.random.default_rng(7)
+    n = 5000
+    x = rng.uniform(-20, 20, n)
+    y = rng.uniform(-20, 20, n)
+    ds = TpuDataStore()
+    ds.create_schema("s2t", "*geom:Point;geomesa.indices=s2")
+    ds.load("s2t", FeatureTable.build(ds.get_schema("s2t"), {"geom": (x, y)}))
+    e = ds.explain("s2t", "BBOX(geom, -5, -5, 5, 5)")
+    assert e["index"] == "s2"
+    c = ds.count("s2t", "BBOX(geom, -5, -5, 5, 5)")
+    assert c == int(np.sum((x >= -5) & (x <= 5) & (y >= -5) & (y <= 5)))
