@@ -400,6 +400,24 @@ def main() -> None:
         del join, dx_, dy_
         gc.collect()
 
+        # extent x extent join (grid partition + exact refine)
+        from geomesa_tpu.features.geometry import GeometryArray
+        from geomesa_tpu.parallel.extent_join import extent_join
+        nj = 200_000
+        jx = rng.uniform(-60, 60, nj)
+        jy = rng.uniform(-60, 60, nj)
+        jc = np.empty((2 * nj, 2))
+        jc[0::2, 0], jc[0::2, 1] = jx, jy
+        jc[1::2, 0] = jx + rng.uniform(-1, 1, nj)
+        jc[1::2, 1] = jy + rng.uniform(-1, 1, nj)
+        lines = GeometryArray.linestrings(jc)
+        polys_g = GeometryArray.from_shapes(polys)
+        t0 = time.perf_counter()
+        la, ra = extent_join(lines, polys_g)
+        detail["cfg3_extent_join_s"] = round(time.perf_counter() - t0, 2)
+        detail["cfg3_extent_join_pairs"] = int(len(la))
+        detail["cfg3_extent_join_n_lines"] = nj
+
     # ---- config 4: density + KNN -----------------------------------------
     if "4" in configs:
         if planner is None:

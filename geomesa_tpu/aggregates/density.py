@@ -80,9 +80,8 @@ def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
         idx is not None and weight_attr in idx.device.columns
         and planner.sft.attribute(weight_attr).type_name in
         ("Int", "Integer", "Long", "Float", "Double"))
-    device_ok = (plan.primary_kind != "fid" and plan.residual_host is None
-                 and plan.candidate_slices is None and idx is not None
-                 and "xf" in idx.device.columns and weight_on_device)
+    device_ok = (plan.device_exact and "xf" in idx.device.columns
+                 and weight_on_device)
     if device_ok:
         from geomesa_tpu.index import prune as _prune
 

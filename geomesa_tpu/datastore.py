@@ -24,7 +24,7 @@ import numpy as np
 
 from geomesa_tpu.features.geometry import GeometryArray
 from geomesa_tpu.features.sft import SimpleFeatureType
-from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.features.table import FeatureTable, StringColumn
 from geomesa_tpu.filter import ir
 from geomesa_tpu.index.api import QueryResult
 from geomesa_tpu.index.planner import QueryPlanner
@@ -402,6 +402,108 @@ class TpuDataStore:
         self._interceptors.setdefault(type_name, []).append(interceptor)
 
     # -- deletes ------------------------------------------------------------
+
+    def update_features(self, type_name: str, f: Union[str, ir.Filter],
+                        updates: Dict[str, object]) -> int:
+        """Modify attributes of matching features in place (≙ the reference's
+        modify writer, GeoMesaFeatureWriter.scala:152-179: read matching
+        features, set attributes, rewrite index rows). Columnar form: patch
+        the columns at the matching rows, rebuild indexes (bulk-modify
+        discipline — key-bearing attributes change index keys anyway).
+
+        ``updates``: attr → scalar, array (len == matches), or callable
+        receiving the matching sub-table and returning values."""
+        planner = self.planner(type_name)  # flushes any delta first
+        rows = planner.select_indices(f)
+        if len(rows) == 0:
+            return 0
+        table = planner.table
+        sub = None
+        for name, val in updates.items():
+            attr = self.schemas[type_name].attribute(name)
+            if callable(val):
+                sub = sub if sub is not None else table.take(rows)
+                val = val(sub)
+            col = table.columns[name]
+            if isinstance(col, GeometryArray):
+                new_geoms = val if isinstance(val, GeometryArray) \
+                    else GeometryArray.from_rows(
+                        [val] * len(rows) if isinstance(val, str) else list(val))
+                keep = np.ones(len(table), dtype=bool)
+                keep[rows] = False
+                order = np.concatenate([np.flatnonzero(keep), rows])
+                inv = np.empty(len(table), dtype=np.int64)
+                inv[order] = np.arange(len(table))
+                merged = GeometryArray.concat([col.take(np.flatnonzero(keep)),
+                                               new_geoms])
+                table.columns[name] = merged.take(inv)
+            elif isinstance(col, StringColumn):
+                # vectorized decode→patch→re-encode (never a per-row Python
+                # loop over the full column)
+                values = np.asarray(col.vocab, dtype=object)[col.codes]
+                values[rows] = val if isinstance(val, str) \
+                    else np.asarray([str(v) for v in val], dtype=object)
+                table.columns[name] = StringColumn.encode(values)
+            else:
+                # copy-on-write: loaded tables may alias caller arrays
+                arr = np.array(col, copy=True)
+                if attr.type_name == "Date":
+                    v = np.asarray(val)
+                    if v.dtype.kind in "MUS":
+                        val = v.astype("datetime64[ms]").astype(np.int64)
+                arr[rows] = val
+                table.columns[name] = arr
+        self._rebuild_indexes(type_name)
+        return int(len(rows))
+
+    def update_schema(self, type_name: str, add_attributes: str = "",
+                      new_name: Optional[str] = None) -> SimpleFeatureType:
+        """Schema evolution (≙ MetadataBackedDataStore.updateSchema:227):
+        append new attributes (spec-string syntax; existing rows take the
+        type's zero/empty value) and/or rename the type."""
+        sft = self.schemas[type_name]
+        spec = sft.to_spec()
+        if add_attributes:
+            body = spec.split(";")[0]
+            user = spec[len(body):]
+            spec = body + "," + add_attributes + user
+        out = SimpleFeatureType.from_spec(new_name or type_name, spec)
+        old_names = {a.name for a in sft.attributes}
+        for attr in out.attributes:
+            if attr.is_geometry and attr.name not in old_names:
+                raise ValueError("Cannot add a geometry attribute")
+        table = self.tables.get(type_name)
+        if table is not None:
+            self.flush(type_name)
+            table = self.tables[type_name]
+            n = len(table)
+            cols: Dict[str, object] = dict(table.columns)
+            for attr in out.attributes:
+                if attr.name in cols:
+                    continue
+                if attr.type_name == "String":
+                    cols[attr.name] = StringColumn(
+                        np.zeros(n, np.int32), [""])
+                else:
+                    cols[attr.name] = np.zeros(n, dtype=attr.binding)
+            new_table = FeatureTable(out, table._fids, cols,
+                                     table.visibility, _n=n)
+        final = new_name or type_name
+        if new_name is not None and new_name != type_name:
+            if new_name in self.schemas:
+                raise ValueError(f"Schema {new_name} already exists")
+            self.remove_schema(type_name)
+        self.schemas[final] = out
+        # the stat battery is built against the OLD attribute set — drop it
+        # so the rebuild re-observes with the evolved schema
+        self._stats.pop(final, None)
+        if table is not None:
+            self.tables[final] = new_table
+            self.deltas[final] = None
+            self._rebuild_indexes(final)
+        else:
+            self.tables[final] = None
+        return out
 
     def remove_features(self, type_name: str, f: Union[str, ir.Filter]) -> int:
         """Delete matching features; returns the number removed (≙ GeoTools

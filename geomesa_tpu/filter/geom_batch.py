@@ -121,6 +121,39 @@ def _pip_chunked(px: np.ndarray, py: np.ndarray, literal: tuple) -> np.ndarray:
     return out
 
 
+def _point_eq_chunked(coords: np.ndarray, lc: np.ndarray) -> np.ndarray:
+    """Any-vertex == any-literal-point equality with bounded temporaries
+    (the raw (n_coords x n_literal) broadcast blows the temp budget for a
+    large candidate set against a large MULTIPOINT literal)."""
+    n = len(coords)
+    step = max(1, _CHUNK // max(1, len(lc)))
+    if n <= step:
+        return np.any((coords[:, None, 0] == lc[None, :, 0])
+                      & (coords[:, None, 1] == lc[None, :, 1]), axis=1)
+    out = np.empty(n, dtype=bool)
+    for i in range(0, n, step):
+        ch = coords[i:i + step]
+        out[i:i + step] = np.any((ch[:, None, 0] == lc[None, :, 0])
+                                 & (ch[:, None, 1] == lc[None, :, 1]), axis=1)
+    return out
+
+
+def _vertex_dist_chunked(coords: np.ndarray, lc: np.ndarray) -> np.ndarray:
+    """Min vertex-to-literal-point distance with bounded temporaries."""
+    n = len(coords)
+    step = max(1, _CHUNK // max(1, len(lc)))
+    if n <= step:
+        return np.min(np.hypot(coords[:, None, 0] - lc[None, :, 0],
+                               coords[:, None, 1] - lc[None, :, 1]), axis=1)
+    out = np.empty(n)
+    for i in range(0, n, step):
+        ch = coords[i:i + step]
+        out[i:i + step] = np.min(np.hypot(ch[:, None, 0] - lc[None, :, 0],
+                                          ch[:, None, 1] - lc[None, :, 1]),
+                                 axis=1)
+    return out
+
+
 def _on_segments_chunked(px, py, segs: np.ndarray) -> np.ndarray:
     n = len(px)
     ns = max(1, len(segs))
@@ -256,8 +289,7 @@ def batch_intersects(arr: geo.GeometryArray, idx: np.ndarray,
     if np.any(point_feat):
         pf = point_feat[cfid]
         if lcode in (geo.POINT, geo.MULTIPOINT):
-            eq = np.any((coords[:, None, 0] == lc[None, :, 0])
-                        & (coords[:, None, 1] == lc[None, :, 1]), axis=1)
+            eq = _point_eq_chunked(coords, lc)
             out |= _any_per_feature(cfid, eq & pf, c)
         elif lcode in (geo.LINESTRING, geo.MULTILINESTRING):
             on = _on_segments_chunked(coords[:, 0], coords[:, 1], lsegs)
@@ -345,8 +377,7 @@ def batch_distance(arr: geo.GeometryArray, idx: np.ndarray,
         nose = ~has_segs
         if np.any(nose):
             pv = nose[cfid]
-            dv = np.min(np.hypot(coords[pv, None, 0] - lc[None, :, 0],
-                                 coords[pv, None, 1] - lc[None, :, 1]), axis=1)
+            dv = _vertex_dist_chunked(coords[pv], lc)
             d = np.minimum(d, _min_per_feature(cfid[pv], dv, c))
     d[inter] = 0.0
     return d

@@ -381,8 +381,7 @@ class QueryPlanner:
                 for bp2 in (self._apply_auths(bp, auths)
                             for _, bp in plan.branches)]
             return plan, functools.reduce(lambda a, b: a | b, masks)
-        if plan.empty or plan.primary_kind == "fid" or plan.residual_host is not None \
-                or plan.candidate_slices is not None or plan.index is None:
+        if not plan.device_exact:
             return plan, None
         return plan, plan.index.kernels.mask(
             plan.primary_kind, plan.boxes_loose, plan.windows, plan.residual_device)
@@ -435,9 +434,7 @@ class PreparedQuery:
         self.filter = f
         self.auths = auths
         self._count_disp = None
-        if (not plan.empty and plan.primary_kind != "fid"
-                and plan.residual_host is None
-                and plan.candidate_slices is None and plan.index is not None):
+        if plan.device_exact:
             blocks = planner._pruned_blocks(plan)
             if blocks is not None and len(blocks) > 0:
                 self._count_disp = plan.index.kernels.prepare_count_blocks(

@@ -33,29 +33,41 @@ _SFT_KEY = b"geomesa.sft.spec"
 _NAME_KEY = b"geomesa.sft.name"
 
 
+def _local_dictionary(attr, col):
+    """Default string strategy: the column's own dictionary."""
+    sc = col if isinstance(col, StringColumn) \
+        else StringColumn.encode([str(v) for v in col])
+    return pa.DictionaryArray.from_arrays(
+        pa.array(sc.codes, pa.int32()), pa.array(sc.vocab, pa.string()))
+
+
+def _encode_column(attr, col, string_encoder=_local_dictionary):
+    """ONE home for the FeatureTable→Arrow column mapping (to_arrow and the
+    delta stream writer share it; only the string-dictionary strategy
+    differs). Geometry encoding follows the ATTRIBUTE type — a generic
+    'Geometry' attribute is WKB even when a particular batch is all points,
+    so stream batches stay schema-stable."""
+    if attr.is_geometry:
+        if attr.type_name == "Point":
+            x, y = col.point_xy()
+            return pa.StructArray.from_arrays(
+                [pa.array(x, pa.float64()), pa.array(y, pa.float64())],
+                ["x", "y"])
+        return pa.array(encode_wkb(col), type=pa.binary())
+    if attr.type_name == "String":
+        return string_encoder(attr, col)
+    if attr.type_name == "Date":
+        return pa.array(np.asarray(col, dtype=np.int64), pa.timestamp("ms"))
+    return pa.array(np.asarray(col))
+
+
 def to_arrow(table: FeatureTable) -> pa.Table:
     arrays, names = [], []
     names.append("__fid__")
     arrays.append(pa.array([str(f) for f in table.fids], type=pa.string()))
     for attr in table.sft.attributes:
-        col = table.columns[attr.name]
         names.append(attr.name)
-        if isinstance(col, GeometryArray):
-            if col.is_points:
-                x, y = col.point_xy()
-                arrays.append(pa.StructArray.from_arrays(
-                    [pa.array(x, pa.float64()), pa.array(y, pa.float64())],
-                    ["x", "y"]))
-            else:
-                arrays.append(pa.array(encode_wkb(col), type=pa.binary()))
-        elif isinstance(col, StringColumn):
-            arrays.append(pa.DictionaryArray.from_arrays(
-                pa.array(col.codes, pa.int32()), pa.array(col.vocab, pa.string())))
-        elif attr.type_name == "Date":
-            arrays.append(pa.array(np.asarray(col, dtype=np.int64),
-                                   pa.timestamp("ms")))
-        else:
-            arrays.append(pa.array(np.asarray(col)))
+        arrays.append(_encode_column(attr, table.columns[attr.name]))
     out = pa.table(dict(zip(names, arrays)))
     return out.replace_schema_metadata(
         {_SFT_KEY: table.sft.to_spec().encode(),
@@ -113,3 +125,103 @@ def write_ipc(table: FeatureTable, path: str) -> None:
 def read_ipc(path: str, sft: Optional[SimpleFeatureType] = None) -> FeatureTable:
     with ipc.open_file(path) as r:
         return from_arrow(r.read_all(), sft)
+
+
+# -- streaming delta batches -------------------------------------------------
+
+
+def _stream_schema(sft: SimpleFeatureType) -> pa.Schema:
+    fields = [pa.field("__fid__", pa.string())]
+    for attr in sft.attributes:
+        if attr.is_geometry:
+            t = pa.struct([("x", pa.float64()), ("y", pa.float64())]) \
+                if attr.type_name == "Point" else pa.binary()
+        elif attr.type_name == "String":
+            t = pa.dictionary(pa.int32(), pa.string())
+        elif attr.type_name == "Date":
+            t = pa.timestamp("ms")
+        else:
+            t = pa.from_numpy_dtype(attr.binding)
+        fields.append(pa.field(attr.name, t))
+    return pa.schema(fields, metadata={
+        _SFT_KEY: sft.to_spec().encode(), _NAME_KEY: sft.name.encode()})
+
+
+class ArrowDeltaWriter:
+    """Incremental Arrow IPC stream with dictionary DELTAS.
+
+    ≙ the reference `DeltaWriter` (/root/reference/geomesa-arrow/
+    geomesa-arrow-gt/src/main/scala/org/locationtech/geomesa/arrow/io/
+    DeltaWriter.scala:53,205): threadsafe incremental record batches whose
+    string dictionaries only ever GROW — each batch ships just the new
+    dictionary entries (``emit_dictionary_deltas``), so a long-running
+    export never re-transmits its vocabularies. Readers merge transparently
+    (pyarrow replays deltas); ``merge_deltas`` k-way-merges several streams
+    into one sorted stream (the BatchWriter merge-sort step)."""
+
+    def __init__(self, sink, sft: SimpleFeatureType):
+        self.sft = sft
+        self.schema = _stream_schema(sft)
+        self._own = isinstance(sink, str)
+        self._sink = open(sink, "wb") if self._own else sink
+        self._writer = ipc.new_stream(
+            self._sink, self.schema,
+            options=ipc.IpcWriteOptions(emit_dictionary_deltas=True))
+        # append-only global vocab per string attr (delta requirement)
+        self._vocabs: dict = {a.name: {} for a in sft.attributes
+                              if a.type_name == "String"}
+
+    def _growing_dictionary(self, attr, col):
+        """Delta strategy: codes remap into the APPEND-ONLY global vocab."""
+        vocab = self._vocabs[attr.name]
+        values = col.decode(np.arange(len(col))) \
+            if isinstance(col, StringColumn) else [str(v) for v in col]
+        for v in values:
+            if v not in vocab:
+                vocab[v] = len(vocab)  # append-only growth
+        codes = np.fromiter((vocab[v] for v in values), np.int32, len(values))
+        return pa.DictionaryArray.from_arrays(
+            pa.array(codes, pa.int32()), pa.array(list(vocab), pa.string()))
+
+    def write(self, table: FeatureTable) -> None:
+        arrays = [pa.array([str(f) for f in table.fids], pa.string())]
+        for attr in self.sft.attributes:
+            arrays.append(_encode_column(attr, table.columns[attr.name],
+                                         self._growing_dictionary))
+        self._writer.write_batch(pa.record_batch(arrays, self.schema))
+
+    def close(self) -> None:
+        self._writer.close()
+        if self._own:
+            self._sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_stream(path: str,
+                sft: Optional[SimpleFeatureType] = None) -> FeatureTable:
+    """Read a delta IPC stream back into one FeatureTable (pyarrow replays
+    the dictionary deltas; batches concatenate)."""
+    with ipc.open_stream(path) as r:
+        at = r.read_all()
+    return from_arrow(at, sft)
+
+
+def merge_deltas(paths, out_path: str, sort: Optional[str] = None,
+                 batch_rows: int = 1 << 17) -> None:
+    """Merge several delta streams into ONE sorted delta stream (≙ the
+    client-side DeltaWriter reduce: per-server batches → one sorted IPC)."""
+    tables = [read_stream(p) for p in paths]
+    merged = FeatureTable.concat(tables)
+    if sort is not None:
+        from geomesa_tpu.index.shaping import shape_local
+        merged = merged.take(shape_local(merged, sort=sort))
+    with ArrowDeltaWriter(out_path, merged.sft) as w:
+        for lo in range(0, len(merged), batch_rows):
+            w.write(merged.take(np.arange(
+                lo, min(len(merged), lo + batch_rows))))
+

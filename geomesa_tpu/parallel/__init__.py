@@ -8,11 +8,14 @@ moral of region splits), replicate query constants, and let XLA insert the
 collectives (psum for counts/stats/density merges — the FeatureReducer step —
 all_gather only for survivor-row hydration).
 
-  - ``mesh``      — mesh construction + ShardedTable
-  - ``dist``      — distributed count/density/stats query steps
-  - ``join``      — broadcast-polygon spatial join with psum hit counts
+  - ``mesh``        — mesh construction + ShardedTable
+  - ``dist``        — distributed count/density/stats query steps
+  - ``join``        — broadcast-polygon spatial join with psum hit counts
+  - ``extent_join`` — grid-partitioned extent×extent join + exact refine
 """
 
+from geomesa_tpu.parallel.extent_join import extent_join, extent_join_partitioned
 from geomesa_tpu.parallel.mesh import ShardedTable, create_mesh
 
-__all__ = ["ShardedTable", "create_mesh"]
+__all__ = ["ShardedTable", "create_mesh", "extent_join",
+           "extent_join_partitioned"]

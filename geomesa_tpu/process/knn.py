@@ -46,10 +46,7 @@ def knn(planner, x: float, y: float, k: int,
         return np.empty(0, dtype=np.int64), np.empty(0)
 
     plan = planner.plan(f if f is not None else ir.Include())
-    device_ok = (not plan.empty and plan.primary_kind != "fid"
-                 and plan.residual_host is None
-                 and plan.candidate_slices is None and plan.index is not None
-                 and "xf" in plan.index.device.columns
+    device_ok = (plan.device_exact and "xf" in plan.index.device.columns
                  and k <= _MAX_DEVICE_K)
     if device_ok:
         return _device_knn(planner, plan, x, y, k, f=f,

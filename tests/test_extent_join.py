@@ -1,0 +1,99 @@
+"""Extent×extent join vs brute force (grid partitioning + pair ownership
+dedup + exact refine; ≙ RelationUtils partitioning + sweepline join)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import geometry as geo
+from geomesa_tpu.filter import geom_batch
+from geomesa_tpu.parallel.extent_join import (candidate_pairs, extent_join,
+                                              extent_join_partitioned)
+
+
+def _lines(n, seed, span=2.0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.uniform(-60, 60, n)
+    y0 = rng.uniform(-60, 60, n)
+    coords = np.empty((2 * n, 2))
+    coords[0::2, 0], coords[0::2, 1] = x0, y0
+    coords[1::2, 0] = x0 + rng.uniform(-span, span, n)
+    coords[1::2, 1] = y0 + rng.uniform(-span, span, n)
+    return geo.GeometryArray.linestrings(coords)
+
+
+def _polys(m, seed):
+    rng = np.random.default_rng(seed)
+    shapes = []
+    for _ in range(m):
+        cx, cy = rng.uniform(-55, 55, 2)
+        r = rng.uniform(0.5, 4.0)
+        ang = np.linspace(0, 2 * np.pi, 9)[:-1]
+        ring = [[float(cx + r * np.cos(a)), float(cy + r * np.sin(a))]
+                for a in ang]
+        ring.append(ring[0])
+        shapes.append((geo.POLYGON, [ring]))
+    return geo.GeometryArray.from_shapes(shapes)
+
+
+def _brute(left, right, predicate="intersects"):
+    fn = geom_batch.batch_intersects if predicate == "intersects" \
+        else geom_batch.batch_within
+    lbb, rbb = left.bboxes(), right.bboxes()
+    out = []
+    all_l = np.arange(len(left), dtype=np.int64)
+    for j in range(len(right)):
+        ov = ((lbb[:, 0] <= rbb[j, 2]) & (lbb[:, 2] >= rbb[j, 0])
+              & (lbb[:, 1] <= rbb[j, 3]) & (lbb[:, 3] >= rbb[j, 1]))
+        cand = all_l[ov]
+        m = fn(left, cand, right.shape(j))
+        for i in cand[m]:
+            out.append((int(i), j))
+    return sorted(out)
+
+
+def test_candidate_pairs_superset_and_dedup():
+    left = _lines(3000, 1)
+    right = _polys(60, 2)
+    li, rj = candidate_pairs(left.bboxes(), right.bboxes())
+    pairs = set(zip(li.tolist(), rj.tolist()))
+    assert len(pairs) == len(li), "ownership dedup failed (duplicate pairs)"
+    # superset of the true bbox-overlap pairs
+    lbb, rbb = left.bboxes(), right.bboxes()
+    for j in range(len(right)):
+        ov = ((lbb[:, 0] <= rbb[j, 2]) & (lbb[:, 2] >= rbb[j, 0])
+              & (lbb[:, 1] <= rbb[j, 3]) & (lbb[:, 3] >= rbb[j, 1]))
+        for i in np.flatnonzero(ov):
+            assert (int(i), j) in pairs
+
+
+def test_extent_join_matches_brute_force():
+    left = _lines(2500, 3)
+    right = _polys(50, 4)
+    la, ra = extent_join(left, right)
+    got = sorted(zip(la.tolist(), ra.tolist()))
+    assert got == _brute(left, right)
+    assert len(got) > 50  # non-trivial overlap in this configuration
+
+
+def test_extent_join_line_vs_line():
+    left = _lines(1500, 5)
+    right = _lines(1500, 6)
+    la, ra = extent_join(left, right)
+    got = sorted(zip(la.tolist(), ra.tolist()))
+    assert got == _brute(left, right)
+
+
+def test_partitioned_join_equals_single():
+    left = _lines(2000, 7)
+    right = _polys(40, 8)
+    la1, ra1 = extent_join(left, right)
+    la2, ra2 = extent_join_partitioned(left, right, n_partitions=6)
+    np.testing.assert_array_equal(la1, la2)
+    np.testing.assert_array_equal(ra1, ra2)
+
+
+def test_empty_sides():
+    left = _lines(100, 9)
+    empty = geo.GeometryArray.from_shapes([])
+    la, ra = extent_join(left, empty)
+    assert len(la) == 0 and len(ra) == 0

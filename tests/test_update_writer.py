@@ -1,0 +1,169 @@
+"""Modify/update writer + updateSchema (≙ GeoMesaFeatureWriter.scala:152-179,
+MetadataBackedDataStore.updateSchema:227) and Arrow delta streams
+(≙ DeltaWriter.scala:53,205)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+
+
+@pytest.fixture()
+def store():
+    rng = np.random.default_rng(13)
+    n = 20_000
+    x = rng.uniform(-30, 30, n)
+    y = rng.uniform(-30, 30, n)
+    base = np.datetime64("2023-01-01T00:00:00", "ms").astype(np.int64)
+    data = {
+        "name": rng.choice(["a", "b", "c"], n),
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 10 * 86400000, n),
+        "geom": (x, y),
+    }
+    ds = TpuDataStore()
+    ds.create_schema("u", "name:String,v:Int,dtg:Date,*geom:Point")
+    ds.load("u", FeatureTable.build(ds.get_schema("u"), data))
+    return ds, data, x, y
+
+
+def test_update_scalar_attribute(store):
+    ds, data, x, y = store
+    n_up = ds.update_features("u", "v < 10", {"v": 999})
+    assert n_up == int(np.sum(data["v"] < 10))
+    assert ds.count("u", "v = 999") == n_up
+    assert ds.count("u", "v < 10") == 0
+    # untouched rows unchanged
+    assert ds.count("u", "v = 50") == int(np.sum(data["v"] == 50))
+
+
+def test_update_with_callable_and_requery(store):
+    ds, data, x, y = store
+    n_up = ds.update_features(
+        "u", "name = 'a'",
+        {"v": lambda sub: np.asarray(sub.columns["v"]) + 1000})
+    assert n_up == int(np.sum(data["name"] == "a"))
+    assert ds.count("u", "v >= 1000") == n_up
+
+
+def test_update_string_attribute(store):
+    ds, data, x, y = store
+    n_up = ds.update_features("u", "v > 90", {"name": "hot"})
+    assert ds.count("u", "name = 'hot'") == n_up
+
+
+def test_update_geometry_reindexes(store):
+    ds, data, x, y = store
+    before = ds.count("u", "BBOX(geom, 170, 80, 180, 90)")
+    assert before == 0
+    n_up = ds.update_features("u", "v = 42", {"geom": "POINT (175 85)"})
+    assert n_up == int(np.sum(data["v"] == 42))
+    # spatial index must see the moved geometries
+    assert ds.count("u", "BBOX(geom, 170, 80, 180, 90)") == n_up
+
+
+def test_update_schema_add_attribute(store):
+    ds, data, x, y = store
+    sft = ds.update_schema("u", add_attributes="score:Double")
+    assert sft.attribute("score").type_name == "Double"
+    r = ds.query("u", "INCLUDE", hints={"limit": 5})
+    assert float(np.asarray(r.table.columns["score"]).sum()) == 0.0
+    ds.update_features("u", "v < 50", {"score": 1.5})
+    assert ds.count("u", "score > 1") == int(np.sum(data["v"] < 50))
+
+
+def test_update_schema_rename(store):
+    ds, data, x, y = store
+    total = ds.count("u")
+    ds.update_schema("u", new_name="u2")
+    assert "u" not in ds.get_type_names()
+    assert ds.count("u2") == total
+
+
+def test_arrow_delta_stream_roundtrip(tmp_path, store):
+    ds, data, x, y = store
+    from geomesa_tpu.io.arrow import ArrowDeltaWriter, read_stream
+    table = ds.planner("u").table
+    p = str(tmp_path / "delta.arrows")
+    with ArrowDeltaWriter(p, table.sft) as w:
+        for lo in range(0, len(table), 6000):
+            w.write(table.take(np.arange(lo, min(len(table), lo + 6000))))
+    back = read_stream(p)
+    assert len(back) == len(table)
+    np.testing.assert_array_equal(np.asarray(back.columns["v"]),
+                                  np.asarray(table.columns["v"]))
+    assert back.columns["name"].decode(np.arange(5)) == \
+        table.columns["name"].decode(np.arange(5))
+    gx, gy = back.geometry().point_xy()
+    np.testing.assert_allclose(gx, table.geometry().point_xy()[0])
+
+
+def test_arrow_delta_dictionary_grows(tmp_path):
+    """Later batches introduce NEW dictionary values — deltas, not resends."""
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.io.arrow import ArrowDeltaWriter, read_stream
+    sft = SimpleFeatureType.from_spec("d", "name:String,*geom:Point")
+    p = str(tmp_path / "grow.arrows")
+    with ArrowDeltaWriter(p, sft) as w:
+        w.write(FeatureTable.build(sft, {
+            "name": ["x", "y"], "geom": ([0.0, 1.0], [0.0, 1.0])}))
+        w.write(FeatureTable.build(sft, {
+            "name": ["z", "x"], "geom": ([2.0, 3.0], [2.0, 3.0])}))
+    back = read_stream(p)
+    assert back.columns["name"].decode(np.arange(4)) == ["x", "y", "z", "x"]
+
+
+def test_merge_deltas_sorted(tmp_path):
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.io.arrow import ArrowDeltaWriter, merge_deltas, read_stream
+    sft = SimpleFeatureType.from_spec("m", "v:Int,*geom:Point")
+    paths = []
+    rng = np.random.default_rng(9)
+    for i in range(3):
+        p = str(tmp_path / f"part{i}.arrows")
+        v = rng.integers(0, 1000, 100).astype(np.int32)
+        with ArrowDeltaWriter(p, sft) as w:
+            w.write(FeatureTable.build(sft, {
+                "v": v, "geom": (rng.uniform(-1, 1, 100),
+                                 rng.uniform(-1, 1, 100))}))
+        paths.append(p)
+    out = str(tmp_path / "merged.arrows")
+    merge_deltas(paths, out, sort="v")
+    merged = read_stream(out)
+    assert len(merged) == 300
+    vals = np.asarray(merged.columns["v"])
+    assert np.all(np.diff(vals) >= 0)
+
+
+def test_update_schema_rejects_new_geometry_even_before_load():
+    ds = TpuDataStore()
+    ds.create_schema("g0", "v:Int,*geom:Point")
+    with pytest.raises(ValueError, match="geometry"):
+        ds.update_schema("g0", add_attributes="geom2:Polygon")
+
+
+def test_update_schema_refreshes_stats(store):
+    ds, data, x, y = store
+    ds.update_schema("u", add_attributes="score:Double")
+    ds.update_features("u", "v < 50", {"score": 2.0})
+    st = ds.stats("u")
+    mm = st.get_min_max("score")
+    assert mm is not None and float(mm.max) == 2.0
+
+
+def test_delta_stream_generic_geometry_attr(tmp_path):
+    """A 'Geometry'-typed attribute streams as WKB even when a batch is all
+    points (schema stability across batches)."""
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.features.geometry import GeometryArray
+    from geomesa_tpu.io.arrow import ArrowDeltaWriter, read_stream
+    sft = SimpleFeatureType.from_spec("gg", "*geom:Geometry")
+    p = str(tmp_path / "gg.arrows")
+    pts = GeometryArray.points(np.array([1.0, 2.0]), np.array([3.0, 4.0]))
+    with ArrowDeltaWriter(p, sft) as w:
+        w.write(FeatureTable.build(sft, {"geom": pts}))
+    back = read_stream(p)
+    bx = back.geometry()
+    assert len(back) == 2
+    np.testing.assert_allclose(bx.bboxes()[:, 0], [1.0, 2.0])
