@@ -1,0 +1,113 @@
+"""System-property registry: typed, documented runtime knobs.
+
+≙ the reference's three-tier config system (SURVEY.md §5): this is tier 1,
+``GeoMesaSystemProperties`` (/root/reference/geomesa-utils/src/main/scala/org/
+locationtech/geomesa/utils/conf/GeoMesaSystemProperties.scala:19) — a central
+registry of typed properties with environment-variable override and a
+programmatic ``set``/``unset`` for tests. Tier 2 (per-datastore params) lives
+on TpuDataStore(params); tier 3 (per-type config) rides in SFT user-data
+strings (``geomesa.indices``, ``geomesa.z3.interval`` …).
+
+Every property reads its env var on EACH access (late-bound, so tests and
+operators can flip knobs at runtime), falling back to a programmatic override
+then the default. ``describe()`` lists everything for the CLI/docs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class SystemProperty:
+    """One typed knob: ``prop.get()`` → env override → set() value → default."""
+
+    name: str                       # env var name
+    default: object
+    parse: Callable[[str], object]
+    doc: str
+    _override: object = field(default=None, repr=False)
+
+    def get(self):
+        raw = os.environ.get(self.name)
+        if raw is not None:
+            try:
+                return self.parse(raw)
+            except (TypeError, ValueError):
+                pass  # malformed env values fall back (reference behavior)
+        if self._override is not None:
+            return self._override
+        return self.default
+
+    def set(self, value) -> None:
+        self._override = value
+
+    def unset(self) -> None:
+        self._override = None
+
+
+_REGISTRY: Dict[str, SystemProperty] = {}
+
+
+def _register(name: str, default, parse, doc: str) -> SystemProperty:
+    prop = SystemProperty(name, default, parse, doc)
+    _REGISTRY[name] = prop
+    return prop
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+# -- the knobs ---------------------------------------------------------------
+
+SCAN_RANGES_TARGET = _register(
+    "GEOMESA_TPU_SCAN_RANGES_TARGET", 2000, int,
+    "Target key ranges per query cover (geomesa.scan.ranges.target, "
+    "QueryProperties.scala:22).")
+
+PRUNE_BLOCK = _register(
+    "GEOMESA_TPU_PRUNE_BLOCK", 4096, int,
+    "Rows per gather block for range-pruned scans.")
+
+PRUNE_MAX_FRACTION = _register(
+    "GEOMESA_TPU_PRUNE_MAX_FRAC", 0.25, float,
+    "Above this candidate fraction a full-table fused scan beats block "
+    "gathering (full-table-scan avoidance threshold).")
+
+PRUNE_ENABLED = _register(
+    "GEOMESA_TPU_PRUNE", True, _parse_bool,
+    "Master switch for range-pruned scan execution.")
+
+DEVICE_SORT_MIN = _register(
+    "GEOMESA_TPU_DEVICE_SORT_MIN", 2_000_000, int,
+    "Row count above which index sorts run on the accelerator.")
+
+LSM_MAX_FRACTION = _register(
+    "GEOMESA_TPU_LSM_MAX_FRAC", 0.02, float,
+    "Delta-run flush threshold as a fraction of the main table.")
+
+NO_NATIVE = _register(
+    "GEOMESA_TPU_NO_NATIVE", False, _parse_bool,
+    "Disable the native C++ encode path (numpy fallback). NB boolean "
+    "semantics: '0'/'false'/'no'/'off' mean NOT disabled (earlier releases "
+    "treated any non-empty value as disabling).")
+
+BENCH_N = _register(
+    "GEOMESA_TPU_BENCH_N", 100_000_000, int,
+    "bench.py corpus size.")
+
+
+def describe() -> Dict[str, dict]:
+    """name → {value, default, doc} for every registered property
+    (the CLI `config` listing / docs surface)."""
+    return {
+        name: {"value": p.get(), "default": p.default, "doc": p.doc}
+        for name, p in sorted(_REGISTRY.items())
+    }
+
+
+def get(name: str) -> SystemProperty:
+    return _REGISTRY[name]

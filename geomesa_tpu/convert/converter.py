@@ -138,6 +138,34 @@ class SimpleFeatureConverter:
         return self._convert({k: np.asarray(v, dtype=object)
                               for k, v in columns.items()}, n)
 
+    def convert_parquet(self, path: str) -> FeatureTable:
+        """Parquet ingest (≙ geomesa-convert-parquet): columns become field
+        refs by name; the expression pipeline applies as for any format."""
+        from geomesa_tpu.convert.formats import read_parquet_columns
+        cols = read_parquet_columns(path)
+        if not cols:
+            return self._empty()
+        return self.convert_columns(cols)
+
+    def convert_xml(self, text_or_path: str, record_tag: str) -> FeatureTable:
+        """XML ingest (≙ geomesa-convert-xml): one feature per
+        ``record_tag`` element; child elements and @attributes are fields."""
+        from geomesa_tpu.convert.formats import read_xml_records
+        cols = read_xml_records(text_or_path, record_tag)
+        if not cols:
+            return self._empty()
+        return self._convert(cols, len(next(iter(cols.values()))))
+
+    def convert_fixed_width(self, text_or_path: str,
+                            fields) -> FeatureTable:
+        """Fixed-width text ingest (≙ geomesa-convert-fixedwidth).
+        ``fields``: (name, start, width) byte slices per column."""
+        from geomesa_tpu.convert.formats import read_fixed_width
+        cols = read_fixed_width(text_or_path, fields)
+        if not cols:
+            return self._empty()
+        return self._convert(cols, len(next(iter(cols.values()))))
+
     # -- core ----------------------------------------------------------------
 
     def _convert(self, fields: Dict[str, np.ndarray], n: int) -> FeatureTable:
@@ -188,7 +216,7 @@ def _looks_like_path(s: str) -> bool:
     import os
     if os.path.exists(s):
         return True
-    if "\n" not in s and s.endswith((".csv", ".tsv", ".txt", ".json", ".jsonl")):
+    if "\n" not in s and s.endswith((".csv", ".tsv", ".txt", ".json", ".jsonl", ".xml", ".dat", ".fw")):
         raise FileNotFoundError(f"No such file: {s}")
     return False
 

@@ -1,0 +1,201 @@
+"""Additional ingest formats: Parquet, XML, fixed-width, ESRI Shapefile.
+
+≙ the reference's format modules under geomesa-convert-* (SURVEY.md §2.10:
+text/CSV, JSON, XML, Avro, Parquet, shapefile, fixed-width …). Each format
+lands raw fields as numpy columns and runs the shared converter pipeline
+(expression transforms + validation in convert/converter.py), exactly as
+every reference format funnels through AbstractConverter.scala:50.
+
+The shapefile reader is self-contained (the .shp/.dbf binary layouts are
+small public specs) — points, multipoints, polylines and polygons, with
+attributes from the sidecar dBASE file.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.features import geometry as geo
+
+
+# -- parquet -----------------------------------------------------------------
+
+
+def read_parquet_columns(path: str) -> Dict[str, np.ndarray]:
+    """Parquet file → raw field columns (strings as object arrays)."""
+    import pyarrow.parquet as pq
+
+    at = pq.read_table(path)
+    out: Dict[str, np.ndarray] = {}
+    for name in at.column_names:
+        col = at.column(name).combine_chunks()
+        import pyarrow as pa
+        if pa.types.is_dictionary(col.type):
+            col = col.cast(col.type.value_type)
+        if pa.types.is_string(col.type) or pa.types.is_large_string(col.type) \
+                or pa.types.is_binary(col.type):
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+        elif pa.types.is_timestamp(col.type):
+            out[name] = np.asarray(col.cast("int64"))
+        else:
+            out[name] = np.asarray(col)
+    return out
+
+
+# -- xml ---------------------------------------------------------------------
+
+
+def read_xml_records(text_or_path: str, record_tag: str) -> Dict[str, np.ndarray]:
+    """XML → columns: one record per ``record_tag`` element; fields are the
+    record's child-element texts and attributes (attribute keys prefixed
+    ``@``). ≙ the XPath field extraction of geomesa-convert-xml."""
+    import xml.etree.ElementTree as ET
+
+    from geomesa_tpu.convert.converter import _looks_like_path
+
+    if _looks_like_path(text_or_path):
+        root = ET.parse(text_or_path).getroot()
+    else:
+        root = ET.fromstring(text_or_path)
+    records = root.iter(record_tag)
+    rows: List[Dict[str, str]] = []
+    for rec in records:
+        row: Dict[str, str] = dict((f"@{k}", v) for k, v in rec.attrib.items())
+        for child in rec:
+            row[child.tag] = (child.text or "").strip()
+        rows.append(row)
+    names = sorted({k for r in rows for k in r})
+    return {name: np.asarray([r.get(name, "") for r in rows], dtype=object)
+            for name in names}
+
+
+# -- fixed width -------------------------------------------------------------
+
+
+def read_fixed_width(text_or_path: str, fields: Sequence[Tuple[str, int, int]]
+                     ) -> Dict[str, np.ndarray]:
+    """Fixed-width text → columns. fields: (name, start, width) per column
+    (0-based byte offsets; values strip whitespace)."""
+    from geomesa_tpu.convert.converter import _looks_like_path
+
+    if _looks_like_path(text_or_path):
+        with open(text_or_path) as f:
+            lines = [l.rstrip("\n") for l in f if l.strip()]
+    else:
+        lines = [l for l in text_or_path.splitlines() if l.strip()]
+    out: Dict[str, np.ndarray] = {}
+    for name, start, width in fields:
+        out[name] = np.asarray(
+            [l[start:start + width].strip() for l in lines], dtype=object)
+    return out
+
+
+# -- shapefile ---------------------------------------------------------------
+
+_SHP_POINT, _SHP_POLYLINE, _SHP_POLYGON, _SHP_MULTIPOINT = 1, 3, 5, 8
+
+
+def read_shapefile(path: str):
+    """ESRI shapefile → (GeometryArray, attribute columns from the .dbf).
+
+    Supports Point (1), PolyLine (3), Polygon (5) and MultiPoint (8) records
+    (plus their Z/M variants, ignoring Z/M). Null shapes become empty
+    geometries are skipped along with their attribute rows."""
+    base, _ = os.path.splitext(path)
+    shapes: List[tuple] = []
+    keep_rows: List[int] = []
+    with open(base + ".shp", "rb") as f:
+        header = f.read(100)
+        if struct.unpack(">i", header[:4])[0] != 9994:
+            raise ValueError("Not a shapefile (bad magic)")
+        rec = 0
+        while True:
+            rh = f.read(8)
+            if len(rh) < 8:
+                break
+            (_num, length) = struct.unpack(">ii", rh)
+            content = f.read(length * 2)
+            shape_type = struct.unpack("<i", content[:4])[0] % 10  # fold Z/M
+            if shape_type == _SHP_POINT:
+                x, y = struct.unpack("<dd", content[4:20])
+                shapes.append((geo.POINT, [x, y]))
+                keep_rows.append(rec)
+            elif shape_type in (_SHP_POLYLINE, _SHP_POLYGON):
+                nparts, npoints = struct.unpack("<ii", content[36:44])
+                parts = struct.unpack(f"<{nparts}i", content[44:44 + 4 * nparts])
+                pts_off = 44 + 4 * nparts
+                pts = np.frombuffer(
+                    content[pts_off:pts_off + 16 * npoints],
+                    dtype="<f8").reshape(npoints, 2)
+                bounds = list(parts) + [npoints]
+                rings = [pts[bounds[i]:bounds[i + 1]].tolist()
+                         for i in range(nparts)]
+                if shape_type == _SHP_POLYGON:
+                    shapes.append((geo.POLYGON, rings))
+                elif nparts == 1:
+                    shapes.append((geo.LINESTRING, rings[0]))
+                else:
+                    shapes.append((geo.MULTILINESTRING, rings))
+                keep_rows.append(rec)
+            elif shape_type == _SHP_MULTIPOINT:
+                npoints = struct.unpack("<i", content[36:40])[0]
+                pts = np.frombuffer(content[40:40 + 16 * npoints],
+                                    dtype="<f8").reshape(npoints, 2)
+                shapes.append((geo.MULTIPOINT, pts.tolist()))
+                keep_rows.append(rec)
+            # shape_type 0 (null) and unsupported types skip the record
+            rec += 1
+    garr = geo.GeometryArray.from_shapes(shapes)
+    attrs = {}
+    if os.path.exists(base + ".dbf"):
+        attrs = _read_dbf(base + ".dbf")
+        attrs = {k: v[np.asarray(keep_rows, dtype=np.int64)]
+                 for k, v in attrs.items()}
+    return garr, attrs
+
+
+def _read_dbf(path: str) -> Dict[str, np.ndarray]:
+    """dBASE III attribute table → object columns (numeric fields parse to
+    float/int where clean)."""
+    with open(path, "rb") as f:
+        header = f.read(32)
+        n_records = struct.unpack("<i", header[4:8])[0]
+        header_len, record_len = struct.unpack("<hh", header[8:12])
+        fields = []
+        while True:
+            fd = f.read(32)
+            if fd[0:1] == b"\r" or len(fd) < 32:
+                break
+            name = fd[:11].split(b"\x00")[0].decode("ascii", "replace")
+            ftype = fd[11:12].decode("ascii")
+            size = fd[16]
+            fields.append((name, ftype, size))
+        f.seek(header_len)
+        raw: Dict[str, list] = {name: [] for name, _, _ in fields}
+        for _ in range(n_records):
+            rec = f.read(record_len)
+            if len(rec) < record_len or rec[0:1] == b"\x1a":
+                break
+            pos = 1  # deletion flag
+            for name, ftype, size in fields:
+                val = rec[pos:pos + size].decode("latin-1").strip()
+                raw[name].append(val)
+                pos += size
+    out: Dict[str, np.ndarray] = {}
+    for name, ftype, _ in fields:
+        vals = raw[name]
+        if ftype in ("N", "F"):
+            def num(v):
+                try:
+                    fv = float(v)
+                    return int(fv) if fv.is_integer() else fv
+                except ValueError:
+                    return 0
+            out[name] = np.asarray([num(v) for v in vals], dtype=object)
+        else:
+            out[name] = np.asarray(vals, dtype=object)
+    return out

@@ -164,25 +164,30 @@ class TpuDataStore:
         delta run (cost ~ O(batch), not O(table)); the main device index
         rebuilds only on the first load or when the delta crosses the flush
         threshold. Queries merge main + delta exactly (see count/query)."""
-        import os
-
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        _metrics.inc("ingest.features", len(batch))
         current = self.tables.get(type_name)
         if current is None:
             self.tables[type_name] = batch
             self.deltas[type_name] = None
-            self._rebuild_indexes(type_name, stats_cached)
+            with _metrics.time("ingest.index_build"):
+                self._rebuild_indexes(type_name, stats_cached)
             return
         delta = self.deltas.get(type_name)
         merged_delta = batch if delta is None else FeatureTable.concat([delta, batch])
-        frac = float(os.environ.get("GEOMESA_TPU_LSM_MAX_FRAC", 0.02))
+        from geomesa_tpu import config
+        frac = config.LSM_MAX_FRACTION.get()
         threshold = max(50_000, int(frac * len(current)))
         if stats_cached is not None or len(merged_delta) > threshold:
             # flush-through (large batch, or a checkpoint restore that must
             # land its cached sketches against the merged table)
+            _metrics.inc("ingest.flushes")
             self.deltas[type_name] = None
             self.tables[type_name] = FeatureTable.concat([current, merged_delta])
-            self._rebuild_indexes(type_name, stats_cached)
+            with _metrics.time("ingest.index_build"):
+                self._rebuild_indexes(type_name, stats_cached)
         else:
+            _metrics.inc("ingest.delta_appends")
             # stat sketches stay main-table-only while a delta is pending
             # (GeoMesaStats.update REPLACES the battery — re-observing just
             # the batch would swap whole-table estimates for batch-only
@@ -379,6 +384,12 @@ class TpuDataStore:
 
     def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
               auths: Optional[list] = None) -> int:
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        _metrics.inc("query.counts")
+        with _metrics.time("query.count"):
+            return self._count_impl(type_name, f, auths)
+
+    def _count_impl(self, type_name, f, auths) -> int:
         c = self._main_planner(type_name).count(f, auths=auths)
         if self.deltas.get(type_name) is not None:
             c += len(self._delta_rows(type_name, f, auths))

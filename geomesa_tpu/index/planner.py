@@ -227,8 +227,8 @@ class QueryPlanner:
         """Candidate gather-blocks for a plan (cached on the plan), or None
         when the full-table fused mask is the better scan. ≙ choosing ranged
         scans over a full-table scan (QueryProperties.BlockFullTableScans)."""
-        import os
-        if os.environ.get("GEOMESA_TPU_PRUNE", "1") == "0":
+        from geomesa_tpu import config
+        if not config.PRUNE_ENABLED.get():
             return None
         if plan.blocks is False:
             blocks = None

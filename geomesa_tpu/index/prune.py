@@ -21,21 +21,33 @@ touched anyway).
 
 from __future__ import annotations
 
-import os
+import sys
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from geomesa_tpu import config
 from geomesa_tpu.curves.ranges import IndexRange
 
-# ≙ geomesa.scan.ranges.target (QueryProperties.scala:22)
-MAX_RANGES = int(os.environ.get("GEOMESA_TPU_SCAN_RANGES_TARGET", 2000))
-# rows per gather block: big enough for coalesced HBM reads, small enough
-# that cover slop stays low (0.5-4K rows per reference tablet-range is the
-# same ballpark the 2000-range target implies)
-BLOCK_SIZE = int(os.environ.get("GEOMESA_TPU_PRUNE_BLOCK", 4096))
-# above this candidate fraction, full-table streaming wins over gathering
-PRUNE_MAX_FRACTION = float(os.environ.get("GEOMESA_TPU_PRUNE_MAX_FRAC", 0.25))
+# MAX_RANGES / BLOCK_SIZE / PRUNE_MAX_FRACTION resolve through the config
+# registry on EVERY access (PEP 562 module __getattr__ below), so env/set()
+# overrides take effect at runtime; tests may still monkeypatch the module
+# attribute directly (a real attribute shadows __getattr__).
+#   MAX_RANGES         ≙ geomesa.scan.ranges.target (QueryProperties.scala:22)
+#   BLOCK_SIZE         rows per gather block (coalesced HBM reads vs slop)
+#   PRUNE_MAX_FRACTION above this candidate fraction a full scan wins
+_CONFIG_ATTRS = {
+    "MAX_RANGES": "SCAN_RANGES_TARGET",
+    "BLOCK_SIZE": "PRUNE_BLOCK",
+    "PRUNE_MAX_FRACTION": "PRUNE_MAX_FRACTION",
+}
+
+
+def __getattr__(name: str):
+    prop = _CONFIG_ATTRS.get(name)
+    if prop is None:
+        raise AttributeError(name)
+    return getattr(config, prop).get()
 # cap on per-query interval decomposition (bins), mirroring the reference's
 # per-epoch range decomposition limits
 MAX_BINS = 512
@@ -67,7 +79,7 @@ def slices_to_blocks(slices: np.ndarray, n_rows: int,
     would be degenerate (no slices). ``block_size`` defaults to the *current*
     module BLOCK_SIZE (late-bound so runtime/test overrides take effect)."""
     if block_size is None:
-        block_size = BLOCK_SIZE
+        block_size = sys.modules[__name__].BLOCK_SIZE
     if len(slices) == 0:
         return None
     last = max(0, (n_rows - 1) // block_size)
@@ -85,7 +97,7 @@ def candidate_stats(slices: np.ndarray, blocks: Optional[np.ndarray],
                     n_rows: int, block_size: Optional[int] = None) -> dict:
     """Explain payload for a pruned plan."""
     if block_size is None:
-        block_size = BLOCK_SIZE
+        block_size = sys.modules[__name__].BLOCK_SIZE
     rows = int((slices[:, 1] - slices[:, 0]).sum()) if len(slices) else 0
     nb = 0 if blocks is None else len(blocks)
     return {

@@ -19,7 +19,7 @@ low-selectivity queries.
 
 from __future__ import annotations
 
-import os
+import sys
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -39,8 +39,15 @@ from geomesa_tpu.index.scan import ScanKernels, pad_boxes, pad_windows, split_re
 # Above this row count the index-key sort and row reorder run on the
 # accelerator (3×21-bit int32 key planes through lax.sort + one fused gather)
 # instead of a single-core host lexsort — ~80× faster at 100M rows.
-DEVICE_SORT_MIN_ROWS = int(os.environ.get("GEOMESA_TPU_DEVICE_SORT_MIN",
-                                          2_000_000))
+# DEVICE_SORT_MIN_ROWS resolves through the config registry on each access
+# (PEP 562) so runtime overrides apply; tests may monkeypatch it directly.
+from geomesa_tpu import config as _config
+
+
+def __getattr__(name: str):
+    if name == "DEVICE_SORT_MIN_ROWS":
+        return _config.DEVICE_SORT_MIN.get()
+    raise AttributeError(name)
 
 _MASK21 = (1 << 21) - 1
 
@@ -207,7 +214,7 @@ class BaseSpatialIndex:
             if keys is None:
                 self._perm_cache = np.arange(n, dtype=np.int64)
                 self.device = DeviceTable.build(table, self._perm_cache, self.period)
-            elif n >= DEVICE_SORT_MIN_ROWS and all(
+            elif n >= sys.modules[__name__].DEVICE_SORT_MIN_ROWS and all(
                     k.dtype == np.int32 for k in keys):
                 self._dev_perm = device_sort_perm(keys)
                 self.device = DeviceTable.build_on_device(
@@ -289,7 +296,7 @@ class BaseSpatialIndex:
         upload.pop("z", None)  # host-only (range-pruning searchsorted)
         upload.update(extra)
 
-        if n < DEVICE_SORT_MIN_ROWS:
+        if n < sys.modules[__name__].DEVICE_SORT_MIN_ROWS:
             # small tables: host lexsort + host gather (device sort overhead
             # isn't worth it; keeps the native path exercised by unit tests)
             keys = [upload[name] for name in key_names]

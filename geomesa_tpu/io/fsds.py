@@ -1,0 +1,334 @@
+"""Partitioned file-system storage (FSDS).
+
+≙ the reference's geomesa-fs module (SURVEY.md §2.6): a partition-scheme
+directory layout (Z2Scheme / DateTimeScheme / AttributeScheme /
+CompositeScheme, fs-storage-common/.../partitions/) over Parquet files, with
+metadata in a sidecar file, query-time partition pruning from the filter,
+and per-partition compaction (AbstractFileSystemStorage.scala:395).
+
+Layout:  root/_metadata.json
+         root/<partition>/<uuid>.parquet      (one file per write batch)
+
+Queries read ONLY the partitions the filter can touch (z2 cells from the
+bbox extraction, date buckets from the interval extraction, attribute
+values from equality predicates), then refine exactly on the host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable, StringColumn
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.evaluate import evaluate as _evaluate
+from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
+from geomesa_tpu.filter.parser import parse_ecql
+
+
+class PartitionScheme:
+    """Row → partition-name mapping + filter → partition pruning."""
+
+    name = "base"
+
+    def partition_of(self, table: FeatureTable) -> np.ndarray:
+        raise NotImplementedError
+
+    def matching(self, f: Optional[ir.Filter], sft,
+                 present: Sequence[str]) -> List[str]:
+        """Subset of ``present`` partitions the filter can match (superset
+        semantics — refinement happens after the read)."""
+        return list(present)
+
+    def to_dict(self) -> dict:
+        return {"scheme": self.name}
+
+    @staticmethod
+    def from_dict(d: dict) -> "PartitionScheme":
+        s = d["scheme"]
+        if s == "z2":
+            return Z2Scheme(d.get("bits", 4))
+        if s == "datetime":
+            return DateTimeScheme(d.get("period", "day"))
+        if s == "attribute":
+            return AttributeScheme(d["attribute"])
+        if s == "composite":
+            return CompositeScheme([PartitionScheme.from_dict(x)
+                                    for x in d["parts"]])
+        raise ValueError(f"Unknown partition scheme {s!r}")
+
+
+class Z2Scheme(PartitionScheme):
+    """2^bits × 2^bits lon/lat grid cells (≙ fs Z2Scheme)."""
+
+    name = "z2"
+
+    def __init__(self, bits: int = 4):
+        self.bits = int(bits)
+
+    def _cells(self, x, y):
+        g = 1 << self.bits
+        ix = np.clip(((np.asarray(x) + 180.0) * (g / 360.0)).astype(np.int64),
+                     0, g - 1)
+        iy = np.clip(((np.asarray(y) + 90.0) * (g / 180.0)).astype(np.int64),
+                     0, g - 1)
+        return ix, iy
+
+    def partition_of(self, table):
+        bb = table.geometry().bboxes()
+        ix, iy = self._cells((bb[:, 0] + bb[:, 2]) / 2, (bb[:, 1] + bb[:, 3]) / 2)
+        return np.asarray([f"z2_{self.bits}_{a}_{b}"
+                           for a, b in zip(ix, iy)], dtype=object)
+
+    def matching(self, f, sft, present):
+        geom = sft.geometry_attribute
+        if f is None or geom is None:
+            return list(present)
+        ext = extract_bboxes(f, geom.name)
+        if ext.unconstrained:
+            return list(present)
+        keep = set()
+        g = 1 << self.bits
+        for xmin, ymin, xmax, ymax in ext.boxes:
+            ix0, iy0 = self._cells(np.array([xmin]), np.array([ymin]))
+            ix1, iy1 = self._cells(np.array([xmax]), np.array([ymax]))
+            for a in range(int(ix0[0]), int(ix1[0]) + 1):
+                for b in range(int(iy0[0]), int(iy1[0]) + 1):
+                    keep.add(f"z2_{self.bits}_{a}_{b}")
+        return [p for p in present if p in keep]
+
+    def to_dict(self):
+        return {"scheme": "z2", "bits": self.bits}
+
+
+class DateTimeScheme(PartitionScheme):
+    """Daily/weekly time buckets (≙ fs DateTimeScheme)."""
+
+    name = "datetime"
+    _MS = {"day": 86_400_000, "week": 7 * 86_400_000}
+
+    def __init__(self, period: str = "day"):
+        if period not in self._MS:
+            raise ValueError(f"period must be day|week, got {period!r}")
+        self.period = period
+
+    def partition_of(self, table):
+        dtg = table.dtg()
+        if dtg is None:
+            raise ValueError("DateTimeScheme needs a dtg attribute")
+        b = np.asarray(dtg, dtype=np.int64) // self._MS[self.period]
+        return np.asarray([f"{self.period}_{v}" for v in b], dtype=object)
+
+    def matching(self, f, sft, present):
+        dtg = sft.dtg_attribute
+        if f is None or dtg is None:
+            return list(present)
+        iv = extract_intervals(f, dtg.name)
+        if iv.unconstrained:
+            return list(present)
+        ms = self._MS[self.period]
+        keep = set()
+        for lo, hi in iv.intervals:
+            for b in range(int(lo) // ms, int(hi) // ms + 1):
+                keep.add(f"{self.period}_{b}")
+        return [p for p in present if p in keep]
+
+    def to_dict(self):
+        return {"scheme": "datetime", "period": self.period}
+
+
+class AttributeScheme(PartitionScheme):
+    """One partition per attribute value (≙ fs AttributeScheme)."""
+
+    name = "attribute"
+
+    def __init__(self, attribute: str):
+        self.attribute = attribute
+
+    def partition_of(self, table):
+        col = table.columns[self.attribute]
+        if isinstance(col, StringColumn):
+            vals = col.decode(np.arange(len(col)))
+        else:
+            vals = [str(v) for v in np.asarray(col)]
+        return np.asarray([f"{self.attribute}_{v}" for v in vals], dtype=object)
+
+    def matching(self, f, sft, present):
+        if f is None:
+            return list(present)
+        vals = _equality_values(f, self.attribute)
+        if vals is None:
+            return list(present)
+        keep = {f"{self.attribute}_{v}" for v in vals}
+        return [p for p in present if p in keep]
+
+    def to_dict(self):
+        return {"scheme": "attribute", "attribute": self.attribute}
+
+
+class CompositeScheme(PartitionScheme):
+    """Nested schemes → nested directories (≙ fs CompositeScheme)."""
+
+    name = "composite"
+
+    def __init__(self, parts: Sequence[PartitionScheme]):
+        self.parts = list(parts)
+
+    def partition_of(self, table):
+        subs = [p.partition_of(table) for p in self.parts]
+        return np.asarray(["/".join(row) for row in zip(*subs)], dtype=object)
+
+    def matching(self, f, sft, present):
+        split = [p.split("/") for p in present]
+        keep = []
+        for parts in split:
+            ok = True
+            for scheme, part in zip(self.parts, parts):
+                if not scheme.matching(f, sft, [part]):
+                    ok = False
+                    break
+            if ok:
+                keep.append("/".join(parts))
+        return keep
+
+    def to_dict(self):
+        return {"scheme": "composite",
+                "parts": [p.to_dict() for p in self.parts]}
+
+
+def _equality_values(f: ir.Filter, attr: str) -> Optional[set]:
+    """Values `attr` must equal for the filter to match, or None when the
+    filter doesn't pin the attribute (AND intersects, OR unions)."""
+    if isinstance(f, ir.Cmp) and f.attr == attr and f.op == "=":
+        return {str(f.value)}
+    if isinstance(f, ir.In) and f.attr == attr:
+        return {str(v) for v in f.values}
+    if isinstance(f, ir.And):
+        vals = None
+        for c in f.children:
+            v = _equality_values(c, attr)
+            if v is not None:
+                vals = v if vals is None else (vals & v)
+        return vals
+    if isinstance(f, ir.Or):
+        out = set()
+        for c in f.children:
+            v = _equality_values(c, attr)
+            if v is None:
+                return None
+            out |= v
+        return out
+    return None
+
+
+class FileSystemStorage:
+    """Partitioned Parquet store with pruned reads and compaction."""
+
+    _META = "_metadata.json"
+
+    def __init__(self, root: str, sft: Optional[SimpleFeatureType] = None,
+                 scheme: Optional[PartitionScheme] = None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        meta_path = os.path.join(root, self._META)
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+            self.sft = SimpleFeatureType.from_spec(meta["name"], meta["spec"])
+            self.scheme = PartitionScheme.from_dict(meta["scheme"])
+        else:
+            if sft is None or scheme is None:
+                raise ValueError("New storage needs sft= and scheme=")
+            self.sft = sft
+            self.scheme = scheme
+            with open(meta_path, "w") as fh:
+                json.dump({"name": sft.name, "spec": sft.to_spec(),
+                           "scheme": scheme.to_dict()}, fh)
+
+    # -- writes --------------------------------------------------------------
+
+    def write(self, table: FeatureTable) -> Dict[str, int]:
+        """Append a batch: rows split by partition, one new Parquet file per
+        touched partition (compaction merges later)."""
+        from geomesa_tpu.io.arrow import to_arrow
+        import pyarrow.parquet as pq
+
+        parts = self.scheme.partition_of(table)
+        out: Dict[str, int] = {}
+        for p in np.unique(parts):
+            rows = np.flatnonzero(parts == p)
+            sub = table.take(rows)
+            pdir = os.path.join(self.root, str(p))
+            os.makedirs(pdir, exist_ok=True)
+            pq.write_table(to_arrow(sub),
+                           os.path.join(pdir, f"{uuid.uuid4().hex}.parquet"))
+            out[str(p)] = len(rows)
+        return out
+
+    # -- reads ---------------------------------------------------------------
+
+    def partitions(self) -> List[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            if any(f.endswith(".parquet") for f in files):
+                out.append(os.path.relpath(dirpath, self.root))
+        return sorted(out)
+
+    def files(self, partition: str) -> List[str]:
+        pdir = os.path.join(self.root, partition)
+        return sorted(os.path.join(pdir, f) for f in os.listdir(pdir)
+                      if f.endswith(".parquet"))
+
+    def read(self, f=None) -> FeatureTable:
+        """Read matching features: partition pruning → parquet reads →
+        exact host refine (≙ the FSDS query path: prune, columnar scan,
+        client filter)."""
+        from geomesa_tpu.io.arrow import from_arrow
+        import pyarrow.parquet as pq
+
+        fir = parse_ecql(f) if isinstance(f, str) else f
+        parts = self.scheme.matching(fir, self.sft, self.partitions())
+        tables = []
+        for p in parts:
+            for fp in self.files(p):
+                t = from_arrow(pq.read_table(fp), self.sft)
+                if fir is not None and not isinstance(fir, ir.Include):
+                    mask = _evaluate(fir, t)
+                    t = t.take(np.flatnonzero(mask))
+                if len(t):
+                    tables.append(t)
+        if not tables:
+            from geomesa_tpu.features.geometry import GeometryArray
+            return FeatureTable.build(self.sft, {
+                a.name: (GeometryArray.from_shapes([]) if a.is_geometry
+                         else [])
+                for a in self.sft.attributes})
+        return FeatureTable.concat(tables)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def compact(self, partition: Optional[str] = None) -> Dict[str, int]:
+        """Merge each partition's files into one (≙ FSDS compaction)."""
+        from geomesa_tpu.io.arrow import from_arrow, to_arrow
+        import pyarrow.parquet as pq
+
+        targets = [partition] if partition else self.partitions()
+        out: Dict[str, int] = {}
+        for p in targets:
+            files = self.files(p)
+            if len(files) <= 1:
+                out[p] = len(files)
+                continue
+            merged = FeatureTable.concat(
+                [from_arrow(pq.read_table(fp), self.sft) for fp in files])
+            tmp = os.path.join(self.root, p, f"{uuid.uuid4().hex}.parquet")
+            pq.write_table(to_arrow(merged), tmp)
+            for fp in files:
+                os.remove(fp)
+            out[p] = 1
+        return out

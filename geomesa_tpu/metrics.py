@@ -1,0 +1,83 @@
+"""Metrics/observability: counters, timers, and a pluggable reporter.
+
+≙ the reference's converter ingest metrics + audit surface (SURVEY.md §5:
+dropwizard metrics with graphite/cloudwatch/ganglia reporters in
+geomesa-convert-metrics-*; QueryEvent audit records in index/audit/
+QueryEvent.scala:13). Here a process-local registry collects ingest and
+query counters/timers; ``snapshot()`` serializes for the CLI/REST surface,
+and ``add_reporter`` hooks a callable for external sinks (the
+graphite-reporter slot)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Callable, Dict, List
+
+
+class MetricsRegistry:
+    """Thread-safe counters + duration histograms (count/total/max)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._timers: Dict[str, List[float]] = defaultdict(
+            lambda: [0, 0.0, 0.0])  # [count, total_s, max_s]
+        self._reporters: List[Callable[[str, str, float], None]] = []
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+            reporters = list(self._reporters)
+        self._report(reporters, "counter", name, n)
+
+    @contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                t = self._timers[name]
+                t[0] += 1
+                t[1] += dt
+                t[2] = max(t[2], dt)
+                reporters = list(self._reporters)
+            self._report(reporters, "timer", name, dt)
+
+    @staticmethod
+    def _report(reporters, kind: str, name: str, value: float) -> None:
+        for r in reporters:
+            try:
+                r(kind, name, value)
+            except Exception:
+                pass  # a failing sink must never fail the store (dropwizard rule)
+
+    def add_reporter(self, fn: Callable[[str, str, float], None]) -> None:
+        """fn(kind, name, value) — the external-sink slot (graphite/etc.)."""
+        with self._lock:
+            self._reporters.append(fn)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers": {
+                    k: {"count": int(v[0]), "total_s": round(v[1], 6),
+                        "mean_ms": round(v[1] / v[0] * 1000, 3) if v[0] else 0.0,
+                        "max_ms": round(v[2] * 1000, 3)}
+                    for k, v in self._timers.items()
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+
+# process-global default registry (≙ the shared MetricRegistry)
+REGISTRY = MetricsRegistry()

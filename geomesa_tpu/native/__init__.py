@@ -74,7 +74,8 @@ def _load():
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+        from geomesa_tpu import config
+        if config.NO_NATIVE.get():
             _load_failed = True
             return None
         try:

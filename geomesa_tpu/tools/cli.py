@@ -184,6 +184,20 @@ def cmd_delete(args):
     print(f"Deleted {n} features")
 
 
+def cmd_config(args):
+    from geomesa_tpu import config as cfg
+    for name, d in cfg.describe().items():
+        mark = "" if d["value"] == d["default"] else "  (set)"
+        print(f"{name} = {d['value']}{mark}\n    {d['doc']}")
+
+
+def cmd_serve(args):
+    from geomesa_tpu.web import serve
+    store = _load(args.store, must_exist=True)
+    print(f"Serving {args.store} on http://{args.host}:{args.port}")
+    serve(store, host=args.host, port=args.port)
+
+
 def cmd_remove_schema(args):
     store = _load(args.store, must_exist=True)
     store.remove_schema(args.feature)
@@ -266,6 +280,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("remove-schema", help="drop a feature type")
     common(sp)
     sp.set_defaults(fn=cmd_remove_schema)
+
+    sp = sub.add_parser("config", help="list system properties")
+    sp.set_defaults(fn=cmd_config)
+
+    sp = sub.add_parser("serve", help="REST/GeoJSON API over a store")
+    sp.add_argument("-s", "--store", required=True)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8765)
+    sp.set_defaults(fn=cmd_serve)
 
     return p
 

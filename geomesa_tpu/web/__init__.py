@@ -1,0 +1,5 @@
+"""HTTP/GeoJSON API surface (≙ geomesa-web + geomesa-geojson)."""
+
+from geomesa_tpu.web.server import GeoJsonApi, serve
+
+__all__ = ["GeoJsonApi", "serve"]

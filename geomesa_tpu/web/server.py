@@ -1,0 +1,169 @@
+"""REST / GeoJSON API over a TpuDataStore.
+
+≙ the reference's web surface: the Scalatra data servlets + stats endpoint
+(geomesa-web, /root/reference/geomesa-web/geomesa-web-stats/.../
+GeoMesaStatsEndpoint.scala) and the pure-JSON API of geomesa-geojson
+(geojson-api/.../GeoJsonGtIndex.scala). Stdlib http.server — no framework
+dependency; the handler core (`GeoJsonApi.handle`) is transport-agnostic so
+it can mount under any WSGI/ASGI shim.
+
+Routes:
+  GET  /types                          → type names
+  GET  /types/{t}                      → schema + row count
+  GET  /types/{t}/features?cql=&limit=&sort=&crs=   → GeoJSON FeatureCollection
+  GET  /types/{t}/count?cql=           → {"count": n}
+  GET  /types/{t}/explain?cql=         → query plan JSON
+  GET  /types/{t}/stats?stat=<dsl>     → stat sketch JSON
+  POST /types/{t}/features             → ingest a GeoJSON FeatureCollection
+  GET  /metrics                        → metrics snapshot
+  GET  /config                         → system-property listing
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+
+class GeoJsonApi:
+    """Transport-agnostic request handler core."""
+
+    def __init__(self, store):
+        self.store = store
+
+    # returns (status, payload dict)
+    def handle(self, method: str, path: str, query: dict,
+               body: Optional[bytes] = None) -> Tuple[int, dict]:
+        try:
+            return self._route(method, path, query, body)
+        except Exception as e:  # surface planner/parser/data errors as 400s
+            return 400, {"error": str(e)}
+
+    def _route(self, method, path, query, body):
+        parts = [p for p in path.split("/") if p]
+        if parts == ["types"]:
+            return 200, {"types": self.store.get_type_names()}
+        if parts == ["metrics"]:
+            from geomesa_tpu.metrics import REGISTRY
+            return 200, REGISTRY.snapshot()
+        if parts == ["config"]:
+            from geomesa_tpu import config
+            return 200, config.describe()
+        if len(parts) >= 2 and parts[0] == "types":
+            t = parts[1]
+            if t not in self.store.get_type_names():
+                return 404, {"error": f"no such type {t!r}"}
+            rest = parts[2:]
+            cql = query.get("cql", ["INCLUDE"])[0]
+            auths = query["auths"][0].split(",") if "auths" in query else None
+            if not rest:
+                sft = self.store.get_schema(t)
+                n = len(self.store.tables[t]) if self.store.tables.get(t) is not None else 0
+                delta = self.store.deltas.get(t)
+                return 200, {"name": t, "spec": sft.to_spec(),
+                             "attributes": [
+                                 {"name": a.name, "type": a.type_name,
+                                  "default": a.default}
+                                 for a in sft.attributes],
+                             "count": n + (len(delta) if delta is not None else 0)}
+            if rest == ["count"]:
+                return 200, {"count": self.store.count(t, cql, auths=auths)}
+            if rest == ["explain"]:
+                out = self.store.explain(t, cql)
+                return 200, json.loads(json.dumps(out, default=str))
+            if rest == ["stats"]:
+                stat = query.get("stat", [None])[0]
+                if not stat:
+                    return 400, {"error": "missing ?stat= DSL expression"}
+                res = self.store.stats(t).run_stat(stat, cql, auths=auths)
+                return 200, {"stat": stat, "result": res.to_dict()
+                             if hasattr(res, "to_dict") else str(res)}
+            if rest == ["features"] and method == "GET":
+                hints = {}
+                if "limit" in query:
+                    hints["limit"] = int(query["limit"][0])
+                if "sort" in query:
+                    hints["sort"] = query["sort"][0]
+                if "crs" in query:
+                    hints["crs"] = query["crs"][0]
+                res = self.store.query(t, cql, hints=hints or None,
+                                       auths=auths)
+                from geomesa_tpu.io.export import export
+                return 200, json.loads(export(res.table, "geojson"))
+            if rest == ["features"] and method == "POST":
+                fc = json.loads(body or b"{}")
+                n = self._ingest_geojson(t, fc)
+                return 200, {"ingested": n}
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _ingest_geojson(self, t: str, fc: dict) -> int:
+        feats = fc.get("features", [])
+        if not feats:
+            return 0
+        sft = self.store.get_schema(t)
+        with self.store.get_writer(t) as w:
+            for f in feats:
+                props = dict(f.get("properties", {}))
+                geom = f.get("geometry") or {}
+                coords = geom.get("coordinates")
+                gtype = (geom.get("type") or "Point").upper()
+                gattr = sft.geometry_attribute.name
+                if gtype == "POINT":
+                    props[gattr] = f"POINT ({coords[0]} {coords[1]})"
+                else:
+                    from geomesa_tpu.features.geometry import (NAME_TYPES,
+                                                               write_wkt)
+                    code = NAME_TYPES[geom.get("type")]
+                    props[gattr] = write_wkt(code, coords)
+                for a in sft.attributes:
+                    if a.type_name == "Date" and a.name in props:
+                        props[a.name] = np.datetime64(props[a.name], "ms") \
+                            .astype(np.int64)
+                w.write(fid=f.get("id"), **props)
+        return len(feats)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: GeoJsonApi = None  # set by serve()
+
+    def _respond(self, status: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        status, payload = self.api.handle("GET", u.path, parse_qs(u.query))
+        self._respond(status, payload)
+
+    def do_POST(self):
+        u = urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        status, payload = self.api.handle("POST", u.path, parse_qs(u.query),
+                                          body)
+        self._respond(status, payload)
+
+    def log_message(self, *a):  # quiet by default
+        pass
+
+
+def serve(store, host: str = "127.0.0.1", port: int = 8765,
+          background: bool = False):
+    """Start the REST server. ``background=True`` returns the server after
+    starting a daemon thread (tests / embedded use)."""
+    handler = type("BoundHandler", (_Handler,), {"api": GeoJsonApi(store)})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    if background:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+    httpd.serve_forever()

@@ -1,0 +1,132 @@
+"""Ingest format breadth: Parquet, XML, fixed-width, shapefile
+(≙ the geomesa-convert-* format modules, SURVEY.md §2.10)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.converter import SimpleFeatureConverter
+from geomesa_tpu.features.sft import SimpleFeatureType
+
+CFG = {
+    "fields": [
+        {"name": "name", "transform": "$name"},
+        {"name": "v", "transform": "toInt($v)"},
+        {"name": "geom", "transform": "point(toDouble($lon), toDouble($lat))"},
+    ],
+}
+SFT = SimpleFeatureType.from_spec("f", "name:String,v:Int,*geom:Point")
+
+
+def test_parquet_roundtrip(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "in.parquet")
+    pq.write_table(pa.table({
+        "name": ["a", "b", "c"],
+        "v": [1, 2, 3],
+        "lon": [10.0, 20.0, 30.0],
+        "lat": [1.0, 2.0, 3.0],
+    }), p)
+    conv = SimpleFeatureConverter(CFG, SFT)
+    t = conv.convert_parquet(p)
+    assert len(t) == 3
+    np.testing.assert_array_equal(np.asarray(t.columns["v"]), [1, 2, 3])
+    gx, gy = t.geometry().point_xy()
+    np.testing.assert_allclose(gx, [10.0, 20.0, 30.0])
+
+
+def test_xml_records(tmp_path):
+    xml = """<data>
+      <row id="7"><name>x</name><v>5</v><lon>1.5</lon><lat>2.5</lat></row>
+      <row id="8"><name>y</name><v>6</v><lon>3.5</lon><lat>4.5</lat></row>
+    </data>"""
+    conv = SimpleFeatureConverter(CFG, SFT)
+    t = conv.convert_xml(xml, "row")
+    assert len(t) == 2
+    assert t.columns["name"].decode([0, 1]) == ["x", "y"]
+    np.testing.assert_allclose(t.geometry().point_xy()[1], [2.5, 4.5])
+
+
+def test_xml_attributes_as_fields():
+    from geomesa_tpu.convert.formats import read_xml_records
+    cols = read_xml_records(
+        "<d><r k='9'><a>1</a></r><r k='10'><a>2</a></r></d>", "r")
+    assert list(cols["@k"]) == ["9", "10"]
+    assert list(cols["a"]) == ["1", "2"]
+
+
+def test_fixed_width():
+    text = "alpha 00112.5 21.5\nbeta  00245.0 42.0\n"
+    conv = SimpleFeatureConverter(CFG, SFT)
+    t = conv.convert_fixed_width(text, [
+        ("name", 0, 6), ("v", 6, 3), ("lon", 9, 5), ("lat", 14, 5)])
+    assert len(t) == 2
+    np.testing.assert_array_equal(np.asarray(t.columns["v"]), [1, 2])
+    np.testing.assert_allclose(t.geometry().point_xy()[0], [12.5, 45.0])
+
+
+def _write_point_shapefile(base, pts, names, vals):
+    """Minimal valid .shp + .dbf with point records (test fixture)."""
+    records = b""
+    for i, (x, y) in enumerate(pts):
+        content = struct.pack("<i", 1) + struct.pack("<dd", x, y)
+        records += struct.pack(">ii", i + 1, len(content) // 2) + content
+    total_words = (100 + len(records)) // 2
+    header = struct.pack(">i", 9994) + b"\x00" * 20 + struct.pack(">i", total_words)
+    header += struct.pack("<ii", 1000, 1)
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    header += struct.pack("<4d", min(xs), min(ys), max(xs), max(ys))
+    header += struct.pack("<4d", 0, 0, 0, 0)
+    with open(base + ".shp", "wb") as f:
+        f.write(header + records)
+    # dbf: fields name C(8), v N(6)
+    n = len(pts)
+    fdesc = (b"name" + b"\x00" * 7 + b"C" + b"\x00" * 4 + bytes([8]) + b"\x00" * 15
+             + b"v" + b"\x00" * 10 + b"N" + b"\x00" * 4 + bytes([6]) + b"\x00" * 15)
+    header_len = 32 + len(fdesc) + 1
+    record_len = 1 + 8 + 6
+    dh = struct.pack("<B3Bihh", 3, 24, 1, 1, n, header_len, record_len)
+    dh += b"\x00" * 20
+    body = b""
+    for nm, v in zip(names, vals):
+        body += b" " + nm.ljust(8)[:8].encode() + str(v).rjust(6).encode()
+    with open(base + ".dbf", "wb") as f:
+        f.write(dh + fdesc + b"\r" + body + b"\x1a")
+
+
+def test_shapefile_points(tmp_path):
+    from geomesa_tpu.convert.formats import read_shapefile
+    base = str(tmp_path / "pts")
+    pts = [(10.5, -3.25), (20.0, 40.0), (-179.5, 89.0)]
+    _write_point_shapefile(base, pts, ["aa", "bb", "cc"], [1, 22, 333])
+    garr, attrs = read_shapefile(base + ".shp")
+    assert len(garr) == 3
+    gx, gy = garr.point_xy()
+    np.testing.assert_allclose(gx, [p[0] for p in pts])
+    np.testing.assert_allclose(gy, [p[1] for p in pts])
+    assert list(attrs["name"]) == ["aa", "bb", "cc"]
+    assert list(attrs["v"]) == [1, 22, 333]
+
+
+def test_shapefile_polygon(tmp_path):
+    from geomesa_tpu.convert.formats import read_shapefile
+    base = str(tmp_path / "poly")
+    ring = [(0.0, 0.0), (4.0, 0.0), (4.0, 4.0), (0.0, 4.0), (0.0, 0.0)]
+    pts = np.asarray(ring)
+    content = struct.pack("<i", 5)
+    content += struct.pack("<4d", 0, 0, 4, 4)
+    content += struct.pack("<ii", 1, len(ring))
+    content += struct.pack("<i", 0)
+    content += pts.astype("<f8").tobytes()
+    rec = struct.pack(">ii", 1, len(content) // 2) + content
+    header = struct.pack(">i", 9994) + b"\x00" * 20 \
+        + struct.pack(">i", (100 + len(rec)) // 2) \
+        + struct.pack("<ii", 1000, 5) + struct.pack("<8d", 0, 0, 4, 4, 0, 0, 0, 0)
+    with open(base + ".shp", "wb") as f:
+        f.write(header + rec)
+    garr, attrs = read_shapefile(base + ".shp")
+    assert len(garr) == 1
+    np.testing.assert_allclose(garr.bboxes()[0], [0, 0, 4, 4])

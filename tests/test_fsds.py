@@ -1,0 +1,98 @@
+"""Partitioned file-system storage: scheme layouts, pruned reads,
+compaction (≙ geomesa-fs partition schemes + AbstractFileSystemStorage)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.io.fsds import (AttributeScheme, CompositeScheme,
+                                 DateTimeScheme, FileSystemStorage, Z2Scheme)
+
+SFT = SimpleFeatureType.from_spec(
+    "fs", "name:String,v:Int,dtg:Date,*geom:Point")
+
+
+def _table(n=4000, seed=1):
+    rng = np.random.default_rng(seed)
+    base = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    return FeatureTable.build(SFT, {
+        "name": rng.choice(["a", "b", "c"], n),
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 5 * 86400000, n),
+        "geom": (rng.uniform(-60, 60, n), rng.uniform(-60, 60, n)),
+    }), rng
+
+
+def test_z2_scheme_prunes_reads(tmp_path):
+    table, rng = _table()
+    fs = FileSystemStorage(str(tmp_path / "s"), SFT, Z2Scheme(bits=3))
+    fs.write(table)
+    assert len(fs.partitions()) > 4
+    q = "BBOX(geom, -10, -10, 10, 10)"
+    got = fs.read(q)
+    x, y = table.geometry().point_xy()
+    ref = int(np.sum((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)))
+    assert len(got) == ref
+    # pruning: the matching partitions are a strict subset
+    from geomesa_tpu.filter.parser import parse_ecql
+    matched = fs.scheme.matching(parse_ecql(q), SFT, fs.partitions())
+    assert 0 < len(matched) < len(fs.partitions())
+
+
+def test_datetime_scheme(tmp_path):
+    table, rng = _table()
+    fs = FileSystemStorage(str(tmp_path / "s"), SFT, DateTimeScheme("day"))
+    fs.write(table)
+    assert len(fs.partitions()) == 5
+    q = "dtg DURING 2024-01-02T00:00:00Z/2024-01-03T00:00:00Z"
+    got = fs.read(q)
+    dtg = np.asarray(table.columns["dtg"])
+    lo = np.datetime64("2024-01-02", "ms").astype(np.int64)
+    hi = np.datetime64("2024-01-03", "ms").astype(np.int64)
+    assert len(got) == int(np.sum((dtg > lo) & (dtg < hi)))
+
+
+def test_attribute_and_composite_scheme(tmp_path):
+    table, rng = _table()
+    scheme = CompositeScheme([AttributeScheme("name"), DateTimeScheme("day")])
+    fs = FileSystemStorage(str(tmp_path / "s"), SFT, scheme)
+    fs.write(table)
+    # nested dirs name_x/day_n
+    assert all("/" in p for p in fs.partitions())
+    got = fs.read("name = 'a'")
+    names = table.columns["name"].decode(np.arange(len(table)))
+    assert len(got) == names.count("a")
+    from geomesa_tpu.filter.parser import parse_ecql
+    matched = fs.scheme.matching(parse_ecql("name = 'a'"), SFT,
+                                 fs.partitions())
+    assert all(p.startswith("name_a/") for p in matched)
+
+
+def test_metadata_reload_and_append(tmp_path):
+    table, rng = _table(n=1000)
+    root = str(tmp_path / "s")
+    fs = FileSystemStorage(root, SFT, Z2Scheme(bits=2))
+    fs.write(table)
+    fs2 = FileSystemStorage(root)  # reload from _metadata.json
+    assert fs2.sft.name == "fs" and isinstance(fs2.scheme, Z2Scheme)
+    t2, _ = _table(n=500, seed=9)
+    fs2.write(t2)
+    assert len(fs2.read()) == 1500
+
+
+def test_compaction_merges_files(tmp_path):
+    root = str(tmp_path / "s")
+    fs = FileSystemStorage(root, SFT, Z2Scheme(bits=1))
+    for seed in range(4):
+        t, _ = _table(n=500, seed=seed)
+        fs.write(t)
+    before = sum(len(fs.files(p)) for p in fs.partitions())
+    assert before > len(fs.partitions())
+    n_before = len(fs.read())
+    fs.compact()
+    after = sum(len(fs.files(p)) for p in fs.partitions())
+    assert after == len(fs.partitions())
+    assert len(fs.read()) == n_before

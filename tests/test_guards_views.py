@@ -151,3 +151,36 @@ def test_routed_view():
     # mixed (not covered by any route) -> default store 0
     q3 = "v = 7 AND BBOX(geom, 0, 0, 20, 20)"
     assert view.count(q3) == recent.count("t", q3)
+
+
+def test_config_registry():
+    import os
+    from geomesa_tpu import config
+    d = config.describe()
+    assert "GEOMESA_TPU_PRUNE_BLOCK" in d
+    assert d["GEOMESA_TPU_PRUNE_BLOCK"]["value"] == 4096
+    os.environ["GEOMESA_TPU_PRUNE_BLOCK"] = "512"
+    try:
+        assert config.PRUNE_BLOCK.get() == 512  # env wins, late-bound
+    finally:
+        del os.environ["GEOMESA_TPU_PRUNE_BLOCK"]
+    config.PRUNE_BLOCK.set(128)
+    try:
+        assert config.PRUNE_BLOCK.get() == 128  # programmatic override
+    finally:
+        config.PRUNE_BLOCK.unset()
+    assert config.PRUNE_BLOCK.get() == 4096
+
+
+def test_metrics_registry():
+    from geomesa_tpu.metrics import MetricsRegistry
+    m = MetricsRegistry()
+    seen = []
+    m.add_reporter(lambda kind, name, v: seen.append((kind, name)))
+    m.inc("writes", 3)
+    with m.time("op"):
+        pass
+    snap = m.snapshot()
+    assert snap["counters"]["writes"] == 3
+    assert snap["timers"]["op"]["count"] == 1
+    assert ("counter", "writes") in seen and ("timer", "op") in seen

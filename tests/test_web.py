@@ -1,0 +1,100 @@
+"""REST / GeoJSON API (≙ geomesa-web servlets + geomesa-geojson JSON API)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.web import serve
+
+
+@pytest.fixture(scope="module")
+def server():
+    rng = np.random.default_rng(3)
+    n = 5000
+    x = rng.uniform(-20, 20, n)
+    y = rng.uniform(-20, 20, n)
+    base = np.datetime64("2024-05-01T00:00:00", "ms").astype(np.int64)
+    ds = TpuDataStore()
+    ds.create_schema("w", "name:String,v:Int,dtg:Date,*geom:Point")
+    ds.load("w", FeatureTable.build(ds.get_schema("w"), {
+        "name": rng.choice(["a", "b"], n), "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 86400000, n), "geom": (x, y)}))
+    httpd = serve(ds, port=0, background=True)
+    port = httpd.server_address[1]
+    yield f"http://127.0.0.1:{port}", ds, x, y
+    httpd.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_types_listing(server):
+    base, ds, x, y = server
+    status, body = _get(f"{base}/types")
+    assert status == 200 and body["types"] == ["w"]
+    status, body = _get(f"{base}/types/w")
+    assert body["count"] == 5000
+    assert any(a["name"] == "geom" for a in body["attributes"])
+
+
+def test_count_and_explain(server):
+    base, ds, x, y = server
+    q = "BBOX(geom, -5, -5, 5, 5)"
+    status, body = _get(f"{base}/types/w/count?cql={urllib.parse.quote(q)}")
+    ref = int(np.sum((x >= -5) & (x <= 5) & (y >= -5) & (y <= 5)))
+    assert body["count"] == ref
+    status, body = _get(f"{base}/types/w/explain?cql={urllib.parse.quote(q)}")
+    assert status == 200 and "index" in body
+
+
+def test_features_geojson(server):
+    base, ds, x, y = server
+    q = urllib.parse.quote("BBOX(geom, -5, -5, 5, 5)")
+    status, fc = _get(f"{base}/types/w/features?cql={q}&limit=10&sort=-v")
+    assert status == 200
+    assert fc["type"] == "FeatureCollection" and len(fc["features"]) == 10
+    vs = [f["properties"]["v"] for f in fc["features"]]
+    assert vs == sorted(vs, reverse=True)
+    g = fc["features"][0]["geometry"]
+    assert g["type"] == "Point" and -5 <= g["coordinates"][0] <= 5
+
+
+def test_post_ingest_roundtrip(server):
+    base, ds, x, y = server
+    fc = {"type": "FeatureCollection", "features": [
+        {"type": "Feature", "geometry": {"type": "Point",
+                                         "coordinates": [101.5, 3.25]},
+         "properties": {"name": "posted", "v": 7,
+                        "dtg": "2024-05-02T12:00:00"}},
+    ]}
+    req = urllib.request.Request(
+        f"{base}/types/w/features", data=json.dumps(fc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["ingested"] == 1
+    status, body = _get(f"{base}/types/w/count?cql=" +
+                        urllib.parse.quote("name = 'posted'"))
+    assert body["count"] == 1
+
+
+def test_metrics_and_config(server):
+    base, ds, x, y = server
+    status, m = _get(f"{base}/metrics")
+    assert status == 200 and "counters" in m
+    status, c = _get(f"{base}/config")
+    assert "GEOMESA_TPU_PRUNE" in c
+
+
+def test_bad_cql_is_400(server):
+    base, ds, x, y = server
+    try:
+        urllib.request.urlopen(f"{base}/types/w/count?cql=NONSENSE(((")
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
