@@ -296,6 +296,21 @@ def _regex_replace(col, pattern, repl, n=0):
     return np.asarray([rx.sub(rp, s) for s in _str(col)], dtype=object)
 
 
+@register("jsonPath")
+def _json_path(path, col, n=0):
+    """Extract a json-path value from JSON-document strings.
+
+    ≙ the reference's json-path property access into serialized JSON
+    attributes (KryoJsonSerialization.scala + JsonPathParser,
+    geomesa-features/feature-kryo/.../json/). Supported path subset:
+    ``$.a.b[0].c`` — dotted keys and integer array indexes. Missing paths
+    and invalid documents yield None."""
+    from geomesa_tpu.features.jsonpath import extract_path
+
+    p = str(path[0])
+    return np.asarray([extract_path(s, p) for s in _str(col)], dtype=object)
+
+
 @register("add")
 def _add(a, b, n=0):
     return _as_f64(a) + _as_f64(b)

@@ -40,7 +40,8 @@ def read_parquet_columns(path: str) -> Dict[str, np.ndarray]:
                 or pa.types.is_binary(col.type):
             out[name] = np.asarray(col.to_pylist(), dtype=object)
         elif pa.types.is_timestamp(col.type):
-            out[name] = np.asarray(col.cast("int64"))
+            # normalize to epoch MILLIS regardless of the file's unit
+            out[name] = np.asarray(col.cast(pa.timestamp("ms")).cast("int64"))
         else:
             out[name] = np.asarray(col)
     return out
@@ -119,7 +120,11 @@ def read_shapefile(path: str):
                 break
             (_num, length) = struct.unpack(">ii", rh)
             content = f.read(length * 2)
-            shape_type = struct.unpack("<i", content[:4])[0] % 10  # fold Z/M
+            raw_type = struct.unpack("<i", content[:4])[0]
+            # fold the documented Z/M variants onto the base types; anything
+            # else (MultiPatch=31, ...) is unsupported and skips the record
+            shape_type = raw_type % 10 if raw_type in (
+                1, 3, 5, 8, 11, 13, 15, 18, 21, 23, 25, 28) else -1
             if shape_type == _SHP_POINT:
                 x, y = struct.unpack("<dd", content[4:20])
                 shapes.append((geo.POINT, [x, y]))

@@ -313,9 +313,46 @@ class QueryPlanner:
             return plan.index.kernels.count(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
+        fast = self._band_intersects_count(plan)
+        if fast is not None:
+            return fast
         return len(self.select_indices(
             f if isinstance(f, ir.Filter) else parse_ecql(f),
             plan=plan, auths=auths))
+
+    def _band_intersects_count(self, plan) -> Optional[int]:
+        """Device certainty-band count for the common extent query shape —
+        a single polygon-INTERSECTS residual over a single-segment layer:
+        the kernel classifies candidates as certain-hit / certain-miss /
+        uncertain (f32 error bands), and only the uncertain sliver refines
+        on host in exact f64. None when the shape doesn't apply."""
+        res = plan.residual_host
+        if not (isinstance(res, ir.Intersects) and plan.index is not None
+                and plan.candidate_slices is None
+                and plan.primary_kind == "bbox_overlap"):
+            return None
+        from geomesa_tpu.features import geometry as geo
+        code = res.geometry[0]
+        if code != geo.POLYGON:
+            return None
+        if not getattr(plan.index, "ensure_segment_columns", lambda: False)():
+            return None
+        blocks = self._pruned_blocks(plan)
+        if blocks is None or len(blocks) == 0:
+            return 0 if blocks is not None else None
+        from geomesa_tpu.filter.geom_numpy import literal_segments
+        edges = literal_segments(res.geometry).astype(np.float32)
+        certain, unc = plan.index.kernels.intersects_band_blocks(
+            plan.primary_kind, plan.boxes_loose, plan.windows,
+            plan.residual_device, edges, blocks, _prune.BLOCK_SIZE)
+        if unc is None:
+            return None  # uncertainty overflow: full host refine instead
+        if len(unc) == 0:
+            return certain
+        from geomesa_tpu.filter.geom_batch import batch_intersects
+        rows = plan.index.perm[unc]
+        return certain + int(batch_intersects(
+            self.table.geometry(), rows, res.geometry).sum())
 
     def select_indices(self, f: Union[str, ir.Filter],
                        plan: Optional[IndexScanPlan] = None,

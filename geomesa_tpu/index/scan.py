@@ -92,6 +92,65 @@ PRIMARY_FNS: Dict[str, Callable] = {
 }
 
 
+# -- certified f32 geometry predicates ---------------------------------------
+#
+# The fp62 planes make BOX predicates exact on device; SEGMENT predicates
+# (exact intersects for extent features) use f32 with a computed CERTAINTY
+# BAND instead: every orientation sign carries an error bound covering both
+# the f32 arithmetic and the f64→f32 input rounding, so each feature
+# classifies as certain-hit / certain-miss / uncertain — and only the
+# uncertain sliver (rows within ~1e-5 deg of a boundary) goes to the host's
+# exact f64 refine. This is the strict/loose band discipline applied to
+# JTS-style predicates.
+
+_F32_EPS = np.float32(1.2e-7)     # 2^-23 with margin
+_IN_DELTA = np.float32(2.5e-5)    # |f64 coord - f32 coord| bound (lon/lat)
+_DY_BAND = np.float32(3e-5)       # vertex y-tie band for the crossing rule
+
+
+def _orient_band(px, py, qx, qy, rx, ry):
+    """Signed area orientation of (p,q,r) with a conservative error bound."""
+    d1x = qx - px
+    d1y = qy - py
+    d2x = rx - px
+    d2y = ry - py
+    t1 = d1x * d2y
+    t2 = d1y * d2x
+    det = t1 - t2
+    tol = (8 * _F32_EPS * (jnp.abs(t1) + jnp.abs(t2))
+           + 4 * _IN_DELTA * (jnp.abs(d1x) + jnp.abs(d1y)
+                              + jnp.abs(d2x) + jnp.abs(d2y)))
+    return det, tol
+
+
+def _pip_band(px, py, ex1, ey1, ex2, ey2):
+    """(certainly-inside, certainly-outside) of points vs polygon edges via
+    the half-open crossing rule; uncertain when any edge's crossing decision
+    sits inside its error band or a vertex y ties the ray."""
+    cond = (ey1 > py) != (ey2 > py)
+    o, t = _orient_band(ex1, ey1, ex2, ey2, px, py)
+    upward = ey2 > ey1
+    cross = cond & jnp.where(upward, o > t, o < -t)
+    unc = (cond & (jnp.abs(o) <= t)) \
+        | (jnp.abs(ey1 - py) <= _DY_BAND) | (jnp.abs(ey2 - py) <= _DY_BAND)
+    inside = (jnp.sum(cross, axis=-1) % 2) == 1
+    any_unc = jnp.any(unc, axis=-1)
+    return inside & ~any_unc, ~inside & ~any_unc
+
+
+def _segpair_band(ax, ay, bx, by, cx, cy, dx, dy):
+    """(certain-intersect, certain-miss) for segment (a,b) vs edge (c,d)."""
+    o1, t1 = _orient_band(ax, ay, bx, by, cx, cy)
+    o2, t2 = _orient_band(ax, ay, bx, by, dx, dy)
+    o3, t3 = _orient_band(cx, cy, dx, dy, ax, ay)
+    o4, t4 = _orient_band(cx, cy, dx, dy, bx, by)
+    opp12 = ((o1 > t1) & (o2 < -t2)) | ((o1 < -t1) & (o2 > t2))
+    opp34 = ((o3 > t3) & (o4 < -t4)) | ((o3 < -t3) & (o4 > t4))
+    same12 = ((o1 > t1) & (o2 > t2)) | ((o1 < -t1) & (o2 < -t2))
+    same34 = ((o3 > t3) & (o4 > t4)) | ((o3 < -t3) & (o4 < -t4))
+    return opp12 & opp34, same12 | same34
+
+
 _EARTH_R_M = 6371008.8
 
 
@@ -421,7 +480,8 @@ class ScanKernels:
                 out = _grid_scatter(xs, ys, ok, w, grid, width, height)
                 return out, jnp.sum(m)
         elif mode in ("count_blocks", "count_multi_blocks", "select_blocks",
-                      "density_blocks", "topk_blocks"):
+                      "density_blocks", "topk_blocks",
+                      "intersects_band_blocks"):
             # range-pruned gather scan: block ids (pad = -1) expand to row
             # indices with an iota, candidate rows gather from HBM, and the
             # FULL exact mask re-applies — so the host cover only needs to be
@@ -496,6 +556,40 @@ class ScanKernels:
                     vals, idxs = jax.lax.top_k(-d, m_cap)
                     sel = rowids[jnp.clip(idxs, 0, rowids.shape[0] - 1)]
                     return -vals, sel.astype(jnp.int32)
+            elif mode == "intersects_band_blocks":
+                # exact segment-vs-polygon intersects over candidate blocks,
+                # in f32 with certainty bands: returns [certain_hit_count,
+                # n_uncertain, uncertain_row_ids...]; the host refines only
+                # the uncertain sliver in exact f64 (geom_batch)
+                unc_cap = capacity[3]
+
+                def run(cols, boxes, windows, rparams, edges, block_ids):
+                    m, rowids, g = blocks_mask(cols, boxes, windows, rparams,
+                                               block_ids)
+                    ax, ay = g["sx1"], g["sy1"]
+                    bx, by = g["sx2"], g["sy2"]
+                    ex1 = edges[None, :, 0]
+                    ey1 = edges[None, :, 1]
+                    ex2 = edges[None, :, 2]
+                    ey2 = edges[None, :, 3]
+                    hit_p, miss_p = _segpair_band(
+                        ax[:, None], ay[:, None], bx[:, None], by[:, None],
+                        ex1, ey1, ex2, ey2)
+                    in_a, out_a = _pip_band(ax[:, None], ay[:, None],
+                                            ex1, ey1, ex2, ey2)
+                    in_b, out_b = _pip_band(bx[:, None], by[:, None],
+                                            ex1, ey1, ex2, ey2)
+                    hit = m & (in_a | in_b | jnp.any(hit_p, axis=1))
+                    miss = out_a & out_b & jnp.all(miss_p, axis=1)
+                    unc = m & ~hit & ~miss
+                    total = m.shape[0]
+                    sel = jnp.nonzero(unc, size=unc_cap, fill_value=total)[0]
+                    rows = jnp.where(sel < total,
+                                     rowids[jnp.clip(sel, 0, total - 1)], n)
+                    return jnp.concatenate([
+                        jnp.sum(hit)[None].astype(jnp.int32),
+                        jnp.sum(unc)[None].astype(jnp.int32),
+                        rows.astype(jnp.int32)])
             elif mode == "density_blocks":
                 # pruned heat-map: candidate blocks gather (contiguous HBM
                 # bursts) + masked scatter of only nb*block_size rows
@@ -761,6 +855,40 @@ class ScanKernels:
         g = jnp.asarray(np.asarray(grid_bbox, dtype=np.float32))
         db = jnp.asarray(b)
         return lambda: fn(cols, bx, w, rp, g, db)
+
+    # polygon-edge pad: far-away horizontal edges (ey1 == ey2 → no crossing;
+    # orientation signs large and same → certain-miss) so padded lanes never
+    # create hits or uncertainty
+    _EDGE_PAD = np.array([1e9, 1e9, 2e9, 1e9], dtype=np.float32)
+
+    def intersects_band_blocks(self, primary_kind, boxes, windows, residual,
+                               edges: np.ndarray, blocks: np.ndarray,
+                               block_size: int, unc_cap: int = 4096):
+        """(certain_hit_count, uncertain_row_positions) for exact
+        segment-feature × polygon intersects over candidate blocks. The
+        uncertain positions (rows within the f32 certainty band of a
+        boundary) need the host's exact f64 refine; returns None for the
+        positions when they overflowed ``unc_cap`` (caller falls back to the
+        full host refine)."""
+        b = self._pad_blocks(blocks)
+        ne = max(4, 1 << max(0, (len(edges) - 1)).bit_length())
+        ep = np.tile(self._EDGE_PAD, (ne, 1))
+        ep[: len(edges)] = edges
+        fn = self._get("intersects_band_blocks", primary_kind,
+                       windows is not None,
+                       residual[0] if residual else "none",
+                       residual[2] if residual else None,
+                       0 if boxes is None else boxes.shape[0],
+                       0 if windows is None else windows.shape[0],
+                       (b.shape[0], block_size, 0, unc_cap, ne))
+        rp = [jnp.asarray(p) for p in residual[1]] if residual else []
+        out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows), rp,
+                            jnp.asarray(ep), jnp.asarray(b)))
+        certain = int(out[0])
+        n_unc = int(out[1])
+        if n_unc > unc_cap:
+            return certain, None
+        return certain, out[2: 2 + n_unc].astype(np.int64)
 
     def topk_nearest_blocks(self, primary_kind, boxes, windows, residual,
                             qx: float, qy: float, m: int,

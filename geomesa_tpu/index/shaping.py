@@ -87,6 +87,27 @@ def _infer_type(arr) -> str:
         return "String"
     a = np.asarray(arr)
     if a.dtype == object:
+        # json-path / mixed expression outputs: ONE pass classifies the
+        # column — clean numeric promotes, anything mixed/None-bearing
+        # becomes dictionary strings
+        all_bool = all_int = all_num = bool(len(a))
+        for v in a:
+            if isinstance(v, bool):
+                all_int = all_num = False
+            elif isinstance(v, (int, np.integer)):
+                all_bool = False
+            elif isinstance(v, (float, np.floating)):
+                all_bool = all_int = False
+            else:
+                return "String"
+            if not (all_bool or all_num):
+                return "String"
+        if all_bool:
+            return "Boolean"
+        if all_int:
+            return "Long"
+        if all_num:
+            return "Double"
         return "String"
     return _DTYPE_TO_TYPE.get(a.dtype.str[1:], "Double")
 
@@ -119,8 +140,13 @@ def transform_table(table: FeatureTable, transforms: Sequence[str],
             val = expr.eval(fields, n)
             if np.ndim(val) == 0:
                 val = np.full(n, val)
+            t = _infer_type(val)
+            if t == "String" and getattr(val, "dtype", None) == object:
+                # stringify mixed/None-bearing outputs for the dictionary
+                val = np.asarray(["" if v is None else str(v) for v in val],
+                                 dtype=object)
             out_cols[out_name] = val
-            spec_parts.append(f"{out_name}:{_infer_type(val)}")
+            spec_parts.append(f"{out_name}:{t}")
         else:
             attr = table.sft.attribute(t)
             out_cols[t] = table.columns[t]

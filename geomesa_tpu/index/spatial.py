@@ -391,6 +391,32 @@ class BaseSpatialIndex:
             return 3.0
         return 10.0  # full scan
 
+    # certified segment predicates ------------------------------------------
+
+    def ensure_segment_columns(self) -> bool:
+        """Upload per-feature segment endpoints (sx1/sy1/sx2/sy2 f32) when
+        every feature is a single-segment LineString — enabling the device
+        certainty-band intersects refine (scan.intersects_band_blocks).
+        Lazy + cached; False when the layer shape doesn't qualify."""
+        cached = getattr(self, "_seg_cols_ok", None)
+        if cached is not None:
+            return cached
+        ok = False
+        garr = self.table.geometry()
+        if not garr.is_points and len(garr):
+            from geomesa_tpu.features import geometry as geo
+            counts = np.diff(garr.ring_offsets)
+            if (np.all(garr.type_codes == geo.LINESTRING)
+                    and len(counts) == len(garr) and np.all(counts == 2)):
+                import jax.numpy as jnp
+                segs = garr.coords.reshape(len(garr), 4)[self.perm]
+                for i, name in enumerate(("sx1", "sy1", "sx2", "sy2")):
+                    self.device.columns[name] = jnp.asarray(
+                        segs[:, i].astype(np.float32))
+                ok = True
+        self._seg_cols_ok = ok
+        return ok
+
     # range pruning ---------------------------------------------------------
 
     def candidate_blocks(self, plan: IndexScanPlan):

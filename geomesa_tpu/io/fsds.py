@@ -45,6 +45,9 @@ class PartitionScheme:
         semantics — refinement happens after the read)."""
         return list(present)
 
+    def validate(self, sft) -> None:
+        """Reject scheme/SFT combinations with unsound pruning."""
+
     def to_dict(self) -> dict:
         return {"scheme": self.name}
 
@@ -64,12 +67,21 @@ class PartitionScheme:
 
 
 class Z2Scheme(PartitionScheme):
-    """2^bits × 2^bits lon/lat grid cells (≙ fs Z2Scheme)."""
+    """2^bits × 2^bits lon/lat grid cells (≙ fs Z2Scheme).
+
+    POINT layers only: extents would partition by bbox center while pruning
+    follows the query bbox, silently missing wide features — the storage
+    constructor enforces the restriction."""
 
     name = "z2"
 
     def __init__(self, bits: int = 4):
         self.bits = int(bits)
+
+    def validate(self, sft) -> None:
+        g = sft.geometry_attribute
+        if g is None or g.type_name != "Point":
+            raise ValueError("Z2Scheme requires a Point geometry layer")
 
     def _cells(self, x, y):
         g = 1 << self.bits
@@ -132,23 +144,41 @@ class DateTimeScheme(PartitionScheme):
         if iv.unconstrained:
             return list(present)
         ms = self._MS[self.period]
-        keep = set()
-        for lo, hi in iv.intervals:
-            for b in range(int(lo) // ms, int(hi) // ms + 1):
-                keep.add(f"{self.period}_{b}")
-        return [p for p in present if p in keep]
+        # test each PRESENT bucket against the intervals (enumerating the
+        # interval hangs on open-ended predicates whose sentinel spans
+        # ~5e10 buckets)
+        prefix = f"{self.period}_"
+        out = []
+        for p in present:
+            if not p.startswith(prefix):
+                continue
+            try:
+                b = int(p[len(prefix):])
+            except ValueError:
+                continue
+            b0, b1 = b * ms, (b + 1) * ms
+            if any(int(lo) < b1 and int(hi) >= b0 for lo, hi in iv.intervals):
+                out.append(p)
+        return out
 
     def to_dict(self):
         return {"scheme": "datetime", "period": self.period}
 
 
 class AttributeScheme(PartitionScheme):
-    """One partition per attribute value (≙ fs AttributeScheme)."""
+    """One partition per attribute value (≙ fs AttributeScheme). Values
+    sanitize into a filesystem-safe alphabet (a raw '/..' in a value must
+    not escape the storage root or corrupt the directory layout)."""
 
     name = "attribute"
 
     def __init__(self, attribute: str):
         self.attribute = attribute
+
+    @staticmethod
+    def _safe(v: str) -> str:
+        import re as _re
+        return _re.sub(r"[^A-Za-z0-9_.:-]", "-", str(v))[:128]
 
     def partition_of(self, table):
         col = table.columns[self.attribute]
@@ -156,7 +186,8 @@ class AttributeScheme(PartitionScheme):
             vals = col.decode(np.arange(len(col)))
         else:
             vals = [str(v) for v in np.asarray(col)]
-        return np.asarray([f"{self.attribute}_{v}" for v in vals], dtype=object)
+        return np.asarray([f"{self.attribute}_{self._safe(v)}" for v in vals],
+                          dtype=object)
 
     def matching(self, f, sft, present):
         if f is None:
@@ -164,7 +195,7 @@ class AttributeScheme(PartitionScheme):
         vals = _equality_values(f, self.attribute)
         if vals is None:
             return list(present)
-        keep = {f"{self.attribute}_{v}" for v in vals}
+        keep = {f"{self.attribute}_{self._safe(v)}" for v in vals}
         return [p for p in present if p in keep]
 
     def to_dict(self):
@@ -178,6 +209,10 @@ class CompositeScheme(PartitionScheme):
 
     def __init__(self, parts: Sequence[PartitionScheme]):
         self.parts = list(parts)
+
+    def validate(self, sft) -> None:
+        for p in self.parts:
+            p.validate(sft)
 
     def partition_of(self, table):
         subs = [p.partition_of(table) for p in self.parts]
@@ -244,6 +279,7 @@ class FileSystemStorage:
         else:
             if sft is None or scheme is None:
                 raise ValueError("New storage needs sft= and scheme=")
+            scheme.validate(sft)
             self.sft = sft
             self.scheme = scheme
             with open(meta_path, "w") as fh:

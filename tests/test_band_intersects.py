@@ -1,0 +1,112 @@
+"""Device certainty-band intersects: exact counts with only an uncertain
+sliver refined on host (f32 orientation bands vs the exact f64 oracle)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import geometry as geo
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import geom_batch
+from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.index import prune
+from geomesa_tpu.index.planner import QueryPlanner
+from geomesa_tpu.index.spatial import XZ2Index
+
+POLY = "POLYGON ((-12 30, 10 28, 14 44, -2 50, -12 30))"
+Q = f"INTERSECTS(geom, {POLY})"
+
+
+@pytest.fixture(autouse=True)
+def small_blocks(monkeypatch):
+    monkeypatch.setattr(prune, "BLOCK_SIZE", 256)
+    monkeypatch.setattr(prune, "PRUNE_MAX_FRACTION", 1.0)
+
+
+def _setup(n=40_000, seed=2):
+    rng = np.random.default_rng(seed)
+    x0 = rng.uniform(-60, 60, n)
+    y0 = rng.uniform(0, 70, n)
+    coords = np.empty((2 * n, 2))
+    coords[0::2, 0], coords[0::2, 1] = x0, y0
+    coords[1::2, 0] = x0 + rng.uniform(-2, 2, n)
+    coords[1::2, 1] = y0 + rng.uniform(-2, 2, n)
+    garr = geo.GeometryArray.linestrings(coords)
+    sft = SimpleFeatureType.from_spec("l", "*geom:LineString")
+    table = FeatureTable.build(sft, {"geom": garr})
+    idx = XZ2Index(sft, table)
+    return QueryPlanner(sft, table, [idx]), idx, garr
+
+
+def _brute(garr):
+    fir = parse_ecql(Q)
+    return int(geom_batch.batch_intersects(
+        garr, np.arange(len(garr)), fir.geometry).sum())
+
+
+def test_band_count_matches_exact():
+    planner, idx, garr = _setup()
+    plan = planner.plan(Q)
+    fast = planner._band_intersects_count(plan)
+    assert fast is not None, "band path did not engage"
+    assert fast == _brute(garr)
+    # the public count() takes the same value
+    assert planner.count(Q) == fast
+
+
+def test_band_boundary_cases_route_to_host():
+    """Segments touching the polygon exactly (vertex-on-edge, endpoint-on-
+    vertex, collinear overlap) classify as uncertain and the host refine
+    keeps the count exact."""
+    # polygon edge from (-12,30) to (10,28): midpoint lies on the edge
+    mid = ((-12 + 10) / 2, (30 + 28) / 2)
+    crafted = [
+        # endpoint exactly ON an edge midpoint, rest outside
+        [[mid[0], mid[1]], [mid[0], mid[1] - 5.0]],
+        # endpoint exactly on a polygon vertex
+        [[-12.0, 30.0], [-20.0, 20.0]],
+        # collinear overlap with an edge segment
+        [[-12.0, 30.0], [10.0, 28.0]],
+        # fully inside
+        [[0.0, 40.0], [1.0, 41.0]],
+        # fully outside, near-ish
+        [[30.0, 30.0], [31.0, 31.0]],
+    ]
+    rng = np.random.default_rng(5)
+    # pad with random segments so the table crosses the pruning size gate
+    n = 10_000
+    x0 = rng.uniform(-60, 60, n)
+    y0 = rng.uniform(0, 70, n)
+    pads = [[[x0[i], y0[i]], [x0[i] + 0.5, y0[i] + 0.5]] for i in range(n)]
+    shapes = [(geo.LINESTRING, s) for s in crafted + pads]
+    garr = geo.GeometryArray.from_shapes(shapes)
+    sft = SimpleFeatureType.from_spec("l", "*geom:LineString")
+    table = FeatureTable.build(sft, {"geom": garr})
+    idx = XZ2Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    plan = planner.plan(Q)
+    fast = planner._band_intersects_count(plan)
+    assert fast is not None
+    assert fast == _brute(garr)
+    # the first four crafted segments all intersect; the fifth does not
+    fir = parse_ecql(Q)
+    m = geom_batch.batch_intersects(garr, np.arange(5), fir.geometry)
+    assert list(m) == [True, True, True, True, False]
+
+
+def test_band_declines_for_multi_vertex_layers():
+    rng = np.random.default_rng(7)
+    shapes = [(geo.LINESTRING, [[0, 0], [1, 1], [2, 0]])] * 100
+    shapes += [(geo.LINESTRING,
+                [[rng.uniform(-50, 50), rng.uniform(-50, 50)],
+                 [rng.uniform(-50, 50), rng.uniform(-50, 50)]])
+               for _ in range(5000)]
+    garr = geo.GeometryArray.from_shapes(shapes)
+    sft = SimpleFeatureType.from_spec("l", "*geom:LineString")
+    table = FeatureTable.build(sft, {"geom": garr})
+    idx = XZ2Index(sft, table)
+    planner = QueryPlanner(sft, table, [idx])
+    plan = planner.plan(Q)
+    assert planner._band_intersects_count(plan) is None  # mixed vertex counts
+    # and the general path still answers exactly
+    assert planner.count(Q) == _brute(garr)

@@ -237,3 +237,45 @@ def test_cli_missing_store(tmp_path):
     r = _cli(tmp_path, "count", "-s", str(tmp_path / "nope"), "-f", "x")
     assert r.returncode != 0
     assert "No store" in r.stderr
+
+
+def test_json_path_attribute_access():
+    """JSON-document attributes expose their interior via json-path
+    (≙ KryoJsonSerialization + JsonPathPropertyAccessor)."""
+    import numpy as np
+    from geomesa_tpu.features.jsonpath import extract_path, json_column
+    from geomesa_tpu.features.table import StringColumn
+    doc = '{"a": {"b": [10, {"c": "deep"}]}, "n": 4.5}'
+    assert extract_path(doc, "$.a.b[0]") == 10
+    assert extract_path(doc, "$.a.b[1].c") == "deep"
+    assert extract_path(doc, "$.n") == 4.5
+    assert extract_path(doc, "$.missing.x") is None
+    assert extract_path("not json", "$.a") is None
+    col = StringColumn.encode([doc, '{"n": 7}', doc, ""])
+    vals = json_column(col, "$.n")
+    assert list(vals) == [4.5, 7, 4.5, None]
+
+
+def test_json_path_in_converter_and_transform_hint():
+    import numpy as np
+    from geomesa_tpu.convert.converter import SimpleFeatureConverter
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    sft = SimpleFeatureType.from_spec("j", "tag:String,*geom:Point")
+    conv = SimpleFeatureConverter({"fields": [
+        {"name": "tag", "transform": "toString(jsonPath('$.meta.tag', $doc))"},
+        {"name": "geom", "transform": "point(toDouble($x), toDouble($y))"},
+    ]}, sft)
+    t = conv.convert_json(
+        '{"doc": "{\\"meta\\": {\\"tag\\": \\"red\\"}}", "x": 1, "y": 2}\n'
+        '{"doc": "{\\"meta\\": {\\"tag\\": \\"blue\\"}}", "x": 3, "y": 4}\n')
+    assert t.columns["tag"].decode([0, 1]) == ["red", "blue"]
+    # query-side access via the shaping transform hint
+    ds = TpuDataStore()
+    ds.create_schema("jq", "doc:String,*geom:Point")
+    from geomesa_tpu.features.table import FeatureTable
+    ds.load("jq", FeatureTable.build(ds.get_schema("jq"), {
+        "doc": ['{"k": 1}', '{"k": 2}'], "geom": ([0.0, 1.0], [0.0, 1.0])}))
+    r = ds.query("jq", "INCLUDE",
+                 hints={"transform": ["kk=jsonPath('$.k', $doc)"]})
+    assert sorted(np.asarray(r.table.columns["kk"]).tolist()) == [1, 2]

@@ -96,3 +96,37 @@ def test_compaction_merges_files(tmp_path):
     after = sum(len(fs.files(p)) for p in fs.partitions())
     assert after == len(fs.partitions())
     assert len(fs.read()) == n_before
+
+
+def test_open_ended_interval_does_not_enumerate(tmp_path):
+    """dtg > X (open-ended sentinel) must prune by testing present buckets,
+    not by enumerating ~5e10 interval buckets."""
+    table, rng = _table(n=1000)
+    fs = FileSystemStorage(str(tmp_path / "s"), SFT, DateTimeScheme("day"))
+    fs.write(table)
+    got = fs.read("dtg > 2024-01-03T00:00:00Z")
+    dtg = np.asarray(table.columns["dtg"])
+    lo = np.datetime64("2024-01-03", "ms").astype(np.int64)
+    assert len(got) == int(np.sum(dtg > lo))
+
+
+def test_attribute_values_sanitized(tmp_path):
+    sft = __import__("geomesa_tpu.features.sft", fromlist=["SimpleFeatureType"])\
+        .SimpleFeatureType.from_spec("t", "name:String,*geom:Point")
+    fs = FileSystemStorage(str(tmp_path / "s"), sft, AttributeScheme("name"))
+    evil = "a/../../../evil"
+    t = FeatureTable.build(sft, {"name": [evil, "ok"],
+                                 "geom": ([0.0, 1.0], [0.0, 1.0])})
+    fs.write(t)
+    # nothing escaped the root; the evil value still queries exactly
+    for dirpath, _d, files in __import__("os").walk(str(tmp_path)):
+        assert str(tmp_path) in dirpath
+    got = fs.read(f"name = '{evil}'")
+    assert len(got) == 1
+
+
+def test_z2_scheme_rejects_extent_layers(tmp_path):
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    lsft = SimpleFeatureType.from_spec("l", "*geom:LineString")
+    with pytest.raises(ValueError, match="Point"):
+        FileSystemStorage(str(tmp_path / "s"), lsft, Z2Scheme())
