@@ -1,0 +1,163 @@
+"""Avro Object Container File reader (ingest format).
+
+≙ the reference's Avro support (geomesa-feature-avro serializer + the
+geomesa-convert-avro ingest module). This is a self-contained reader for the
+public Avro 1.x container spec — no avro library ships in this image:
+
+  - header: magic 'Obj\\x01', metadata map (avro.schema JSON, avro.codec),
+    16-byte sync marker
+  - blocks: [record count, byte length, payload, sync]; null/deflate codecs
+  - binary encoding: zigzag varints (int/long), little-endian float/double,
+    length-prefixed bytes/string, index-prefixed unions, arrays/maps in
+    blocks
+
+Supported schema subset for columnar ingest: a top-level record of
+primitives (null/boolean/int/long/float/double/bytes/string), nullable
+unions of those, enums, and logicalType timestamp-millis — the shapes the
+reference's converter consumes. Output: field name → numpy object column,
+ready for the shared converter pipeline.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import BinaryIO, Dict, List, Tuple
+
+import numpy as np
+
+_MAGIC = b"Obj\x01"
+
+
+def _read_long(buf: BinaryIO) -> int:
+    """Zigzag varint."""
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        v = b[0]
+        acc |= (v & 0x7F) << shift
+        if not v & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def _read_bytes(buf: BinaryIO) -> bytes:
+    n = _read_long(buf)
+    return buf.read(n)
+
+
+def _read_value(buf: BinaryIO, schema):
+    if isinstance(schema, list):  # union: index-prefixed
+        idx = _read_long(buf)
+        return _read_value(buf, schema[idx])
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "enum":
+            return schema["symbols"][_read_long(buf)]
+        if t == "array":
+            out = []
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)  # block byte size (skippable form)
+                    n = -n
+                out.extend(_read_value(buf, schema["items"]) for _ in range(n))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    out[_read_bytes(buf).decode()] = _read_value(
+                        buf, schema["values"])
+            return out
+        if t == "fixed":
+            return buf.read(schema["size"])
+        return _read_value(buf, t)  # annotated primitive (logicalType rides)
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) == b"\x01"
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema == "bytes":
+        return _read_bytes(buf)
+    if schema == "string":
+        return _read_bytes(buf).decode("utf-8")
+    raise ValueError(f"Unsupported Avro schema {schema!r}")
+
+
+def read_avro_records(path_or_bytes) -> Tuple[List[dict], dict]:
+    """Container file → (records, schema dict)."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        f = io.BytesIO(path_or_bytes)
+    else:
+        f = open(path_or_bytes, "rb")
+    try:
+        if f.read(4) != _MAGIC:
+            raise ValueError("Not an Avro container file")
+        meta: Dict[str, bytes] = {}
+        while True:
+            n = _read_long(f)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(f)
+                n = -n
+            for _ in range(n):
+                key = _read_bytes(f).decode()
+                meta[key] = _read_bytes(f)
+        sync = f.read(16)
+        schema = json.loads(meta["avro.schema"].decode("utf-8"))
+        codec = meta.get("avro.codec", b"null").decode()
+        if schema.get("type") != "record":
+            raise ValueError("Top-level Avro schema must be a record")
+        fields = schema["fields"]
+        records: List[dict] = []
+        while True:
+            head = f.read(1)
+            if not head:
+                break
+            f.seek(-1, 1)
+            count = _read_long(f)
+            size = _read_long(f)
+            payload = f.read(size)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            elif codec != "null":
+                raise ValueError(f"Unsupported Avro codec {codec!r}")
+            if f.read(16) != sync:
+                raise ValueError("Avro sync marker mismatch")
+            b = io.BytesIO(payload)
+            for _ in range(count):
+                records.append({fd["name"]: _read_value(b, fd["type"])
+                                for fd in fields})
+        return records, schema
+    finally:
+        f.close()
+
+
+def read_avro_columns(path_or_bytes) -> Dict[str, np.ndarray]:
+    """Container file → field columns (object arrays; timestamp-millis
+    logical values stay as int64 epoch millis — the Date convention)."""
+    records, schema = read_avro_records(path_or_bytes)
+    names = [fd["name"] for fd in schema["fields"]]
+    return {name: np.asarray([r.get(name) for r in records], dtype=object)
+            for name in names}

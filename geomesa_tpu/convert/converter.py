@@ -147,6 +147,15 @@ class SimpleFeatureConverter:
             return self._empty()
         return self.convert_columns(cols)
 
+    def convert_avro(self, path_or_bytes) -> FeatureTable:
+        """Avro container-file ingest (≙ geomesa-convert-avro): record
+        fields become field refs by name."""
+        from geomesa_tpu.convert.avro import read_avro_columns
+        cols = read_avro_columns(path_or_bytes)
+        if not cols:
+            return self._empty()
+        return self._convert(cols, len(next(iter(cols.values()))))
+
     def convert_xml(self, text_or_path: str, record_tag: str) -> FeatureTable:
         """XML ingest (≙ geomesa-convert-xml): one feature per
         ``record_tag`` element; child elements and @attributes are fields."""

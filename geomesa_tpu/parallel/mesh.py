@@ -3,9 +3,13 @@
 Sharding model: one logical axis ``rows``. The index-sorted table (epoch-major
 for temporal indexes) is padded to a multiple of the device count and laid out
 with ``NamedSharding(P("rows"))``, so each device owns a contiguous key-range
-slice — exactly the reference's tablet/region split discipline
-(DefaultSplitter.scala:34), with even row counts standing in for the
-stats-driven split points until the stats subsystem feeds the splitter.
+slice — the reference's tablet/region split discipline
+(DefaultSplitter.scala:34). The reference derives split points from stat
+histograms because its splits are KEY-valued and the key distribution is
+unknown; here splits are ROW-COUNT-valued over an already-sorted layout, so
+equal row counts ARE the exact key-quantile splits the stats-driven splitter
+approximates — perfect balance by construction. ``split_points`` surfaces
+the resulting per-device key boundaries for ops parity.
 
 Pad rows carry ``__valid__ = False`` and out-of-domain key values so no
 predicate can match them; the mask kernels AND the valid plane when present.
@@ -60,6 +64,15 @@ class ShardedTable:
     def replicated(self, arr: np.ndarray) -> jnp.ndarray:
         """Place query constants replicated on every device."""
         return jax.device_put(np.asarray(arr), NamedSharding(self.mesh, P()))
+
+
+def split_points(sorted_keys: np.ndarray, n_devices: int) -> np.ndarray:
+    """Per-device key boundaries of the row-quantile sharding (≙ the split
+    points DefaultSplitter derives from stat histograms; here they are read
+    off the sorted keys directly)."""
+    n = len(sorted_keys)
+    cuts = (np.arange(1, n_devices) * n) // n_devices
+    return np.asarray(sorted_keys)[np.minimum(cuts, max(0, n - 1))]
 
 
 def _pad_value(name: str, dtype) -> object:

@@ -130,3 +130,77 @@ def test_shapefile_polygon(tmp_path):
     garr, attrs = read_shapefile(base + ".shp")
     assert len(garr) == 1
     np.testing.assert_allclose(garr.bboxes()[0], [0, 0, 4, 4])
+
+
+def _zz(v):
+    """Avro zigzag varint encoder (test fixture)."""
+    u = (v << 1) ^ (v >> 63)
+    out = b""
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _avro_str(s):
+    b = s.encode()
+    return _zz(len(b)) + b
+
+
+def _write_avro(schema_json, rows_bytes, codec=b"null"):
+    import json as _json
+    sync = b"S" * 16
+    meta = (_zz(2)
+            + _avro_str("avro.schema") + _zz(len(schema_json)) + schema_json
+            + _avro_str("avro.codec") + _zz(len(codec)) + codec
+            + _zz(0))
+    payload = b"".join(rows_bytes)
+    if codec == b"deflate":
+        import zlib
+        c = zlib.compressobj(wbits=-15)
+        payload = c.compress(payload) + c.flush()
+    block = _zz(len(rows_bytes)) + _zz(len(payload)) + payload + sync
+    return b"Obj\x01" + meta + sync + block
+
+
+AVRO_SCHEMA = (b'{"type":"record","name":"r","fields":['
+               b'{"name":"name","type":"string"},'
+               b'{"name":"v","type":["null","long"]},'
+               b'{"name":"lon","type":"double"},'
+               b'{"name":"lat","type":"double"}]}')
+
+
+def _avro_row(name, v, lon, lat):
+    out = _avro_str(name)
+    out += _zz(0) if v is None else (_zz(1) + _zz(v))
+    out += struct.pack("<d", lon) + struct.pack("<d", lat)
+    return out
+
+
+@pytest.mark.parametrize("codec", [b"null", b"deflate"])
+def test_avro_container_roundtrip(tmp_path, codec):
+    from geomesa_tpu.convert.avro import read_avro_columns
+    rows = [_avro_row("a", 5, 10.0, 1.0), _avro_row("b", None, 20.0, 2.0),
+            _avro_row("c", -7, 30.0, 3.0)]
+    p = str(tmp_path / "in.avro")
+    with open(p, "wb") as f:
+        f.write(_write_avro(AVRO_SCHEMA, rows, codec))
+    cols = read_avro_columns(p)
+    assert list(cols["name"]) == ["a", "b", "c"]
+    assert list(cols["v"]) == [5, None, -7]
+    assert list(cols["lon"]) == [10.0, 20.0, 30.0]
+
+
+def test_avro_through_converter(tmp_path):
+    rows = [_avro_row("a", 1, 10.0, 1.0), _avro_row("b", 2, 20.0, 2.0)]
+    p = str(tmp_path / "in.avro")
+    with open(p, "wb") as f:
+        f.write(_write_avro(AVRO_SCHEMA, rows))
+    conv = SimpleFeatureConverter(CFG, SFT)
+    t = conv.convert_avro(p)
+    assert len(t) == 2
+    np.testing.assert_allclose(t.geometry().point_xy()[0], [10.0, 20.0])

@@ -126,3 +126,14 @@ class TestSpatialJoin:
         y = np.asarray(sharded.columns["yf"])[: sharded.n]
         exact = gn.points_in_polygon(x.astype(np.float64), y.astype(np.float64), polys[0])
         assert abs(int(counts[0]) - int(exact.sum())) <= 2
+
+
+def test_split_points_are_key_quantiles():
+    import numpy as np
+    from geomesa_tpu.parallel.mesh import split_points
+    keys = np.sort(np.random.default_rng(1).integers(0, 1 << 40, 1000))
+    sp = split_points(keys, 8)
+    assert len(sp) == 7
+    assert np.all(np.diff(sp) >= 0)
+    # each device's slice holds exactly its row quantile
+    assert sp[0] == keys[125] and sp[-1] == keys[875]
