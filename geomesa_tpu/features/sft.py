@@ -166,3 +166,23 @@ class SimpleFeatureType:
         if not raw:
             return None
         return [part.split(":")[0] for part in raw.split(",") if part]
+
+    @property
+    def device_column_group(self) -> Optional[List[str]]:
+        """Attribute names projected onto the device (``geomesa.column.groups``
+        user data, ':'-separated). ≙ the reference's ColumnGroups narrow
+        scans (conf/ColumnGroups.scala): the TPU redesign is ONE group — the
+        HBM-resident projection; attributes outside it stay host-only and
+        their predicates evaluate as host residuals. None = all attributes.
+        Geometry and the primary dtg always project (the scan primaries)."""
+        raw = self.user_data.get("geomesa.column.groups")
+        if not raw:
+            return None
+        names = [p for p in raw.split(":") if p]
+        known = {a.name for a in self.attributes}
+        unknown = [n for n in names if n not in known]
+        if unknown:
+            raise ValueError(
+                f"geomesa.column.groups names unknown attributes {unknown} "
+                f"(have {sorted(known)}; ':'-separated)")
+        return names

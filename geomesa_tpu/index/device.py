@@ -168,9 +168,13 @@ def host_planes(table: FeatureTable,
         # dictionary codes; query-time auths shrink to an allowed-code set
         cols["__vis__"] = np.asarray(table.visibility.codes, dtype=np.int32)
 
+    group = table.sft.device_column_group
     for attr in table.sft.attributes:
         if attr.is_geometry:
             continue
+        if group is not None and attr.name not in group \
+                and not (dtg_attr is not None and attr.name == dtg_attr.name):
+            continue  # outside the device column group: host-only attribute
         raw = table.columns[attr.name]
         if isinstance(raw, StringColumn):
             cols[attr.name] = np.asarray(raw.codes, dtype=np.int32)

@@ -177,15 +177,22 @@ class Unsupported(Exception):
 _EXACT_DEVICE_TYPES = {"Int", "Integer", "Boolean", "String", "Float"}
 
 
-def compile_residual(f: Optional[ir.Filter], sft, string_vocabs: Dict[str, list]):
+def compile_residual(f: Optional[ir.Filter], sft, string_vocabs: Dict[str, list],
+                     available: Optional[set] = None):
     """IR → (structure_key, params ndarray list, fn(cols, params) -> mask).
 
-    Raises Unsupported for subtrees that must stay host-side. Constants are
-    hoisted into the params list so differing query values share one compiled
-    kernel (structure_key captures only the shape of the tree).
+    Raises Unsupported for subtrees that must stay host-side — including
+    predicates on attributes OUTSIDE the device column projection
+    (``available``, when given: the column-group narrow-scan contract).
+    Constants are hoisted into the params list so differing query values
+    share one compiled kernel (structure_key captures only the tree shape).
     """
     if f is None:
         return "none", [], None
+
+    def check_available(attr: str) -> None:
+        if available is not None and attr not in available:
+            raise Unsupported(f"{attr} not in the device column group")
 
     params: list = []
 
@@ -214,6 +221,7 @@ def compile_residual(f: Optional[ir.Filter], sft, string_vocabs: Dict[str, list]
             k, g = walk(node.child)
             return f"not({k})", lambda cols, p, g=g: ~g(cols, p)
         if isinstance(node, ir.Cmp):
+            check_available(node.attr)
             attr = sft.attribute(node.attr)
             if attr.type_name == "String":
                 if node.op not in ("=", "<>"):
@@ -243,6 +251,7 @@ def compile_residual(f: Optional[ir.Filter], sft, string_vocabs: Dict[str, list]
                         "<=": c <= v, ">": c > v, ">=": c >= v}[op]
             return key, g
         if isinstance(node, ir.In):
+            check_available(node.attr)
             attr = sft.attribute(node.attr)
             if attr.type_name == "String":
                 vocab = string_vocabs.get(node.attr)
@@ -273,12 +282,14 @@ def compile_residual(f: Optional[ir.Filter], sft, string_vocabs: Dict[str, list]
     return key, params, fn
 
 
-def split_residual(f: Optional[ir.Filter], sft, string_vocabs):
+def split_residual(f: Optional[ir.Filter], sft, string_vocabs,
+                   available: Optional[set] = None):
     """Split a residual filter into (device_part, host_part).
 
     AND trees split per-child; any child the device compiler rejects stays on
     the host (≙ reference splitting between pushed-down filter and client
-    post-filter). Returns (device_ir_or_None, host_ir_or_None).
+    post-filter) — including predicates on attributes outside the device
+    column group. Returns (device_ir_or_None, host_ir_or_None).
     """
     if f is None or isinstance(f, ir.Include):
         return None, None
@@ -286,7 +297,7 @@ def split_residual(f: Optional[ir.Filter], sft, string_vocabs):
     dev, host = [], []
     for c in children:
         try:
-            compile_residual(c, sft, string_vocabs)
+            compile_residual(c, sft, string_vocabs, available)
             dev.append(c)
         except Unsupported:
             host.append(c)

@@ -360,8 +360,11 @@ class BaseSpatialIndex:
                         min(i32, int(bhi)), int(ohi))
             windows = pad_windows(w)
 
-        dev_res, host_res = split_residual(residual, self.sft, self.vocabs)
-        compiled = compile_residual(dev_res, self.sft, self.vocabs) if dev_res else None
+        avail = set(self.device.columns)
+        dev_res, host_res = split_residual(residual, self.sft, self.vocabs,
+                                           avail)
+        compiled = compile_residual(dev_res, self.sft, self.vocabs, avail) \
+            if dev_res else None
 
         cost = self._cost(ext, iv)
         return IndexScanPlan(
@@ -806,9 +809,12 @@ class FullScanIndex(BaseSpatialIndex):
         return None  # natural table order
 
     def plan(self, f: ir.Filter) -> Optional[IndexScanPlan]:
+        avail = set(self.device.columns)
         dev_res, host_res = split_residual(
-            f if not isinstance(f, (ir.Include,)) else None, self.sft, self.vocabs)
-        compiled = compile_residual(dev_res, self.sft, self.vocabs) if dev_res else None
+            f if not isinstance(f, (ir.Include,)) else None, self.sft,
+            self.vocabs, avail)
+        compiled = compile_residual(dev_res, self.sft, self.vocabs, avail) \
+            if dev_res else None
         return IndexScanPlan(
             index=self, primary_kind="none",
             residual_device=compiled, residual_host=host_res, full_filter=f,

@@ -10,6 +10,9 @@ client RPC at all:
   density  — sharded scatter-add partial grids → replicated (H, W) (psum)
   select   — per-device compaction; survivors gather to host (the only
              ragged/host-merged step, as in the reference's client merge)
+  knn      — sharded distance + per-shard top-k; XLA's sharded top_k merges
+             the per-device candidate sets into the global k over ICI (the
+             distributed form of the device KNN kernel)
 
 All entry points are jit-compiled once per (structure, shape) and reused.
 """
@@ -57,10 +60,22 @@ class DistributedScan:
             self._jitted[key] = builder()
         return self._jitted[key]
 
-    def count(self, plan) -> int:
+    def _stage(self, plan):
+        """(rkey, rfn, boxes, windows, rparams) — shared plan staging:
+        residual unpack + replicated query constants (one home for the four
+        scan entry points)."""
         res = plan.residual_device
         rkey = res[0] if res else "none"
         rfn = res[2] if res else None
+        boxes = None if plan.boxes_loose is None \
+            else self.sharded.replicated(plan.boxes_loose)
+        windows = None if plan.windows is None \
+            else self.sharded.replicated(plan.windows)
+        rparams = [self.sharded.replicated(p) for p in res[1]] if res else []
+        return rkey, rfn, boxes, windows, rparams
+
+    def count(self, plan) -> int:
+        rkey, rfn, boxes, windows, rparams = self._stage(plan)
         key = ("count", plan.primary_kind, plan.windows is not None, rkey)
 
         def build():
@@ -70,16 +85,11 @@ class DistributedScan:
             return jax.jit(step)
 
         fn = self._fn(key, build)
-        boxes = None if plan.boxes_loose is None else self.sharded.replicated(plan.boxes_loose)
-        windows = None if plan.windows is None else self.sharded.replicated(plan.windows)
-        rparams = [self.sharded.replicated(p) for p in res[1]] if res else []
         return int(fn(self.sharded.columns, boxes, windows, rparams))
 
     def density(self, plan, bbox, width: int, height: int,
                 weight_attr: Optional[str] = None) -> np.ndarray:
-        res = plan.residual_device
-        rkey = res[0] if res else "none"
-        rfn = res[2] if res else None
+        rkey, rfn, boxes, windows, rparams = self._stage(plan)
         key = ("density", plan.primary_kind, plan.windows is not None, rkey,
                width, height, weight_attr)
 
@@ -91,17 +101,51 @@ class DistributedScan:
             return jax.jit(step)
 
         fn = self._fn(key, build)
-        boxes = None if plan.boxes_loose is None else self.sharded.replicated(plan.boxes_loose)
-        windows = None if plan.windows is None else self.sharded.replicated(plan.windows)
-        rparams = [self.sharded.replicated(p) for p in res[1]] if res else []
         grid = self.sharded.replicated(np.asarray(bbox, dtype=np.float32))
         return np.asarray(fn(self.sharded.columns, boxes, windows, rparams, grid))
 
+    def knn(self, plan, x: float, y: float, k: int):
+        """(global row ids, distances_m f32) of the k nearest masked rows
+        across every shard: one jitted program computes sharded haversine
+        distances and a top-k whose merge XLA lowers to per-shard top-k +
+        an ICI combine (the FeatureReducer step as a collective).
+
+        Requires a fully device-servable plan — a host residual cannot be
+        applied after a k-limited reduction (unlike select, there is nothing
+        left to refine), so such plans are rejected rather than silently
+        answering the wrong question."""
+        from geomesa_tpu.index.scan import _haversine_f32
+
+        if plan.residual_host is not None or plan.candidate_slices is not None:
+            raise ValueError(
+                "distributed knn needs a device-exact plan (host residuals "
+                "cannot refine a k-limited result)")
+        rkey, rfn, boxes, windows, rparams = self._stage(plan)
+        m_cap = min(max(16, 1 << (max(0, k - 1)).bit_length()),
+                    self.sharded.n_padded)
+        key = ("knn", plan.primary_kind, plan.windows is not None, rkey, m_cap)
+
+        def build():
+            def step(cols, boxes, windows, rparams, q):
+                m = _build_mask(cols, plan.primary_kind, boxes, windows,
+                                rfn, rparams)
+                d = _haversine_f32(cols["xf"], cols["yf"], q[0], q[1])
+                d = jnp.where(m, d, jnp.inf)
+                vals, idxs = jax.lax.top_k(-d, m_cap)
+                return -vals, idxs
+            return jax.jit(step)
+
+        fn = self._fn(key, build)
+        q = self.sharded.replicated(np.array([x, y], dtype=np.float32))
+        dists, idxs = fn(self.sharded.columns, boxes, windows, rparams, q)
+        dists = np.asarray(dists)[:k]
+        idxs = np.asarray(idxs)[:k]
+        valid = np.isfinite(dists)
+        return idxs[valid], dists[valid]
+
     def mask(self, plan) -> np.ndarray:
         """Full boolean mask gathered to host (hydration path)."""
-        res = plan.residual_device
-        rkey = res[0] if res else "none"
-        rfn = res[2] if res else None
+        rkey, rfn, boxes, windows, rparams = self._stage(plan)
         key = ("mask", plan.primary_kind, plan.windows is not None, rkey)
 
         def build():
@@ -110,7 +154,4 @@ class DistributedScan:
             return jax.jit(step)
 
         fn = self._fn(key, build)
-        boxes = None if plan.boxes_loose is None else self.sharded.replicated(plan.boxes_loose)
-        windows = None if plan.windows is None else self.sharded.replicated(plan.windows)
-        rparams = [self.sharded.replicated(p) for p in res[1]] if res else []
         return np.asarray(fn(self.sharded.columns, boxes, windows, rparams))[: self.sharded.n]

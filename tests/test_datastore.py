@@ -151,3 +151,31 @@ class TestExtentStore:
             "roads",
             "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2020-01-02T00:00:00Z/2020-01-20T00:00:00Z")
         assert exp["index"] == "xz3"
+
+
+def test_device_column_group_narrow_scan():
+    """geomesa.column.groups restricts the device projection (≙ ColumnGroups
+    narrow scans); predicates on host-only attributes evaluate exactly as
+    host residuals."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.features.table import FeatureTable
+    rng = np.random.default_rng(8)
+    n = 30_000
+    x = rng.uniform(-30, 30, n)
+    y = rng.uniform(-30, 30, n)
+    a = rng.integers(0, 100, n).astype(np.int32)
+    b = rng.integers(0, 100, n).astype(np.int32)
+    ds = TpuDataStore()
+    ds.create_schema("cg", "a:Int,b:Int,*geom:Point;geomesa.column.groups=a")
+    ds.load("cg", FeatureTable.build(ds.get_schema("cg"),
+                                     {"a": a, "b": b, "geom": (x, y)}))
+    planner = ds.planner("cg")
+    idx = planner.indexes[0]
+    assert "a" in idx.device.columns and "b" not in idx.device.columns
+    q = "BBOX(geom, -10, -10, 10, 10) AND a < 50 AND b < 50"
+    plan = planner.plan(q)
+    assert plan.residual_host is not None  # b predicate stays host-side
+    ref = int(np.sum((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)
+                     & (a < 50) & (b < 50)))
+    assert ds.count("cg", q) == ref

@@ -137,3 +137,28 @@ def test_split_points_are_key_quantiles():
     assert np.all(np.diff(sp) >= 0)
     # each device's slice holds exactly its row quantile
     assert sp[0] == keys[125] and sp[-1] == keys[875]
+
+
+def test_sharded_knn_matches_bruteforce(point_store, sharded_scan):
+    ds, table = point_store
+    planner, idx, dscan = sharded_scan
+    plan = planner.plan("INCLUDE")
+    idxs, dists = dscan.knn(plan, 5.0, 5.0, 10)
+    assert len(idxs) == 10
+    from geomesa_tpu.process.geo import haversine_m
+    # the sharded table rows are in the INDEX's sorted order
+    gx = np.asarray(idx.device.columns["xf"])
+    gy = np.asarray(idx.device.columns["yf"])
+    ref_d = haversine_m(gx.astype(np.float64), gy.astype(np.float64), 5.0, 5.0)
+    ref = np.sort(np.argsort(ref_d)[:10])
+    np.testing.assert_array_equal(np.sort(idxs), ref)
+    assert np.all(np.diff(dists) >= 0)
+
+
+def test_sharded_knn_with_filter(point_store, sharded_scan):
+    ds, table = point_store
+    planner, idx, dscan = sharded_scan
+    plan = planner.plan("val > 50")
+    idxs, dists = dscan.knn(plan, 0.0, 0.0, 5)
+    vals = np.asarray(idx.device.columns["val"])
+    assert np.all(vals[idxs] > 50)
