@@ -33,6 +33,8 @@ def save_store(store, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     catalog: Dict[str, dict] = {"version": _VERSION, "types": {}}
     for name, sft in store.schemas.items():
+        if getattr(store, "flush", None) is not None:
+            store.flush(name)  # pending LSM delta runs must persist too
         table = store.tables.get(name)
         entry = {
             "spec": sft.to_spec(),

@@ -141,3 +141,17 @@ def test_shaping_merges_delta_inline():
     allv = np.concatenate([main[3], vb])
     np.testing.assert_array_equal(np.sort(vals)[::-1],
                                   np.sort(allv)[::-1][:30])
+
+
+def test_checkpoint_persists_pending_delta(tmp_path):
+    from geomesa_tpu.io.checkpoint import load_store, save_store
+    ds, main = _store(n=60_000)
+    xb, yb, db, vb = _mk(500, 71)
+    ds.load("t", FeatureTable.build(
+        ds.get_schema("t"), {"v": vb, "dtg": db, "geom": (xb, yb)}))
+    assert ds.deltas["t"] is not None
+    expected = ds.count("t", Q)
+    save_store(ds, str(tmp_path / "ckpt"))
+    ds2 = load_store(str(tmp_path / "ckpt"))
+    assert len(ds2.tables["t"]) == 60_500
+    assert ds2.count("t", Q) == expected
