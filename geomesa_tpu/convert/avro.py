@@ -154,6 +154,121 @@ def read_avro_records(path_or_bytes) -> Tuple[List[dict], dict]:
         f.close()
 
 
+def _write_long(out: bytearray, v: int) -> None:
+    u = (v << 1) ^ (v >> 63)
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _write_str(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    _write_long(out, len(b))
+    out += b
+
+
+_AVRO_TYPES = {
+    "String": "string", "Int": "int", "Integer": "int", "Long": "long",
+    "Float": "float", "Double": "double", "Boolean": "boolean",
+}
+
+
+def write_avro(table, path: str, codec: str = "deflate") -> None:
+    """FeatureTable → Avro container file (the export side, ≙ the
+    geomesa-feature-avro serializer + the CLI avro export format).
+
+    Schema: record of the SFT's attributes — primitives map directly, Date
+    becomes long timestamp-millis, geometries become WKB ``bytes``; the fid
+    rides as a ``__fid__`` string field (round-trips through
+    read_avro_columns)."""
+    from geomesa_tpu.features.table import StringColumn
+    from geomesa_tpu.features.twkb import encode_wkb
+
+    sft = table.sft
+    fields = [{"name": "__fid__", "type": "string"}]
+    writers = []  # (write_fn, per-row values)
+    n = len(table)
+    fids = [str(f) for f in table.fids]
+    for attr in sft.attributes:
+        col = table.columns[attr.name]
+        if attr.is_geometry:
+            fields.append({"name": attr.name, "type": "bytes"})
+            vals = encode_wkb(col)
+            writers.append(("bytes", vals))
+        elif attr.type_name == "Date":
+            fields.append({"name": attr.name,
+                           "type": {"type": "long",
+                                    "logicalType": "timestamp-millis"}})
+            writers.append(("long", np.asarray(col, dtype=np.int64)))
+        elif attr.type_name in _AVRO_TYPES:
+            t = _AVRO_TYPES[attr.type_name]
+            fields.append({"name": attr.name, "type": t})
+            if isinstance(col, StringColumn):
+                writers.append(("string", col.decode(np.arange(n))))
+            else:
+                writers.append((t, np.asarray(col)))
+        else:
+            raise ValueError(f"Cannot export {attr.type_name} to Avro")
+    import re as _re
+    # Avro name grammar: [A-Za-z_][A-Za-z0-9_]* — sanitize SFT/attr names so
+    # spec-compliant readers (Java Avro, fastavro) accept the file
+    def _avro_name(s: str) -> str:
+        s = _re.sub(r"[^A-Za-z0-9_]", "_", str(s) or "feature")
+        return s if _re.match(r"[A-Za-z_]", s) else "_" + s
+    for fd in fields:
+        fd["name"] = _avro_name(fd["name"]) if fd["name"] != "__fid__" else "__fid__"
+    schema = {"type": "record", "name": _avro_name(sft.name),
+              "fields": fields}
+
+    body = bytearray()
+    for i in range(n):
+        _write_str(body, fids[i])
+        for t, vals in writers:
+            v = vals[i]
+            if t == "string":
+                _write_str(body, str(v))
+            elif t == "bytes":
+                b = bytes(v)
+                _write_long(body, len(b))
+                body += b
+            elif t in ("int", "long"):
+                _write_long(body, int(v))
+            elif t == "float":
+                body += struct.pack("<f", float(v))
+            elif t == "double":
+                body += struct.pack("<d", float(v))
+            elif t == "boolean":
+                body.append(1 if v else 0)
+    payload = bytes(body)
+    if codec == "deflate":
+        c = zlib.compressobj(wbits=-15)
+        payload = c.compress(payload) + c.flush()
+    elif codec != "null":
+        raise ValueError(f"Unsupported Avro codec {codec!r}")
+
+    out = bytearray(_MAGIC)
+    _write_long(out, 2)
+    _write_str(out, "avro.schema")
+    sb = json.dumps(schema).encode()
+    _write_long(out, len(sb))
+    out += sb
+    _write_str(out, "avro.codec")
+    _write_str(out, codec)
+    _write_long(out, 0)
+    sync = b"geomesa-tpu-sync"  # any 16 bytes
+    out += sync
+    _write_long(out, n)
+    _write_long(out, len(payload))
+    out += payload + sync
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
 def read_avro_columns(path_or_bytes) -> Dict[str, np.ndarray]:
     """Container file → field columns (object arrays; timestamp-millis
     logical values stay as int64 epoch millis — the Date convention)."""

@@ -17,7 +17,7 @@ import numpy as np
 from geomesa_tpu.features.geometry import GeometryArray
 from geomesa_tpu.features.table import FeatureTable, StringColumn
 
-FORMATS = ("csv", "tsv", "geojson", "json", "wkt", "arrow", "parquet")
+FORMATS = ("csv", "tsv", "geojson", "json", "wkt", "arrow", "parquet", "avro")
 
 
 def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
@@ -37,6 +37,12 @@ def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
         if path is None:
             raise ValueError("arrow export requires a path")
         write_ipc(table, path)
+        return path
+    if fmt == "avro":
+        from geomesa_tpu.convert.avro import write_avro
+        if path is None:
+            raise ValueError("avro export requires a path")
+        write_avro(table, path)
         return path
     if fmt == "parquet":
         import pyarrow.parquet as pq

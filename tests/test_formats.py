@@ -204,3 +204,38 @@ def test_avro_through_converter(tmp_path):
     t = conv.convert_avro(p)
     assert len(t) == 2
     np.testing.assert_allclose(t.geometry().point_xy()[0], [10.0, 20.0])
+
+
+def test_avro_writer_roundtrip(tmp_path):
+    """write_avro → read_avro_columns round-trips attributes, dates, fids,
+    and WKB geometries (the export side of the Avro slot)."""
+    import numpy as np
+    from geomesa_tpu.convert.avro import read_avro_columns, write_avro
+    from geomesa_tpu.features.table import FeatureTable
+    from geomesa_tpu.features.twkb import decode_wkb
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    sft = SimpleFeatureType.from_spec(
+        "av", "name:String,v:Int,d:Double,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(4)
+    n = 500
+    base = np.datetime64("2024-02-01T00:00:00", "ms").astype(np.int64)
+    t = FeatureTable.build(sft, {
+        "name": rng.choice(["aa", "bb"], n),
+        "v": rng.integers(-100, 100, n).astype(np.int32),
+        "d": rng.uniform(-1, 1, n),
+        "dtg": base + rng.integers(0, 86400000, n),
+        "geom": (rng.uniform(-50, 50, n), rng.uniform(-50, 50, n)),
+    })
+    p = str(tmp_path / "out.avro")
+    from geomesa_tpu.io.export import export
+    export(t, "avro", p)
+    cols = read_avro_columns(p)
+    assert list(cols["v"]) == list(np.asarray(t.columns["v"]))
+    np.testing.assert_allclose(np.asarray(cols["d"], dtype=np.float64),
+                               np.asarray(t.columns["d"]))
+    assert list(cols["dtg"]) == list(np.asarray(t.columns["dtg"]))
+    assert cols["name"][0] == t.columns["name"].decode([0])[0]
+    garr = decode_wkb(list(cols["geom"]))
+    gx, gy = garr.point_xy()
+    np.testing.assert_allclose(gx, t.geometry().point_xy()[0])
+    assert list(cols["__fid__"]) == [str(f) for f in t.fids]
