@@ -155,3 +155,23 @@ def test_checkpoint_persists_pending_delta(tmp_path):
     ds2 = load_store(str(tmp_path / "ckpt"))
     assert len(ds2.tables["t"]) == 60_500
     assert ds2.count("t", Q) == expected
+
+
+def test_lambda_persist_lands_in_delta_tier():
+    """The lambda hot-tier flush rides the LSM delta path: persisting a
+    small hot tier must NOT rebuild the cold device index."""
+    from geomesa_tpu.stream.live import LambdaDataStore
+    ds, main = _store(n=120_000)
+    lam = LambdaDataStore(ds, "t")
+    for i in range(200):
+        lam.put(f"hot.{i}", v=int(i % 100),
+                dtg=np.datetime64("2022-01-02T00:00:00"),
+                geom=f"POINT ({i % 10} {i % 7})")
+    idx_before = id(ds.planners["t"].indexes[0])
+    flushed = lam.persist()
+    assert flushed == 200
+    assert ds.deltas["t"] is not None and len(ds.deltas["t"]) == 200
+    assert id(ds.planners["t"].indexes[0]) == idx_before, "index rebuilt!"
+    # merged counts exact across cold main + delta + (now empty) hot
+    assert lam.count("BBOX(geom, -0.5, -0.5, 10.5, 7.5) AND v < 100") >= 200
+    assert ds.count("t", "v = 7") == int(np.sum(main[3] == 7)) + 2
