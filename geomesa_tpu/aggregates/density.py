@@ -126,15 +126,16 @@ def density(planner, f, bbox, width: int = 256, height: int = 256,
                            auths)()
 
 
-def _host_density(planner, f, plan, bbox, width, height, weight_attr,
-                  auths) -> DensityGrid:
-    """Host fallback (≙ LocalQueryRunner.transform density path)."""
-    rows = planner.select_indices(f, plan=plan, auths=auths)
-    garr = planner.table.geometry()
+def host_grid(table, rows: np.ndarray, bbox, width: int, height: int,
+              weight_attr: Optional[str] = None) -> np.ndarray:
+    """Snap+accumulate selected table rows onto an (H, W) grid on the host
+    (the LocalQueryRunner density transform; also the LSM delta tier's
+    incremental contribution)."""
+    garr = table.geometry()
     bbs = garr.bboxes()[rows]
     x = (bbs[:, 0] + bbs[:, 2]) / 2
     y = (bbs[:, 1] + bbs[:, 3]) / 2
-    w = np.asarray(planner.table.column(weight_attr), dtype=np.float64)[rows] \
+    w = np.asarray(table.column(weight_attr), dtype=np.float64)[rows] \
         if weight_attr else None
     xmin, ymin, xmax, ymax = bbox
     fx = (x - xmin) / (xmax - xmin)
@@ -144,6 +145,14 @@ def _host_density(planner, f, plan, bbox, width, height, weight_attr,
     iy = np.clip((fy[inb] * height).astype(np.int64), 0, height - 1)
     weights = np.zeros((height, width), dtype=np.float32)
     np.add.at(weights, (iy, ix), w[inb] if w is not None else 1.0)
+    return weights
+
+
+def _host_density(planner, f, plan, bbox, width, height, weight_attr,
+                  auths) -> DensityGrid:
+    """Host fallback (≙ LocalQueryRunner.transform density path)."""
+    rows = planner.select_indices(f, plan=plan, auths=auths)
+    weights = host_grid(planner.table, rows, bbox, width, height, weight_attr)
     return DensityGrid(tuple(bbox), width, height, weights)
 
 

@@ -354,16 +354,27 @@ class TpuDataStore:
             if "crs" in hints:
                 sub = reproject_table(sub, hints["crs"])
             return QueryResult(rows, sub, plan)
-        planner = self.planner(type_name)  # aggregation scans see merged state
         # auths compose with every aggregation hint: the visibility-code
         # mask folds into the device scan (planner._apply_auths) exactly as
         # VisibilityFilter rides the reference's server-side scans
         if "density" in hints:
-            from geomesa_tpu.aggregates.density import density
+            # density merges any pending delta INCREMENTALLY (a host grid
+            # for the delta rows adds onto the device grid) — a dashboard
+            # repaint must never trigger an O(table) flush
+            from geomesa_tpu.aggregates.density import density, host_grid
+            planner = self._main_planner(type_name)
             d = dict(hints["density"])
-            return density(planner, f, d["bbox"], d.get("width", 256),
+            grid = density(planner, f, d["bbox"], d.get("width", 256),
                            d.get("height", 256), d.get("weight"),
                            auths=auths)
+            delta = self.deltas.get(type_name)
+            if delta is not None:
+                drows = self._delta_rows(type_name, f, auths)
+                grid.weights = grid.weights + host_grid(
+                    delta, drows, d["bbox"], grid.width, grid.height,
+                    d.get("weight"))
+            return grid
+        planner = self.planner(type_name)  # other aggregations see merged state
         if "bin" in hints:
             from geomesa_tpu.aggregates.bin import bin_records
             b = dict(hints["bin"])

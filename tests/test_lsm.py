@@ -100,7 +100,14 @@ def test_hint_queries_see_merged_state():
     assert ds.deltas["t"] is not None
     g = ds.query("t", "INCLUDE", hints={
         "density": {"bbox": (-30, -30, 30, 30), "width": 16, "height": 16}})
-    assert int(g.weights.sum()) == 61_000  # delta flushed into the aggregate
+    assert int(g.weights.sum()) == 61_000  # delta contribution merged in
+    assert ds.deltas["t"] is not None, "density must NOT flush the delta"
+    # filtered density also merges the delta exactly
+    g2 = ds.query("t", Q, hints={
+        "density": {"bbox": (-30, -30, 30, 30), "width": 16, "height": 16}})
+    assert int(g2.weights.sum()) == _ref_count([main, (xb, yb, db, vb)])
+    # stats/bin/sample style hints still see merged (flushed) state
+    ds.query("t", "INCLUDE", hints={"stats": "Count()"})  # flush side effect
     assert ds.deltas["t"] is None
 
 
