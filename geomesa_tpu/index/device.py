@@ -49,6 +49,14 @@ def fp62(x, lo: float, hi: float):
     int compare plane pair instead of two passes.
     """
     x = np.asarray(x, dtype=np.float64)
+    if x.ndim == 1 and len(x) >= 65536:
+        # bulk encodes take the native one-pass path (bit-identical —
+        # tests/test_native.py pins parity); the numpy path below is the
+        # canonical semantics and the fallback
+        from geomesa_tpu import native
+        planes = native.fp62_planes(x, float(lo), float(hi))
+        if planes is not None:
+            return planes
     frac = np.clip((x - lo) / (hi - lo), 0.0, 1.0)
     # clamp in int64: float(2^62 - 1) rounds UP to 2^62, so a float-side min
     # would let the domain edge overflow the 31-bit hi plane
