@@ -251,8 +251,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("export", help="export matching features")
     common(sp)
     sp.add_argument("-q", "--cql")
-    sp.add_argument("--format", default="csv",
-                    help="csv|tsv|geojson|json|wkt|arrow|parquet")
+    from geomesa_tpu.io.export import FORMATS as _EXPORT_FORMATS
+    sp.add_argument("--format", default="csv", choices=_EXPORT_FORMATS,
+                    help="|".join(_EXPORT_FORMATS))
     sp.add_argument("-o", "--output")
     sp.add_argument("--max", type=int)
     sp.set_defaults(fn=cmd_export)
