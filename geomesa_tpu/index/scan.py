@@ -402,6 +402,14 @@ def warm_transfer_shapes() -> None:
         puts.append(jax.device_put(np.zeros((b, 8), np.int32)))   # boxes
         puts.append(jax.device_put(np.zeros((b, 4), np.int32)))   # windows
         puts.append(jax.device_put(np.zeros((b,), np.int32)))     # params
+    for b in (32, 64):
+        puts.append(jax.device_put(np.zeros((b, 8), np.int32)))   # batch boxes
+    # padded block-id vectors (_pad_blocks pow2 tiers): a cold query's
+    # candidate-block upload was the r4 plan-stage cost (131ms measured —
+    # one per-shape channel setup through the tunnel)
+    for nb in (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+               16384, 32768, 65536):
+        puts.append(jax.device_put(np.zeros((nb,), np.int32)))
     puts.append(jax.device_put(np.zeros((), np.int32)))
     puts.append(jax.device_put(np.zeros((), np.float32)))
     jax.block_until_ready(puts)

@@ -236,18 +236,27 @@ class BaseSpatialIndex:
             if isinstance(col, StringColumn)
         }
 
+    def _join_prefetch(self) -> None:
+        """Wait for the background perm/keys prefetch (if any) to finish.
+        Every lazy accessor calls this first — otherwise a query arriving
+        while the prefetch is mid-gather would see a not-yet-set cache and
+        redo the same multi-hundred-ms gather synchronously (the r4
+        plan-stage regression at 10M scale)."""
+        import threading
+        t = getattr(self, "_perm_thread", None)
+        if t is not None and t is not threading.current_thread():
+            t.join()
+            self._perm_thread = None
+
     @property
     def perm(self) -> np.ndarray:
         """Host copy of the index sort permutation (sorted pos → table row);
         downloaded from the device lazily on the large-table build path (a
         background prefetch started at build time usually has it ready)."""
         if self._perm_cache is None:
-            t = getattr(self, "_perm_thread", None)
-            if t is not None:
-                t.join()
-                self._perm_thread = None
-            if self._perm_cache is None:
-                self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
+            self._join_prefetch()
+        if self._perm_cache is None:
+            self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
         return self._perm_cache
 
     def _prefetch_perm(self) -> None:
@@ -469,8 +478,22 @@ class BaseSpatialIndex:
     def _bin_segments(self):
         from geomesa_tpu.index.prune import BinSegments
         if getattr(self, "_bin_segs", None) is None:
+            self._join_prefetch()
+        if getattr(self, "_bin_segs", None) is None:
             self._bin_segs = BinSegments(self.sorted_bins)
         return self._bin_segs
+
+    def _sorted_plane(self, attr: str, src: np.ndarray) -> np.ndarray:
+        """Sorted host key plane, preferring the build-time background
+        prefetch result over a synchronous (100s-of-ms at 10M+) gather."""
+        cached = getattr(self, attr, None)
+        if cached is None:
+            self._join_prefetch()
+            cached = getattr(self, attr, None)
+        if cached is None:
+            cached = src[self.perm]
+            setattr(self, attr, cached)
+        return cached
 
     def _binned_row_slices(self, boxes, intervals, sorted_keys,
                            cover_fn) -> Optional[np.ndarray]:
@@ -555,15 +578,11 @@ class Z3Index(BaseSpatialIndex):
 
     @property
     def sorted_z(self) -> np.ndarray:
-        if getattr(self, "_sorted_z", None) is None:
-            self._sorted_z = self._z[self.perm]
-        return self._sorted_z
+        return self._sorted_plane("_sorted_z", self._z)
 
     @property
     def sorted_bins(self) -> np.ndarray:
-        if getattr(self, "_sorted_bins", None) is None:
-            self._sorted_bins = self._bins[self.perm]
-        return self._sorted_bins
+        return self._sorted_plane("_sorted_bins", self._bins)
 
     def key_ranges(self, plan, max_ranges: int = 2000):
         ext = extract_bboxes(plan.full_filter, self.geom)
@@ -619,9 +638,7 @@ class Z2Index(BaseSpatialIndex):
 
     @property
     def sorted_z(self) -> np.ndarray:
-        if getattr(self, "_sorted_z", None) is None:
-            self._sorted_z = self._z[self.perm]
-        return self._sorted_z
+        return self._sorted_plane("_sorted_z", self._z)
 
     def _row_slices(self, boxes, intervals):
         from geomesa_tpu.index.prune import MAX_RANGES, ranges_to_slices
@@ -654,15 +671,11 @@ class XZ3Index(BaseSpatialIndex):
 
     @property
     def sorted_xz(self) -> np.ndarray:
-        if getattr(self, "_sorted_xz", None) is None:
-            self._sorted_xz = self._xz[self.perm]
-        return self._sorted_xz
+        return self._sorted_plane("_sorted_xz", self._xz)
 
     @property
     def sorted_bins(self) -> np.ndarray:
-        if getattr(self, "_sorted_bins", None) is None:
-            self._sorted_bins = self._bins[self.perm]
-        return self._sorted_bins
+        return self._sorted_plane("_sorted_bins", self._bins)
 
     def _row_slices(self, boxes, intervals):
         from geomesa_tpu.index.prune import MAX_RANGES
@@ -696,9 +709,7 @@ class XZ2Index(BaseSpatialIndex):
 
     @property
     def sorted_xz(self) -> np.ndarray:
-        if getattr(self, "_sorted_xz", None) is None:
-            self._sorted_xz = self._xz[self.perm]
-        return self._sorted_xz
+        return self._sorted_plane("_sorted_xz", self._xz)
 
     def _row_slices(self, boxes, intervals):
         from geomesa_tpu.index.prune import MAX_RANGES, ranges_to_slices
@@ -731,9 +742,7 @@ class S2Index(BaseSpatialIndex):
 
     @property
     def sorted_z(self) -> np.ndarray:
-        if getattr(self, "_sorted_z", None) is None:
-            self._sorted_z = self._z[self.perm]
-        return self._sorted_z
+        return self._sorted_plane("_sorted_z", self._z)
 
     def _row_slices(self, boxes, intervals):
         from geomesa_tpu.curves.s2 import S2SFC
@@ -770,15 +779,11 @@ class S3Index(BaseSpatialIndex):
 
     @property
     def sorted_z(self) -> np.ndarray:
-        if getattr(self, "_sorted_z", None) is None:
-            self._sorted_z = self._z[self.perm]
-        return self._sorted_z
+        return self._sorted_plane("_sorted_z", self._z)
 
     @property
     def sorted_bins(self) -> np.ndarray:
-        if getattr(self, "_sorted_bins", None) is None:
-            self._sorted_bins = self._bins[self.perm]
-        return self._sorted_bins
+        return self._sorted_plane("_sorted_bins", self._bins)
 
     def _row_slices(self, boxes, intervals):
         from geomesa_tpu.curves.s2 import S2SFC
