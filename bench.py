@@ -468,9 +468,12 @@ def main() -> None:
         del join, dx_, dy_
         gc.collect()
 
-        # extent x extent join (grid partition + exact refine)
+        # extent x extent join (grid partition + device band refine + host
+        # f64 uncertain sliver)
         from geomesa_tpu.features.geometry import GeometryArray
-        from geomesa_tpu.parallel.extent_join import extent_join
+        from geomesa_tpu.parallel.extent_join import (candidate_pairs,
+                                                      extent_join)
+        from geomesa_tpu.parallel.pair_kernel import device_refine
         nj = 200_000
         jx = rng.uniform(-60, 60, nj)
         jy = rng.uniform(-60, 60, nj)
@@ -481,10 +484,42 @@ def main() -> None:
         lines = GeometryArray.linestrings(jc)
         polys_g = GeometryArray.from_shapes(polys)
         t0 = time.perf_counter()
-        la, ra = extent_join(lines, polys_g)
-        detail["cfg3_extent_join_s"] = round(time.perf_counter() - t0, 2)
+        la, ra = extent_join(lines, polys_g, device="never")
+        detail["cfg3_extent_join_host_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        la_d, ra_d = extent_join(lines, polys_g, device="always")
+        detail["cfg3_extent_join_device_s"] = round(
+            time.perf_counter() - t0, 2)
+        assert np.array_equal(la, la_d) and np.array_equal(ra, ra_d)
         detail["cfg3_extent_join_pairs"] = int(len(la))
         detail["cfg3_extent_join_n_lines"] = nj
+        # device pair-kernel throughput: candidate pairs refined per second
+        # per chip (warm dispatch, excludes the host grid partitioner).
+        # The natural candidate set here is small and would be RTT-bound, so
+        # the throughput rep tiles it to ~1M pairs — same kernel, same
+        # gather-from-geometry-tables serving shape.
+        from geomesa_tpu.parallel.pair_kernel import prepare_refine
+        cli, crj = candidate_pairs(lines.bboxes(), polys_g.bboxes())
+        detail["cfg3_candidate_pairs"] = int(len(cli))
+        reps_t = max(1, 1_000_000 // max(1, len(cli)))
+        tli = np.tile(cli, reps_t)
+        trj = np.tile(crj, reps_t)
+        device_refine(lines, polys_g, tli, trj)  # warm/compile
+        lat3d = _time_reps(lambda: device_refine(lines, polys_g, tli, trj),
+                           max(5, reps // 2))
+        p3d = _p50(lat3d)
+        detail["cfg3_pair_refine_p50_ms"] = round(p3d, 2)
+        detail["cfg3_pair_refine_mpairs_per_s_per_chip"] = round(
+            len(tli) / (p3d / 1000) / 1e6, 2)
+        # staged variant: pair vectors + geometry tables resident on device
+        # (serving shape; isolates kernel+readback from the per-call upload)
+        prep3 = prepare_refine(lines, polys_g, tli, trj)
+        prep3()
+        lat3p = _time_reps(prep3, max(5, reps // 2))
+        p3p = _p50(lat3p)
+        detail["cfg3_pair_refine_staged_p50_ms"] = round(p3p, 2)
+        detail["cfg3_pair_refine_staged_mpairs_per_s_per_chip"] = round(
+            len(tli) / (p3p / 1000) / 1e6, 2)
 
     # ---- config 4: density + KNN -----------------------------------------
     if "4" in configs:

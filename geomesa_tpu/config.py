@@ -95,6 +95,12 @@ NO_NATIVE = _register(
     "semantics: '0'/'false'/'no'/'off' mean NOT disabled (earlier releases "
     "treated any non-empty value as disabling).")
 
+JOIN_DEVICE_MIN_PAIRS = _register(
+    "GEOMESA_TPU_JOIN_DEVICE_MIN_PAIRS", 32_768, int,
+    "Candidate-pair count above which the extent join's exact refine runs "
+    "on the device band kernel (below it, host f64 soups win — each device "
+    "dispatch pays the tunnel round trip).")
+
 BENCH_N = _register(
     "GEOMESA_TPU_BENCH_N", 100_000_000, int,
     "bench.py corpus size.")

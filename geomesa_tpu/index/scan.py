@@ -123,16 +123,21 @@ def _orient_band(px, py, qx, qy, rx, ry):
     return det, tol
 
 
-def _pip_band(px, py, ex1, ey1, ex2, ey2):
+def _pip_band(px, py, ex1, ey1, ex2, ey2, evalid=None):
     """(certainly-inside, certainly-outside) of points vs polygon edges via
     the half-open crossing rule; uncertain when any edge's crossing decision
-    sits inside its error band or a vertex y ties the ray."""
+    sits inside its error band or a vertex y ties the ray. ``evalid``
+    masks padded edges out of both crossings and uncertainty (pair-kernel
+    padded tables)."""
     cond = (ey1 > py) != (ey2 > py)
     o, t = _orient_band(ex1, ey1, ex2, ey2, px, py)
     upward = ey2 > ey1
     cross = cond & jnp.where(upward, o > t, o < -t)
     unc = (cond & (jnp.abs(o) <= t)) \
         | (jnp.abs(ey1 - py) <= _DY_BAND) | (jnp.abs(ey2 - py) <= _DY_BAND)
+    if evalid is not None:
+        cross = cross & evalid
+        unc = unc & evalid
     inside = (jnp.sum(cross, axis=-1) % 2) == 1
     any_unc = jnp.any(unc, axis=-1)
     return inside & ~any_unc, ~inside & ~any_unc

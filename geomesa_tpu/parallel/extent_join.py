@@ -1,5 +1,5 @@
 """Extent × extent spatial join: grid partition → bbox pair generation →
-exact geometry refine.
+device band refine → exact host refine of the uncertain sliver.
 
 ≙ the reference's Spark join machinery: `RelationUtils` spatial partitioning
 (grid / weighted, /root/reference/geomesa-spark/geomesa-spark-sql/src/main/
@@ -11,26 +11,33 @@ SweepLineIndex + predicate evaluate). The TPU-native shape:
     out to every cell its bbox overlaps (duplicate-and-own: a candidate pair
     is emitted only by the cell that contains the max of the two bbox min
     corners, the standard dedup that avoids a global unique pass)
-  - candidate pairs filter by envelope overlap, all vectorized numpy — the
-    moral equivalent of the sweepline, O(pairs) after gridding
-  - surviving pairs refine with the exact vectorized geometry predicates
-    (filter/geom_batch), grouped by right-hand geometry so each group is one
-    batched soup evaluation
+  - candidate pairs stream out in bounded chunks (never a monolithic
+    materialization — an overlap-heavy workload degrades to more chunks,
+    not an error), filtered by envelope overlap — the moral equivalent of
+    the sweepline, O(pairs) after gridding
+  - surviving pairs refine on the DEVICE with the certified f32 band kernel
+    (parallel/pair_kernel — the executor-side predicate evaluate of
+    GeoMesaJoinRelation run on a chip), leaving only the uncertain sliver
+    for the host's exact f64 geometry soups (filter/geom_batch), grouped by
+    right-hand geometry so each group is one batched evaluation
 
 Partitioned variant: row-band partitioning of the grid, each band an
-independent join — the unit the dist layer shards over a device mesh (host
-shuffle ≙ the reference's Spark shuffle; the refine arithmetic is the part a
-chip would accelerate)."""
+independent join — the unit the dist layer shards over a device mesh
+(pair_kernel.mesh_join_pairs is the whole-mesh form: pairs sharded,
+geometry tables broadcast, psum'd hit counts)."""
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from geomesa_tpu import config
 from geomesa_tpu.features import geometry as geo
 from geomesa_tpu.filter import geom_batch
 
+# memory bound per candidate-pair chunk (NOT a failure cap: bigger joins
+# stream through more chunks)
 MAX_CANDIDATE_PAIRS = 50_000_000
 
 
@@ -57,12 +64,17 @@ def _fanout(ix0, iy0, ix1, iy1, gx):
     return gid, cell
 
 
-def candidate_pairs(lbb: np.ndarray, rbb: np.ndarray,
-                    grid: Optional[Tuple[int, int]] = None):
-    """(li, rj) candidate pairs whose envelopes overlap, deduplicated via
-    cell ownership. Pure vectorized host planning (≙ partition + sweepline)."""
+def candidate_pair_chunks(lbb: np.ndarray, rbb: np.ndarray,
+                          grid: Optional[Tuple[int, int]] = None,
+                          chunk_pairs: int = MAX_CANDIDATE_PAIRS
+                          ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Stream (li, rj) candidate-pair chunks whose envelopes overlap,
+    deduplicated via cell ownership. Each yielded chunk materializes at most
+    ~``chunk_pairs`` raw pairs, so overlap-heavy workloads degrade to more
+    chunks instead of raising (the reference never throws on join size; it
+    partitions harder — RelationUtils weighted partitioning)."""
     if len(lbb) == 0 or len(rbb) == 0:
-        return (np.empty(0, np.int64),) * 2
+        return
     xmin = min(lbb[:, 0].min(), rbb[:, 0].min())
     ymin = min(lbb[:, 1].min(), rbb[:, 1].min())
     xmax = max(lbb[:, 2].max(), rbb[:, 2].max())
@@ -86,55 +98,115 @@ def candidate_pairs(lbb: np.ndarray, rbb: np.ndarray,
     starts = np.searchsorted(rc_s, lc, side="left")
     stops = np.searchsorted(rc_s, lc, side="right")
     counts = stops - starts
-    total = int(counts.sum())
-    if total > MAX_CANDIDATE_PAIRS:
-        raise ValueError(
-            f"extent join candidate blow-up: {total} pairs (cap "
-            f"{MAX_CANDIDATE_PAIRS}); refine the grid or pre-filter")
-    li = np.repeat(lg, counts)
-    pos = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
-    rj = rg_s[np.repeat(starts, counts) + pos]
-    cell = np.repeat(lc, counts)
+    cum = np.cumsum(counts)
+    total = int(cum[-1]) if len(cum) else 0
+    if total == 0:
+        return
+    # split left-fanout entries into runs of <= chunk_pairs raw pairs
+    cuts = [0]
+    while cuts[-1] < len(counts):
+        base = int(cum[cuts[-1] - 1]) if cuts[-1] else 0
+        nxt = int(np.searchsorted(cum, base + chunk_pairs, side="right"))
+        nxt = max(nxt, cuts[-1] + 1)  # always advance (one entry may exceed)
+        cuts.append(min(nxt, len(counts)))
 
-    # envelope overlap + ownership dedup (the cell holding the pair's
-    # max-of-mins corner owns it)
-    lb = lbb[li]
-    rb = rbb[rj]
-    overlap = ((lb[:, 0] <= rb[:, 2]) & (lb[:, 2] >= rb[:, 0])
-               & (lb[:, 1] <= rb[:, 3]) & (lb[:, 3] >= rb[:, 1]))
-    ox = np.maximum(lb[:, 0], rb[:, 0])
-    oy = np.maximum(lb[:, 1], rb[:, 1])
-    own_cell = (np.clip(((oy - origin[1]) / csize[1]).astype(np.int64), 0, gy - 1) * gx
-                + np.clip(((ox - origin[0]) / csize[0]).astype(np.int64), 0, gx - 1))
-    keep = overlap & (own_cell == cell)
-    return li[keep], rj[keep]
+    for a, b in zip(cuts[:-1], cuts[1:]):
+        cnt = counts[a:b]
+        n = int(cnt.sum())
+        if n == 0:
+            continue
+        li = np.repeat(lg[a:b], cnt)
+        pos = np.arange(n) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+        rj = rg_s[np.repeat(starts[a:b], cnt) + pos]
+        cell = np.repeat(lc[a:b], cnt)
+
+        # envelope overlap + ownership dedup (the cell holding the pair's
+        # max-of-mins corner owns it)
+        lb = lbb[li]
+        rb = rbb[rj]
+        overlap = ((lb[:, 0] <= rb[:, 2]) & (lb[:, 2] >= rb[:, 0])
+                   & (lb[:, 1] <= rb[:, 3]) & (lb[:, 3] >= rb[:, 1]))
+        ox = np.maximum(lb[:, 0], rb[:, 0])
+        oy = np.maximum(lb[:, 1], rb[:, 1])
+        own_cell = (np.clip(((oy - origin[1]) / csize[1]).astype(np.int64),
+                            0, gy - 1) * gx
+                    + np.clip(((ox - origin[0]) / csize[0]).astype(np.int64),
+                              0, gx - 1))
+        keep = overlap & (own_cell == cell)
+        if keep.any():
+            yield li[keep], rj[keep]
+
+
+def candidate_pairs(lbb: np.ndarray, rbb: np.ndarray,
+                    grid: Optional[Tuple[int, int]] = None):
+    """(li, rj) candidate pairs whose envelopes overlap (all chunks
+    concatenated — the streaming form is ``candidate_pair_chunks``)."""
+    out = list(candidate_pair_chunks(lbb, rbb, grid))
+    if not out:
+        return (np.empty(0, np.int64),) * 2
+    return (np.concatenate([c[0] for c in out]),
+            np.concatenate([c[1] for c in out]))
+
+
+def _host_refine_mask(left: geo.GeometryArray, right: geo.GeometryArray,
+                      li: np.ndarray, rj: np.ndarray, fn) -> np.ndarray:
+    """Exact f64 predicate per pair, batched per distinct right geometry
+    (each group is one geom_batch soup evaluation). Returns bool (P,)."""
+    mask = np.zeros(len(li), dtype=bool)
+    if len(li) == 0:
+        return mask
+    order = np.argsort(rj, kind="stable")
+    rj_s = rj[order]
+    bounds = np.flatnonzero(np.diff(rj_s)) + 1
+    for seg_pos, j in zip(np.split(order, bounds),
+                          rj_s[np.concatenate([[0], bounds])]):
+        mask[seg_pos] = fn(left, li[seg_pos], right.shape(int(j)))
+    return mask
+
+
+def _refine_chunk(left: geo.GeometryArray, right: geo.GeometryArray,
+                  li: np.ndarray, rj: np.ndarray, predicate: str,
+                  device: str) -> np.ndarray:
+    """Exact hit mask for one candidate chunk: device band kernel first
+    (when it applies), host f64 for the uncertain sliver / fallback."""
+    fn = geom_batch.batch_intersects if predicate == "intersects" \
+        else geom_batch.batch_within
+    use_device = (predicate == "intersects" and device != "never"
+                  and (device == "always"
+                       or len(li) >= config.JOIN_DEVICE_MIN_PAIRS.get()))
+    if use_device:
+        from geomesa_tpu.parallel.pair_kernel import device_refine
+        out = device_refine(left, right, li, rj)
+        if out is not None:
+            hit, unc = out
+            if unc.any():
+                u = np.flatnonzero(unc)
+                hit = hit.copy()
+                hit[u] = _host_refine_mask(left, right, li[u], rj[u], fn)
+            return hit
+    return _host_refine_mask(left, right, li, rj, fn)
 
 
 def extent_join(left: geo.GeometryArray, right: geo.GeometryArray,
                 predicate: str = "intersects",
-                grid: Optional[Tuple[int, int]] = None):
+                grid: Optional[Tuple[int, int]] = None,
+                device: str = "auto"):
     """Exact extent×extent join → (left ids, right ids) of matching pairs.
 
-    Candidate pairs come from the grid partitioner; the exact predicate
-    evaluates with the vectorized geometry soups, batched per distinct
-    right-hand geometry (each group is one geom_batch evaluation)."""
+    Candidate pairs stream from the grid partitioner in bounded chunks;
+    each chunk refines on the device (certified f32 bands, INTERSECTS) with
+    host f64 only for the uncertain sliver — or fully on host for small
+    chunks / WITHIN / unsupported shapes. ``device``: "auto" (size
+    threshold, config JOIN_DEVICE_MIN_PAIRS), "always", "never".
+    """
     if predicate not in ("intersects", "within"):
         raise ValueError(f"Unsupported join predicate {predicate!r}")
-    li, rj = candidate_pairs(left.bboxes(), right.bboxes(), grid)
-    if len(li) == 0:
-        return li, rj
-    fn = geom_batch.batch_intersects if predicate == "intersects" \
-        else geom_batch.batch_within
     out_l: List[np.ndarray] = []
     out_r: List[np.ndarray] = []
-    order = np.argsort(rj, kind="stable")
-    li, rj = li[order], rj[order]
-    bounds = np.flatnonzero(np.diff(rj)) + 1
-    for seg_l, j in zip(np.split(li, bounds),
-                        rj[np.concatenate([[0], bounds])] if len(li) else []):
-        mask = fn(left, seg_l, right.shape(int(j)))
-        out_l.append(seg_l[mask])
-        out_r.append(np.full(int(mask.sum()), j, dtype=np.int64))
+    for li, rj in candidate_pair_chunks(left.bboxes(), right.bboxes(), grid):
+        hit = _refine_chunk(left, right, li, rj, predicate, device)
+        out_l.append(li[hit])
+        out_r.append(rj[hit])
     if not out_l:
         return np.empty(0, np.int64), np.empty(0, np.int64)
     la = np.concatenate(out_l)
@@ -146,7 +218,8 @@ def extent_join(left: geo.GeometryArray, right: geo.GeometryArray,
 def extent_join_partitioned(left: geo.GeometryArray,
                             right: geo.GeometryArray,
                             n_partitions: int = 8,
-                            predicate: str = "intersects"):
+                            predicate: str = "intersects",
+                            device: str = "auto"):
     """Band-partitioned join: the grid's y-extent splits into bands, each an
     independent join over the geometries overlapping it (geometries fan out
     to every band they touch; pair ownership dedups at the band of the
@@ -166,7 +239,8 @@ def extent_join_partitioned(left: geo.GeometryArray,
         rsel = np.flatnonzero((rbb[:, 3] >= y0) & (rbb[:, 1] <= y1))
         if len(lsel) == 0 or len(rsel) == 0:
             continue
-        la, ra = extent_join(left.take(lsel), right.take(rsel), predicate)
+        la, ra = extent_join(left.take(lsel), right.take(rsel), predicate,
+                             device=device)
         if len(la) == 0:
             continue
         gl, gr = lsel[la], rsel[ra]

@@ -97,3 +97,124 @@ def test_empty_sides():
     empty = geo.GeometryArray.from_shapes([])
     la, ra = extent_join(left, empty)
     assert len(la) == 0 and len(ra) == 0
+
+
+def test_chunked_candidates_equal_monolithic():
+    """Streaming the pair generation in tiny chunks must reproduce the
+    single-pass result exactly (the no-hard-fail-at-scale discipline)."""
+    from geomesa_tpu.parallel.extent_join import candidate_pair_chunks
+    left = _lines(2000, 10)
+    right = _polys(40, 11)
+    one = candidate_pairs(left.bboxes(), right.bboxes())
+    chunks = list(candidate_pair_chunks(left.bboxes(), right.bboxes(),
+                                        chunk_pairs=500))
+    assert len(chunks) > 1, "chunk size did not engage"
+    li = np.concatenate([c[0] for c in chunks])
+    rj = np.concatenate([c[1] for c in chunks])
+    assert sorted(zip(li.tolist(), rj.tolist())) \
+        == sorted(zip(one[0].tolist(), one[1].tolist()))
+
+
+def test_device_refine_matches_host():
+    """The certified-band device kernel + f64 uncertain refine must equal
+    the pure host join bit for bit (device='always' forces the kernel even
+    for a small workload; on the CPU-jax test mesh this runs the same XLA
+    program the chip would)."""
+    left = _lines(2500, 12)
+    right = _polys(50, 13)
+    la_h, ra_h = extent_join(left, right, device="never")
+    la_d, ra_d = extent_join(left, right, device="always")
+    np.testing.assert_array_equal(la_h, la_d)
+    np.testing.assert_array_equal(ra_h, ra_d)
+
+
+def test_device_refine_line_vs_line():
+    left = _lines(1200, 14)
+    right = _lines(1200, 15)
+    la_h, ra_h = extent_join(left, right, device="never")
+    la_d, ra_d = extent_join(left, right, device="always")
+    np.testing.assert_array_equal(la_h, la_d)
+    np.testing.assert_array_equal(ra_h, ra_d)
+
+
+def test_device_refine_poly_vs_poly_containment():
+    """Nested polygons: no boundary crossing, pure containment — exercises
+    the pip-band arms of the pair kernel."""
+    shapes_l, shapes_r = [], []
+    for k in range(6):
+        c = k * 10.0
+        big = [[c - 2, -2.0], [c + 2, -2.0], [c + 2, 2.0], [c - 2, 2.0],
+               [c - 2, -2.0]]
+        small = [[c - .5, -.5], [c + .5, -.5], [c + .5, .5], [c - .5, .5],
+                 [c - .5, -.5]]
+        shapes_l.append((geo.POLYGON, [small]))
+        shapes_r.append((geo.POLYGON, [big]))
+    left = geo.GeometryArray.from_shapes(shapes_l)
+    right = geo.GeometryArray.from_shapes(shapes_r)
+    la_h, ra_h = extent_join(left, right, device="never")
+    la_d, ra_d = extent_join(left, right, device="always")
+    np.testing.assert_array_equal(la_h, la_d)
+    np.testing.assert_array_equal(ra_h, ra_d)
+    assert len(la_d) == 6  # each small poly inside exactly its big poly
+
+
+def test_device_refine_multipart_containment():
+    """A MULTILINESTRING whose SECOND part sits wholly inside the polygon:
+    no boundary crossing, first vertex far outside — the kernel must not
+    certify a miss (multi-part geometries are connected no more), and the
+    join must agree with the host bit for bit."""
+    ml = (geo.MULTILINESTRING,
+          [[[100.0, 100.0], [101.0, 101.0]],     # part 1: far away
+           [[0.0, 0.0], [1.0, 1.0]]])            # part 2: inside the poly
+    left = geo.GeometryArray.from_shapes([ml])
+    right = geo.GeometryArray.from_shapes([
+        (geo.POLYGON, [[[-5.0, -5.0], [5.0, -5.0], [5.0, 5.0],
+                        [-5.0, 5.0], [-5.0, -5.0]]])])
+    la_h, ra_h = extent_join(left, right, device="never")
+    la_d, ra_d = extent_join(left, right, device="always")
+    np.testing.assert_array_equal(la_h, la_d)
+    np.testing.assert_array_equal(ra_h, ra_d)
+    assert len(la_d) == 1  # the pair intersects via the contained part
+
+
+def test_device_refine_falls_back_for_points():
+    """Point geometries have no boundary segments — the device path must
+    decline and the host produce the exact result."""
+    pts = geo.GeometryArray.points(np.array([0.0, 50.0]),
+                                   np.array([0.0, 50.0]))
+    right = _polys(10, 16)
+    from geomesa_tpu.parallel.pair_kernel import device_refine
+    assert device_refine(pts, right, np.array([0, 1]),
+                         np.array([0, 1])) is None
+    la, ra = extent_join(pts, right, device="always")
+    got = sorted(zip(la.tolist(), ra.tolist()))
+    assert got == _brute(pts, right)
+
+
+def test_mesh_join_pairs_psum_counts():
+    """Whole-mesh pair refine: pairs sharded over the 8 virtual devices,
+    geometry tables broadcast; per-device hit counts must sum to the
+    host-join hit count and the sharded hit mask must match."""
+    import jax
+    from jax.sharding import Mesh
+    from geomesa_tpu.parallel.pair_kernel import mesh_join_pairs
+
+    left = _lines(1500, 17)
+    right = _polys(40, 18)
+    li, rj = candidate_pairs(left.bboxes(), right.bboxes())
+    mesh = Mesh(np.array(jax.devices()[:8]), ("rows",))
+    out = mesh_join_pairs(mesh, left, right, li, rj)
+    assert out is not None
+    hit, unc, per_dev = out
+    # resolve uncertain pairs on host, then compare to the pure host join
+    exact = hit.copy()
+    u = np.flatnonzero(unc)
+    if len(u):
+        from geomesa_tpu.parallel.extent_join import _host_refine_mask
+        exact[u] = _host_refine_mask(left, right, li[u], rj[u],
+                                     geom_batch.batch_intersects)
+    la, ra = extent_join(left, right, device="never")
+    assert sorted(zip(li[exact].tolist(), rj[exact].tolist())) \
+        == sorted(zip(la.tolist(), ra.tolist()))
+    assert int(per_dev.sum()) == int(hit.sum())
+    assert len(per_dev) == 8
