@@ -210,8 +210,10 @@ def main() -> None:
         idx = Z3Index(sft, table)
         jax.block_until_ready(idx.device.columns["xi"])
         detail["cfg1_index_build_s"] = round(time.perf_counter() - t0, 2)
+        for k, v in getattr(idx, "build_stages", {}).items():
+            detail[f"cfg1_build_{k}"] = v
         t0 = time.perf_counter()
-        idx.perm  # joins the background readback of the pruning host keys
+        idx._join_prefetch()  # joins the background host pruning-key sorts
         detail["cfg1_host_keys_s"] = round(time.perf_counter() - t0, 2)
         planner = QueryPlanner(sft, table, [idx])
 

@@ -350,7 +350,7 @@ class QueryPlanner:
         if len(unc) == 0:
             return certain
         from geomesa_tpu.filter.geom_batch import batch_intersects
-        rows = plan.index.perm[unc]
+        rows = plan.index.map_rows(unc)
         return certain + int(batch_intersects(
             self.table.geometry(), rows, res.geometry).sum())
 
@@ -387,7 +387,7 @@ class QueryPlanner:
                 idx, _ = plan.index.kernels.select(
                     plan.primary_kind, plan.boxes_loose, plan.windows,
                     plan.residual_device, _select_tier(capacity))
-        rows = plan.index.perm[idx]
+        rows = plan.index.map_rows(idx)
         if plan.residual_host is None:
             return np.sort(rows)
         return np.sort(self._refine(plan, rows))

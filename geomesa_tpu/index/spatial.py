@@ -104,6 +104,27 @@ def device_sort_perm(keys: List[np.ndarray]):
     return _sort_perm(padded)[:n]
 
 
+_ROW_GATHER_JIT = None
+
+
+def _row_gather(dev_perm, idx: np.ndarray) -> np.ndarray:
+    """Gather table rows for sorted positions on device (pow2-padded so
+    compilations and transfer shapes are shared across result sizes)."""
+    global _ROW_GATHER_JIT
+    import jax
+    import jax.numpy as jnp
+
+    if _ROW_GATHER_JIT is None:
+        _ROW_GATHER_JIT = jax.jit(lambda p, i: p[i])
+    if len(idx) == 0:
+        return np.empty(0, dtype=np.int64)
+    cap = max(8, 1 << max(0, len(idx) - 1).bit_length())
+    pad = np.zeros(cap, np.int32)
+    pad[: len(idx)] = idx
+    out = np.asarray(_ROW_GATHER_JIT(dev_perm, jnp.asarray(pad)))
+    return out[: len(idx)].astype(np.int64)
+
+
 def _as_query_column(name: str, gathered, xp):
     """Shared build-plane → device-column rename/cast rule (one home for both
     the host small-table gather and the traced device gather): bin16 lands as
@@ -230,7 +251,12 @@ class BaseSpatialIndex:
                 # np.lexsort sorts by LAST key first → reverse to major-first
                 self._perm_cache = np.lexsort(tuple(reversed(keys))).astype(np.int64)
                 self.device = DeviceTable.build(table, self._perm_cache, self.period)
+        import time as _time
+        _t = _time.perf_counter()
         self.kernels = ScanKernels(self.device.columns)
+        if hasattr(self, "build_stages"):
+            self.build_stages["warm_shapes_s"] = round(
+                _time.perf_counter() - _t, 2)
         self.vocabs = {
             name: col.vocab for name, col in table.columns.items()
             if isinstance(col, StringColumn)
@@ -259,28 +285,60 @@ class BaseSpatialIndex:
             self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
         return self._perm_cache
 
+    def _host_sorted_keys(self) -> None:
+        """Derive the sorted host pruning keys WITHOUT downloading the
+        device perm. The index order is (bin, key, row); row only breaks
+        ties between EQUAL keys, so the sorted key *values* are exactly
+        np.sort per bin segment — ~6s of host sorts at 100M versus a
+        400MB perm download through a tunnel whose downlink runs 10-100×
+        slower than its uplink (measured 2-25MB/s down vs 30-280MB/s up)."""
+        bins = getattr(self, "_bins", None)
+        order = None
+        if bins is not None:
+            # one stable argsort of the (small-dtype) bins, then per-segment
+            # value sorts — O(N log N) regardless of how many bins exist
+            order = np.argsort(bins, kind="stable")
+            self._sorted_bins = np.asarray(bins)[order]
+            segs = self._bin_segments()
+        for attr, src in (("_sorted_z", getattr(self, "_z", None)),
+                          ("_sorted_xz", getattr(self, "_xz", None))):
+            if src is None:
+                continue
+            if order is None:
+                setattr(self, attr, np.sort(src))
+            else:
+                out = src[order]
+                for i in range(len(segs.bins)):
+                    out[segs.starts[i]: segs.starts[i + 1]].sort()
+                setattr(self, attr, out)
+
     def _prefetch_perm(self) -> None:
-        """Overlap the device→host perm readback AND the derived host
-        pruning keys (sorted z/bins + bin segments — together several
-        seconds of single-core gathers at 100M) with whatever the caller
-        does next after the build, so the first query's prepare is ~ms."""
+        """Overlap the derived host pruning keys (sorted z/bins + bin
+        segments) with whatever the caller does next after the build, so
+        the first query's prepare is ~ms. The device perm itself is NOT
+        downloaded here — ``map_rows`` gathers small result sets on device
+        and the ``perm`` property downloads in full only on demand."""
         import threading
 
         def fetch():
             try:
-                self._perm_cache = np.asarray(self._dev_perm).astype(np.int64)
-                if getattr(self, "_z", None) is not None:
-                    self._sorted_z = self._z[self._perm_cache]
-                if getattr(self, "_bins", None) is not None:
-                    self._sorted_bins = self._bins[self._perm_cache]
-                    self._bin_segments()
-                if getattr(self, "_xz", None) is not None:
-                    self._sorted_xz = self._xz[self._perm_cache]
+                self._host_sorted_keys()
             except Exception:
                 pass  # the lazy properties will retry synchronously
 
         self._perm_thread = threading.Thread(target=fetch, daemon=True)
         self._perm_thread.start()
+
+    def map_rows(self, idx: np.ndarray) -> np.ndarray:
+        """Sorted-position → table-row mapping for query results. Prefers
+        the cached host perm; small sets gather against the device-resident
+        perm (a full perm download is 100s of MB through the slow downlink
+        — only huge hydrations warrant it)."""
+        idx = np.asarray(idx, dtype=np.int64)
+        if self._perm_cache is not None or self._dev_perm is None \
+                or len(idx) > (1 << 20):
+            return self.perm[idx]
+        return _row_gather(self._dev_perm, idx)
 
     # subclasses supply the sort keys ---------------------------------------
 
@@ -331,11 +389,26 @@ class BaseSpatialIndex:
         # to the power-of-two sort shape on DEVICE — ~28% less key traffic
         # through the host link and no host pad pass; the program is keyed
         # by n already, so device-side padding adds no compilations)
+        import time as _time
+        t0 = _time.perf_counter()
         dev_keys = [jax.device_put(k) for k in keys]
         dev_cols = {k: jax.device_put(v) for k, v in upload.items()}
-
+        jax.block_until_ready(dev_keys + list(dev_cols.values()))
+        t1 = _time.perf_counter()
         self._dev_perm, cols = _native_sort_gather(
             tuple(dev_keys), dev_cols, n)
+        jax.block_until_ready(self._dev_perm)
+        t2 = _time.perf_counter()
+        # per-stage build timings (≙ the profile the reference exposes via
+        # MethodProfiling around its writers); bench surfaces these so a
+        # slow build is attributable: upload is tunnel-bandwidth, sort is
+        # device + compile (persistent-cached after the first run)
+        mb = sum(k.nbytes for k in keys) / 1e6 \
+            + sum(v.nbytes for v in upload.values()) / 1e6
+        self.build_stages = dict(getattr(self, "build_stages", {}))
+        self.build_stages.update({
+            "upload_s": round(t1 - t0, 2), "upload_mb": round(mb, 1),
+            "sort_gather_s": round(t2 - t1, 2)})
         self.device = DeviceTable(n, cols)
         self._prefetch_perm()
 
@@ -565,9 +638,12 @@ class Z3Index(BaseSpatialIndex):
             return False
         x, y = garr.point_xy()
         ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
+        import time as _time
+        t0 = _time.perf_counter()
         enc = native.z3_encode(x, y, ms, self.period.value)
         if enc is None:  # calendar periods stay on the numpy path
             return False
+        self.build_stages = {"encode_s": round(_time.perf_counter() - t0, 2)}
         self._sfc = Z3SFC.apply(self.period)
         self._z = enc["z"]
         self._bins = enc["bin16"]

@@ -274,23 +274,30 @@ def mesh_join_pairs(mesh, left: geo.GeometryArray, right: geo.GeometryArray,
     rt = padded_segment_table(right, ur)
     if lt is None or rt is None:
         return None
-    n_pad = max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
-    pl = np.full(n_pad, -1, dtype=np.int32)
-    pr = np.zeros(n_pad, dtype=np.int32)
-    pl[:n] = inv_l
-    pr[:n] = inv_r
-
     rows = NamedSharding(mesh, P("rows"))
     repl = NamedSharding(mesh, P())
     d_l = [jax.device_put(a, repl) for a in lt]
     d_r = [jax.device_put(a, repl) for a in rt]
-    d_pl = jax.device_put(pl, rows)
-    d_pr = jax.device_put(pr, rows)
-
     fn = _mesh_fn(mesh, n_dev)
-    hit, unc, per_dev = fn(*d_l, *d_r, d_pl, d_pr)
-    return (np.asarray(hit)[:n], np.asarray(unc)[:n],
-            np.asarray(per_dev))
+
+    # chunk the pair axis like device_refine: per-device band intermediates
+    # stay within _CHUNK_BUDGET instead of scaling with the join size
+    ch = _chunk_size(lt[0].shape[1], rt[0].shape[1]) * n_dev
+    hits, uncs = [], []
+    per_dev = np.zeros(n_dev, dtype=np.int64)
+    for s in range(0, n, ch):
+        e = min(n, s + ch)
+        n_pad = max(n_dev, ((e - s + n_dev - 1) // n_dev) * n_dev)
+        pl = np.full(n_pad, -1, dtype=np.int32)
+        pr = np.zeros(n_pad, dtype=np.int32)
+        pl[: e - s] = inv_l[s:e]
+        pr[: e - s] = inv_r[s:e]
+        hit, unc, pd = fn(*d_l, *d_r, jax.device_put(pl, rows),
+                          jax.device_put(pr, rows))
+        hits.append(np.asarray(hit)[: e - s])
+        uncs.append(np.asarray(unc)[: e - s])
+        per_dev += np.asarray(pd)
+    return np.concatenate(hits), np.concatenate(uncs), per_dev
 
 
 _MESH_JITS: dict = {}

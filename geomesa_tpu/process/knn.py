@@ -118,7 +118,7 @@ def _device_knn(planner, plan, x: float, y: float, k: int,
 
 
 def _exact_rerank(planner, index, pos: np.ndarray, x: float, y: float, k: int):
-    rows = index.perm[pos.astype(np.int64)]
+    rows = index.map_rows(pos.astype(np.int64))
     if len(rows) == 0:
         return rows, np.empty(0)
     gx, gy = planner.table.geometry().point_xy()
