@@ -543,7 +543,20 @@ def main() -> None:
             # band (~1 f32 ulp) along the bbox edge — bound, don't equate
             ref_mass = detail.get("cfg1_matched", mass)
             assert abs(mass - ref_mass) <= 16, (mass, ref_mass)
-            # dispatch-only (device render cost; no 1MB grid readback)
+            # delivered-grid encoding (device-side pack, DensityScan.scala:95
+            # sparse-grid analogue) vs the raw 1MB f32 readback
+            pk = getattr(drun, "packed", lambda: None)()
+            detail["cfg4_density_pack"] = pk[0] if pk else "raw-f32"
+            if pk:
+                from geomesa_tpu.aggregates.grid_codec import packed_bytes
+                detail["cfg4_density_delivered_kb"] = round(
+                    packed_bytes(pk[0], pk[1], 512, 512) / 1024, 1)
+                lat_raw = _time_reps(lambda: np.asarray(drun.dispatch()),
+                                     max(5, reps // 2))
+                detail["cfg4_density_raw_f32_p50_ms"] = round(_p50(lat_raw), 2)
+            else:
+                detail["cfg4_density_delivered_kb"] = round(512 * 512 * 4 / 1024, 1)
+            # dispatch-only (device render cost; no grid readback)
             d0 = drun.dispatch()
             jax.block_until_ready(d0)
             t0 = time.perf_counter()

@@ -83,33 +83,87 @@ def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
     device_ok = (plan.device_exact and "xf" in idx.device.columns
                  and weight_on_device)
     if device_ok:
+        from geomesa_tpu.aggregates import grid_codec
+        from geomesa_tpu.config import DENSITY_PACK
         from geomesa_tpu.index import prune as _prune
 
         blocks = planner._pruned_blocks(plan)
         if blocks is not None and len(blocks) == 0:
             return run_empty  # provably-empty cover
+
+        state: dict = {}
+
+        def _stage_compact(cnt):
+            cap = next((t for t in _COMPACT_TIERS if cnt <= t),
+                       1 << max(0, (max(cnt, 1) - 1)).bit_length())
+            state["disp"] = idx.kernels.prepare_density_compact(
+                plan.primary_kind, plan.boxes_loose, plan.windows,
+                plan.residual_device, bbox, width, height, cap, weight_attr)
+            state["cap"] = cap
+
+        def _stage_pack(bound):
+            """Device-side readback encoding ladder (u8/sparse/fp16 → raw)
+            sized from a bound on the matched rows — nonzero cells can't
+            exceed it. Encodings that can't carry a result (cap overflow,
+            saturation) get popped at decode time."""
+            state["ladder"] = grid_codec.choose(
+                bound, height, width, DENSITY_PACK.get(),
+                unit_weights=weight_attr is None)
+            state["pack"] = _next_pack()
+
+        def _next_pack():
+            if state["ladder"]:
+                pmode, pcap = state["ladder"].pop(0)
+                return (pmode, pcap, grid_codec.pack_jit(pmode, pcap))
+            return None
+
         if blocks is not None:
-            disp0 = idx.kernels.prepare_density_blocks(
+            state["disp"] = idx.kernels.prepare_density_blocks(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device, bbox, width, height, blocks,
                 _prune.BLOCK_SIZE, weight_attr)
+            state["cap"] = None  # gather scan — no compaction to overflow
+            _stage_pack(len(blocks) * _prune.BLOCK_SIZE)
         else:
-            # size the compaction from an exact count (static data — the
-            # capacity can then never overflow)
             cnt = planner._count(plan, f, auths)
-            cap = next((t for t in _COMPACT_TIERS if cnt <= t),
-                       1 << max(0, (max(cnt, 1) - 1)).bit_length())
-            disp0 = idx.kernels.prepare_density_compact(
-                plan.primary_kind, plan.boxes_loose, plan.windows,
-                plan.residual_device, bbox, width, height, cap, weight_attr)
+            _stage_compact(cnt)
+            _stage_pack(cnt)
 
         def dispatch():
-            return disp0()[0]
+            return state["disp"]()[0]
 
         def run():
-            return DensityGrid(tuple(bbox), width, height,
-                               np.asarray(dispatch()))
+            for _ in range(6):
+                g, c = state["disp"]()
+                pack = state["pack"]
+                if pack is not None:
+                    pmode, pcap, fn = pack
+                    dec = grid_codec.decode(np.asarray(fn(g, c)), pmode,
+                                            pcap, height, width)
+                    if dec is None:
+                        # cap overflow / saturation / rounding drift: this
+                        # encoding can't carry the result — step down the
+                        # ladder (ultimately to raw f32)
+                        state["pack"] = _next_pack()
+                        weights, got = np.asarray(g), int(c)
+                    else:
+                        weights, got, _mass = dec
+                else:
+                    weights, got = np.asarray(g), int(c)
+                if state["cap"] is not None and got > state["cap"]:
+                    # the match count outgrew the compaction capacity (table
+                    # mutated since prepare): the scatter dropped rows —
+                    # restage with a bigger cap instead of returning a grid
+                    # that silently lost mass
+                    _stage_compact(got)
+                    if state["pack"] is not None:
+                        _stage_pack(got)
+                    continue
+                return DensityGrid(tuple(bbox), width, height, weights)
+            raise RuntimeError("density capacity kept overflowing under "
+                               "concurrent mutation; flush and retry")
         run.dispatch = dispatch
+        run.packed = lambda: state["pack"] and state["pack"][:2]
         return run
 
     def run_host():

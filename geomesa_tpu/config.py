@@ -101,6 +101,12 @@ JOIN_DEVICE_MIN_PAIRS = _register(
     "on the device band kernel (below it, host f64 soups win — each device "
     "dispatch pays the tunnel round trip).")
 
+DENSITY_PACK = _register(
+    "GEOMESA_TPU_DENSITY_PACK", "auto", str,
+    "Density grid readback encoding: auto (sparse when the match bound says "
+    "occupancy < ~1/3, else fp16), sparse, fp16, or none (raw f32 grid). "
+    "≙ the reference's sparse kryo density grids (DensityScan.scala:95).")
+
 BENCH_N = _register(
     "GEOMESA_TPU_BENCH_N", 100_000_000, int,
     "bench.py corpus size.")

@@ -47,9 +47,20 @@ def _read_long(buf: BinaryIO) -> int:
     return (acc >> 1) ^ -(acc & 1)
 
 
+def _read_exact(buf: BinaryIO, n: int) -> bytes:
+    """Read exactly n bytes — corrupt/negative lengths must fail loudly, not
+    consume the rest of the stream and mis-frame every later read."""
+    if n < 0:
+        raise ValueError(f"negative Avro length {n} (corrupt file)")
+    data = buf.read(n)
+    if len(data) != n:
+        raise ValueError(f"truncated Avro data: wanted {n} bytes, "
+                         f"got {len(data)}")
+    return data
+
+
 def _read_bytes(buf: BinaryIO) -> bytes:
-    n = _read_long(buf)
-    return buf.read(n)
+    return _read_exact(buf, _read_long(buf))
 
 
 def _read_value(buf: BinaryIO, schema):
@@ -85,18 +96,18 @@ def _read_value(buf: BinaryIO, schema):
                         buf, schema["values"])
             return out
         if t == "fixed":
-            return buf.read(schema["size"])
+            return _read_exact(buf, schema["size"])
         return _read_value(buf, t)  # annotated primitive (logicalType rides)
     if schema == "null":
         return None
     if schema == "boolean":
-        return buf.read(1) == b"\x01"
+        return _read_exact(buf, 1) == b"\x01"
     if schema in ("int", "long"):
         return _read_long(buf)
     if schema == "float":
-        return struct.unpack("<f", buf.read(4))[0]
+        return struct.unpack("<f", _read_exact(buf, 4))[0]
     if schema == "double":
-        return struct.unpack("<d", buf.read(8))[0]
+        return struct.unpack("<d", _read_exact(buf, 8))[0]
     if schema == "bytes":
         return _read_bytes(buf)
     if schema == "string":

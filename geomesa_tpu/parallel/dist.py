@@ -121,7 +121,10 @@ class DistributedScan:
                 "distributed knn needs a device-exact plan (host residuals "
                 "cannot refine a k-limited result)")
         rkey, rfn, boxes, windows, rparams = self._stage(plan)
-        m_cap = min(max(16, 1 << (max(0, k - 1)).bit_length()),
+        # ≥2k margin: f32 distance rounding can swap membership right at the
+        # k-th boundary, so over-fetch and re-rank the margin in f64 on host
+        # (same discipline as process/knn._exact_rerank)
+        m_cap = min(max(32, 1 << (max(0, 2 * k - 1)).bit_length()),
                     self.sharded.n_padded)
         key = ("knn", plan.primary_kind, plan.windows is not None, rkey, m_cap)
 
@@ -138,10 +141,18 @@ class DistributedScan:
         fn = self._fn(key, build)
         q = self.sharded.replicated(np.array([x, y], dtype=np.float32))
         dists, idxs = fn(self.sharded.columns, boxes, windows, rparams, q)
-        dists = np.asarray(dists)[:k]
-        idxs = np.asarray(idxs)[:k]
+        dists = np.asarray(dists)
+        idxs = np.asarray(idxs)
         valid = np.isfinite(dists)
-        return idxs[valid], dists[valid]
+        idxs, dists = idxs[valid], dists[valid]
+        if self.sharded.host_xy is not None and len(idxs):
+            from geomesa_tpu.process.geo import haversine_m
+            gx, gy = self.sharded.host_xy
+            d = haversine_m(gx[idxs].astype(np.float64),
+                            gy[idxs].astype(np.float64), x, y)
+            order = np.argsort(d, kind="stable")[:k]
+            return idxs[order], d[order]
+        return idxs[:k], dists[:k]
 
     def mask(self, plan) -> np.ndarray:
         """Full boolean mask gathered to host (hydration path)."""

@@ -41,6 +41,9 @@ class ShardedTable:
     n: int               # true row count (pre-padding)
     n_padded: int
     columns: Dict[str, jnp.ndarray]
+    # host refs to the unpadded coordinate columns, kept so k-limited
+    # reductions (knn) can re-rank their f32 margin exactly on host
+    host_xy: Optional[tuple] = None
 
     @classmethod
     def from_host_columns(cls, mesh: Mesh, host_cols: Dict[str, np.ndarray]) -> "ShardedTable":
@@ -49,6 +52,9 @@ class ShardedTable:
         n_padded = ((n + n_dev - 1) // n_dev) * n_dev
         sharding = NamedSharding(mesh, P("rows"))
         cols: Dict[str, jnp.ndarray] = {}
+        host_xy = None
+        if "xf" in host_cols and "yf" in host_cols:
+            host_xy = (np.asarray(host_cols["xf"]), np.asarray(host_cols["yf"]))
         for name, arr in host_cols.items():
             arr = np.asarray(arr)
             if n_padded != n:
@@ -59,7 +65,7 @@ class ShardedTable:
         valid = np.zeros(n_padded, dtype=bool)
         valid[:n] = True
         cols["__valid__"] = jax.device_put(valid, sharding)
-        return cls(mesh, n, n_padded, cols)
+        return cls(mesh, n, n_padded, cols, host_xy)
 
     def replicated(self, arr: np.ndarray) -> jnp.ndarray:
         """Place query constants replicated on every device."""

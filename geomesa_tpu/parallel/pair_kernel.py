@@ -287,7 +287,15 @@ def mesh_join_pairs(mesh, left: geo.GeometryArray, right: geo.GeometryArray,
     per_dev = np.zeros(n_dev, dtype=np.int64)
     for s in range(0, n, ch):
         e = min(n, s + ch)
-        n_pad = max(n_dev, ((e - s + n_dev - 1) // n_dev) * n_dev)
+        # pad to the FULL chunk width (multi-chunk) or a pow2 multiple of
+        # n_dev (single chunk): remainder-sized shapes would trigger a fresh
+        # XLA trace per distinct tail (-1 sentinels make the slop free), so
+        # chunks share compiled programs
+        if n > ch:
+            n_pad = ch
+        else:
+            m = (e - s + n_dev - 1) // n_dev
+            n_pad = n_dev * (1 << max(0, (m - 1).bit_length()))
         pl = np.full(n_pad, -1, dtype=np.int32)
         pr = np.zeros(n_pad, dtype=np.int32)
         pl[: e - s] = inv_l[s:e]

@@ -1,0 +1,174 @@
+"""Grid readback codec tests: sparse/fp16 round trips, overflow and
+saturation fallbacks, and the density path end-to-end under each encoding
+(≙ the reference's sparse kryo density grids, DensityScan.scala:95-106)."""
+
+import jax
+import numpy as np
+import pytest
+
+from geomesa_tpu.aggregates import grid_codec
+from geomesa_tpu.config import DENSITY_PACK
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+
+
+# -- codec unit tests --------------------------------------------------------
+
+
+def _pack(fn, *args):
+    return np.asarray(jax.jit(fn)(*args))
+
+
+def test_sparse_round_trip_exact():
+    rng = np.random.default_rng(3)
+    grid = np.zeros((16, 32), np.float32)
+    cells = rng.choice(16 * 32, 40, replace=False)
+    grid.reshape(-1)[cells] = rng.integers(1, 2000, 40).astype(np.float32)
+    packed = _pack(lambda g, c: grid_codec.pack_sparse(g, c, 64),
+                   grid, np.int32(40))
+    dec = grid_codec.decode(packed, "sparse", 64, 16, 32)
+    assert dec is not None
+    got, count, mass = dec
+    np.testing.assert_array_equal(got, grid)  # integer cells ≤2048: exact
+    assert count == 40
+    assert mass == pytest.approx(float(grid.sum()), rel=1e-6)
+
+
+def test_sparse_overflow_signals_refetch():
+    grid = np.ones((8, 8), np.float32)  # 64 nonzero > cap 32
+    packed = _pack(lambda g, c: grid_codec.pack_sparse(g, c, 32),
+                   grid, np.int32(64))
+    assert grid_codec.decode(packed, "sparse", 32, 8, 8) is None
+
+
+def test_fp16_round_trip_and_odd_cells():
+    rng = np.random.default_rng(7)
+    grid = rng.integers(0, 100, (7, 9)).astype(np.float32)  # odd cell count
+    packed = _pack(grid_codec.pack_fp16, grid, np.int32(17))
+    dec = grid_codec.decode(packed, "fp16", None, 7, 9)
+    assert dec is not None
+    got, count, _ = dec
+    np.testing.assert_array_equal(got, grid)
+    assert count == 17
+
+
+def test_fp16_saturation_signals_refetch():
+    grid = np.zeros((4, 4), np.float32)
+    grid[0, 0] = 1e9  # fp16 max is 65504 -> inf
+    packed = _pack(grid_codec.pack_fp16, grid, np.int32(1))
+    assert grid_codec.decode(packed, "fp16", None, 4, 4) is None
+
+
+def test_fp16_rounding_beyond_tolerance_signals_refetch():
+    # one huge non-integer weight: fp16 keeps ~11 mantissa bits, so the
+    # decoded mass drifts past MASS_RTOL and the decoder demands raw f32
+    grid = np.zeros((4, 4), np.float32)
+    grid[1, 1] = 40000.0
+    grid[2, 2] = 40100.5
+    packed = _pack(grid_codec.pack_fp16, grid, np.int32(2))
+    dec = grid_codec.decode(packed, "fp16", None, 4, 4)
+    if dec is not None:  # within band is fine too — then values must be close
+        got, _, _ = dec
+        assert abs(float(got.sum()) - 80100.5) <= 0.002 * 80100.5
+
+
+def test_u8_round_trip_and_saturation():
+    rng = np.random.default_rng(5)
+    grid = rng.integers(0, 255, (16, 17)).astype(np.float32)  # hw % 4 != 0
+    packed = _pack(grid_codec.pack_u8, grid, np.int32(9))
+    dec = grid_codec.decode(packed, "u8", None, 16, 17)
+    assert dec is not None
+    got, count, _ = dec
+    np.testing.assert_array_equal(got, grid)
+    assert count == 9
+    # a cell past 255 saturates -> mass guard demands a denser encoding
+    grid[3, 3] = 90000.0
+    packed = _pack(grid_codec.pack_u8, grid, np.int32(9))
+    assert grid_codec.decode(packed, "u8", None, 16, 17) is None
+
+
+def test_u8_small_hotspot_rejected_despite_mass_guard():
+    # a clipped hotspot tiny relative to the global mass slips the MASS_RTOL
+    # check — the per-cell peak in the header must reject it anyway
+    grid = np.full((64, 64), 200.0, np.float32)   # mass ~819k
+    grid[10, 10] = 500.0                          # clip error 245 << 2e-3*mass
+    packed = _pack(grid_codec.pack_u8, grid, np.int32(0))
+    assert grid_codec.decode(packed, "u8", None, 64, 64) is None
+
+
+def test_choose_ladder():
+    # tiny match bound on a big grid -> sparse first, with pow2 cap
+    ladder = grid_codec.choose(100, 512, 512)
+    assert ladder[0] == ("sparse", 128)
+    # bound ~ grid size, weighted -> fp16 dense only
+    assert grid_codec.choose(512 * 512, 512, 512)[0] == ("fp16", None)
+    # unit weights admit u8 (1 byte/cell) ahead of fp16
+    ladder = grid_codec.choose(512 * 512, 512, 512, unit_weights=True)
+    assert ladder[0] == ("u8", None)
+    assert ("fp16", None) in ladder
+    assert grid_codec.choose(10, 64, 64, "none") == []
+    assert grid_codec.choose(10 ** 9, 64, 64, "sparse")[0][0] == "sparse"
+    # wire-cost ordering: sparse@crossover < u8 < fp16 < raw f32
+    assert grid_codec.packed_bytes("sparse", 128, 512, 512) \
+        < grid_codec.packed_bytes("u8", None, 512, 512) \
+        < grid_codec.packed_bytes("fp16", None, 512, 512) \
+        < 512 * 512 * 4
+
+
+# -- density end-to-end under each encoding ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(11)
+    n = 20000
+    base = np.datetime64("2022-01-01T00:00:00", "ms").astype(np.int64)
+    ds = TpuDataStore()
+    ds.create_schema("pk", "w:Double,dtg:Date,*geom:Point")
+    ds.load("pk", FeatureTable.build(ds.get_schema("pk"), {
+        "w": rng.uniform(0.5, 2.0, n),
+        "dtg": base + rng.integers(0, 7 * 86400000, n),
+        "geom": (rng.uniform(-90, 90, n), rng.uniform(-45, 45, n))}))
+    return ds
+
+
+@pytest.mark.parametrize("mode", ["none", "sparse", "fp16", "auto"])
+def test_density_same_grid_under_every_encoding(store, mode):
+    from geomesa_tpu.aggregates.density import prepare_density
+    planner = store.planner("pk")
+    DENSITY_PACK.set(mode)
+    try:
+        run = prepare_density(planner, "BBOX(geom, -50, -20, 50, 30)",
+                              (-50, -20, 50, 30), 32, 16)
+        got = run().weights
+    finally:
+        DENSITY_PACK.unset()
+    DENSITY_PACK.set("none")
+    try:
+        ref = prepare_density(planner, "BBOX(geom, -50, -20, 50, 30)",
+                              (-50, -20, 50, 30), 32, 16)().weights
+    finally:
+        DENSITY_PACK.unset()
+    np.testing.assert_array_equal(got, ref)  # unit counts ≤2048/cell: exact
+
+
+def test_density_weighted_fp16_stays_within_band(store):
+    from geomesa_tpu.aggregates.density import prepare_density
+    planner = store.planner("pk")
+    DENSITY_PACK.set("fp16")
+    try:
+        got = prepare_density(planner, "INCLUDE", (-90, -45, 90, 45),
+                              16, 8, weight_attr="w")().weights
+    finally:
+        DENSITY_PACK.unset()
+    DENSITY_PACK.set("none")
+    try:
+        ref = prepare_density(planner, "INCLUDE", (-90, -45, 90, 45),
+                              16, 8, weight_attr="w")().weights
+    finally:
+        DENSITY_PACK.unset()
+    # fp16 per-cell relative error ~2^-11; the decoder's mass guard would
+    # have forced raw f32 had the total drifted further
+    np.testing.assert_allclose(got, ref, rtol=2e-3)
+    assert float(got.sum(dtype=np.float64)) == pytest.approx(
+        float(ref.sum(dtype=np.float64)), rel=2e-3)

@@ -3,6 +3,7 @@ rebuild); count/query stay exact across the main/delta boundary; the delta
 flushes into the device index past the threshold (≙ the Lambda store's hot
 tier shadowing the cold tier, LambdaDataStore.scala:180)."""
 
+import os
 import time
 
 import numpy as np
@@ -55,7 +56,9 @@ def test_delta_append_is_cheap_and_exact():
                                     {"v": v2, "dtg": dtg2, "geom": (x2, y2)}))
     append_s = time.perf_counter() - t0
     assert ds.deltas["t"] is not None, "append did not take the delta path"
-    assert append_s < 0.25 * rebuild_s, (append_s, rebuild_s)
+    # wall-clock ratio flakes on loaded hosts — gate like the other perf pins
+    if os.environ.get("GEOMESA_TPU_SKIP_PERF") != "1":
+        assert append_s < 0.25 * rebuild_s, (append_s, rebuild_s)
 
     assert ds.count("t", Q) == _ref_count([main, (x2, y2, dtg2, v2)])
     r = ds.query("t", Q)
