@@ -159,6 +159,12 @@ def choose(count_bound: int, height: int, width: int, mode: str = "auto",
     ≤255/cell)."""
     if mode == "none":
         return []
+    if mode not in ("auto", "sparse", "fp16", "u8"):
+        mode = "auto"  # malformed knob values fall back (reference behavior)
+    if mode == "u8" and not unit_weights:
+        # u8 per-cell rounding of fractional weights can cancel in the mass
+        # guard while individual cells are off by up to 0.5 — not faithful
+        mode = "fp16"
     hw = height * width
     nnzb = max(1, min(int(count_bound), hw))
     cap = 1 << max(5, (nnzb - 1).bit_length())
@@ -167,6 +173,10 @@ def choose(count_bound: int, height: int, width: int, mode: str = "auto",
     ladder = [("sparse", cap), ("fp16", None)]
     if unit_weights:
         ladder.insert(0, ("u8", None))
+    # an encoding that ships more bytes than the raw f32 grid (sparse cap at
+    # high occupancy) is strictly worse than falling straight to raw
+    ladder = [mc for mc in ladder
+              if packed_bytes(mc[0], mc[1], height, width) < 4 * hw]
     ladder.sort(key=lambda mc: packed_bytes(mc[0], mc[1], height, width))
     return ladder
 

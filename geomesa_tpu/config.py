@@ -103,9 +103,10 @@ JOIN_DEVICE_MIN_PAIRS = _register(
 
 DENSITY_PACK = _register(
     "GEOMESA_TPU_DENSITY_PACK", "auto", str,
-    "Density grid readback encoding: auto (sparse when the match bound says "
-    "occupancy < ~1/3, else fp16), sparse, fp16, or none (raw f32 grid). "
-    "≙ the reference's sparse kryo density grids (DensityScan.scala:95).")
+    "Density grid readback encoding: auto (cheapest faithful of sparse/u8/"
+    "fp16 by wire size), sparse, u8 (unweighted only), fp16, or none (raw "
+    "f32 grid). Unknown values fall back to auto. ≙ the reference's sparse "
+    "kryo density grids (DensityScan.scala:95).")
 
 BENCH_N = _register(
     "GEOMESA_TPU_BENCH_N", 100_000_000, int,

@@ -52,33 +52,57 @@ def merge_ranges(ranges: List[IndexRange]) -> List[IndexRange]:
     return out
 
 
-def _zranges(
+_EMPTY_COVER = (np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, bool))
+
+
+def merge_range_arrays(lo: np.ndarray, hi: np.ndarray, cont: np.ndarray):
+    """Vectorized sort+merge of inclusive (lo, hi, contained) range arrays
+    (same rule as ``merge_ranges``; arrays in, arrays out — no per-range
+    Python objects on the query-planning hot path)."""
+    if len(lo) == 0:
+        return _EMPTY_COVER
+    order = np.lexsort((hi, lo))
+    lo, hi, cont = lo[order], hi[order], cont[order]
+    cmax = np.maximum.accumulate(hi)
+    new = np.empty(len(lo), bool)
+    new[0] = True
+    np.greater(lo[1:], cmax[:-1] + 1, out=new[1:])
+    starts = np.flatnonzero(new)
+    return (lo[starts], np.maximum.reduceat(hi, starts),
+            np.logical_and.reduceat(cont, starts))
+
+
+def _zranges_arrays(
     boxes: Sequence[Sequence[Tuple[int, int]]],
     bits: int,
     dims: int,
     max_ranges: int,
     max_levels: int,
-) -> List[IndexRange]:
-    """Generic D-dimensional Morton cover.
+):
+    """Generic D-dimensional Morton cover → merged (lo, hi, contained)
+    inclusive z-interval arrays covering the union of boxes.
 
-    boxes: per-box, per-dim inclusive int bounds [(lo, hi), ...] in normalized
-    int space. Returns merged inclusive z ranges covering the union of boxes.
-
-    Level-synchronous vectorized BFS: each tree level classifies every live
-    cell against every box in one numpy pass (the scalar per-cell recursion of
-    sfcurve costs 10s of ms at the 2000-range target; this runs in ~1ms, which
-    matters because the cover sits on the query planning path for range-pruned
-    scans). Budget rule mirrors sfcurve's maxRanges stop: when expanding the
-    next level would exceed the budget, remaining overlapping cells flush as
-    coarse (uncontained) ranges.
+    boxes: per-box, per-dim inclusive int bounds [(lo, hi), ...] in
+    normalized int space. The native C++ pass (gm_zranges) runs when
+    available (~50us — the cover sits on the cold-query planning path);
+    the fallback is a level-synchronous vectorized numpy BFS. Budget rule
+    mirrors sfcurve's maxRanges stop: when expanding the next level would
+    exceed the budget, remaining overlapping cells flush as coarse
+    (uncontained) ranges.
     """
     if not boxes:
-        return []
+        return _EMPTY_COVER
     interleave = {2: zorder.z2_encode, 3: zorder.z3_encode}[dims]
     max_levels = min(max_levels, bits)
 
     blo = np.array([[d[0] for d in b] for b in boxes], dtype=np.int64)  # (B,D)
     bhi = np.array([[d[1] for d in b] for b in boxes], dtype=np.int64)
+
+    from geomesa_tpu import native
+    res = native.zranges(blo, bhi, dims, bits, max_ranges, max_levels)
+    if res is not None:
+        return res
 
     child_bits = np.array(
         [[(c >> d) & 1 for d in range(dims)] for c in range(1 << dims)],
@@ -123,12 +147,25 @@ def _zranges(
         level += 1
 
     if not out_lo:
-        return []
-    lo = np.concatenate(out_lo)
-    hi = np.concatenate(out_hi)
-    cont = np.concatenate(out_cont)
-    return merge_ranges([IndexRange(int(l), int(h), bool(c))
-                         for l, h, c in zip(lo, hi, cont)])
+        return _EMPTY_COVER
+    return merge_range_arrays(np.concatenate(out_lo), np.concatenate(out_hi),
+                              np.concatenate(out_cont))
+
+
+def to_ranges(arrays) -> List[IndexRange]:
+    """(lo, hi, contained) arrays → IndexRange list (the object-form API)."""
+    lo, hi, cont = arrays
+    return [IndexRange(int(l), int(h), bool(c))
+            for l, h, c in zip(lo, hi, cont)]
+
+
+def _reshape_2d(boxes):
+    return [((xlo, xhi), (ylo, yhi)) for xlo, ylo, xhi, yhi in boxes]
+
+
+def _reshape_3d(boxes):
+    return [((xlo, xhi), (ylo, yhi), (tlo, thi))
+            for xlo, ylo, tlo, xhi, yhi, thi in boxes]
 
 
 def zranges_2d(
@@ -138,8 +175,7 @@ def zranges_2d(
     max_levels: int = 64,
 ) -> List[IndexRange]:
     """2-D cover. boxes = (xlo, ylo, xhi, yhi) inclusive normalized ints."""
-    reshaped = [((xlo, xhi), (ylo, yhi)) for xlo, ylo, xhi, yhi in boxes]
-    return _zranges(reshaped, bits, 2, max_ranges, max_levels)
+    return to_ranges(zranges_2d_arrays(boxes, bits, max_ranges, max_levels))
 
 
 def zranges_3d(
@@ -149,5 +185,17 @@ def zranges_3d(
     max_levels: int = 64,
 ) -> List[IndexRange]:
     """3-D cover. boxes = (xlo, ylo, tlo, xhi, yhi, thi) inclusive ints."""
-    reshaped = [((xlo, xhi), (ylo, yhi), (tlo, thi)) for xlo, ylo, tlo, xhi, yhi, thi in boxes]
-    return _zranges(reshaped, bits, 3, max_ranges, max_levels)
+    return to_ranges(zranges_3d_arrays(boxes, bits, max_ranges, max_levels))
+
+
+def zranges_2d_arrays(boxes, bits: int = 31, max_ranges: int = 2000,
+                      max_levels: int = 64):
+    """Array-form 2-D cover: merged (lo, hi, contained) — the hot-path form
+    consumed directly by prune.ranges_to_slices."""
+    return _zranges_arrays(_reshape_2d(boxes), bits, 2, max_ranges, max_levels)
+
+
+def zranges_3d_arrays(boxes, bits: int = 21, max_ranges: int = 2000,
+                      max_levels: int = 64):
+    """Array-form 3-D cover: merged (lo, hi, contained)."""
+    return _zranges_arrays(_reshape_3d(boxes), bits, 3, max_ranges, max_levels)

@@ -14,7 +14,8 @@ import numpy as np
 from geomesa_tpu.curves import zorder
 from geomesa_tpu.curves.binnedtime import TimePeriod, max_offset
 from geomesa_tpu.curves.normalize import NormalizedLat, NormalizedLon, NormalizedTime
-from geomesa_tpu.curves.ranges import IndexRange, zranges_2d, zranges_3d
+from geomesa_tpu.curves.ranges import (IndexRange, to_ranges,
+                                       zranges_2d_arrays, zranges_3d_arrays)
 
 
 class Z2SFC:
@@ -57,12 +58,19 @@ class Z2SFC:
         max_levels: int = 64,
     ) -> List[IndexRange]:
         """Cover (xmin, ymin, xmax, ymax) user-space boxes with z ranges."""
+        return to_ranges(self.ranges_arrays(xy, max_ranges, max_levels))
+
+    def ranges_arrays(self, xy, max_ranges: Optional[int] = None,
+                      max_levels: int = 64):
+        """Array-form cover (lo, hi, contained) — the query-planning hot
+        path (feeds prune.ranges_to_slices without per-range objects)."""
         boxes = []
         for xmin, ymin, xmax, ymax in xy:
             xlo, ylo = self.normalize(xmin, ymin)
             xhi, yhi = self.normalize(xmax, ymax)
             boxes.append((int(xlo), int(ylo), int(xhi), int(yhi)))
-        return zranges_2d(boxes, self.precision, max_ranges or 2000, max_levels)
+        return zranges_2d_arrays(boxes, self.precision, max_ranges or 2000,
+                                 max_levels)
 
 
 class Z3SFC:
@@ -134,11 +142,19 @@ class Z3SFC:
         max_levels: int = 64,
     ) -> List[IndexRange]:
         """Cover the cross product of lon/lat boxes and in-bin time windows."""
+        return to_ranges(self.ranges_arrays(xy, t, max_ranges, max_levels))
+
+    def ranges_arrays(self, xy, t, max_ranges: Optional[int] = None,
+                      max_levels: int = 64):
+        """Array-form cover (lo, hi, contained) — the query-planning hot
+        path (feeds prune.ranges_to_slices without per-range objects)."""
         boxes = []
         for xmin, ymin, xmax, ymax in xy:
             xlo, ylo = self.lon.normalize(xmin), self.lat.normalize(ymin)
             xhi, yhi = self.lon.normalize(xmax), self.lat.normalize(ymax)
             for tmin, tmax in t:
                 tlo, thi = self.time.normalize(tmin), self.time.normalize(tmax)
-                boxes.append((int(xlo), int(ylo), int(tlo), int(xhi), int(yhi), int(thi)))
-        return zranges_3d(boxes, self.precision, max_ranges or 2000, max_levels)
+                boxes.append((int(xlo), int(ylo), int(tlo),
+                              int(xhi), int(yhi), int(thi)))
+        return zranges_3d_arrays(boxes, self.precision, max_ranges or 2000,
+                                 max_levels)

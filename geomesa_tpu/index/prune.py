@@ -54,19 +54,28 @@ MAX_BINS = 512
 
 
 def ranges_to_slices(sorted_keys: np.ndarray,
-                     ranges: Sequence[IndexRange],
+                     ranges,
                      base: int = 0,
                      lo: int = 0,
                      hi: Optional[int] = None) -> np.ndarray:
     """Inclusive key ranges → [lo, hi) row slices via binary search over one
-    contiguous segment of a sorted key array. Returns (S, 2) int64."""
+    contiguous segment of a sorted key array. Returns (S, 2) int64.
+
+    ``ranges``: a Sequence[IndexRange], or the array form — a (lo, hi, ...)
+    tuple of int64 arrays (the hot path: sfc.ranges_arrays feeds this with
+    no per-range Python objects)."""
     if hi is None:
         hi = len(sorted_keys)
-    if not ranges or lo >= hi:
+    if isinstance(ranges, tuple):
+        lowers, uppers = ranges[0], ranges[1]
+    elif ranges:
+        lowers = np.fromiter((r.lower for r in ranges), np.int64, len(ranges))
+        uppers = np.fromiter((r.upper for r in ranges), np.int64, len(ranges))
+    else:
+        lowers = uppers = np.empty(0, np.int64)
+    if len(lowers) == 0 or lo >= hi:
         return np.empty((0, 2), dtype=np.int64)
     seg = sorted_keys[lo:hi]
-    lowers = np.fromiter((r.lower for r in ranges), np.int64, len(ranges))
-    uppers = np.fromiter((r.upper for r in ranges), np.int64, len(ranges))
     starts = np.searchsorted(seg, lowers, side="left") + lo + base
     stops = np.searchsorted(seg, uppers, side="right") + lo + base
     keep = stops > starts

@@ -678,7 +678,8 @@ class Z3Index(BaseSpatialIndex):
         from geomesa_tpu.index.prune import MAX_RANGES
         return self._binned_row_slices(
             boxes, intervals, self.sorted_z,
-            lambda bx, w: self._sfc.ranges(bx, [w], max_ranges=MAX_RANGES))
+            lambda bx, w: self._sfc.ranges_arrays(bx, [w],
+                                                  max_ranges=MAX_RANGES))
 
 
 class Z2Index(BaseSpatialIndex):
@@ -718,7 +719,7 @@ class Z2Index(BaseSpatialIndex):
 
     def _row_slices(self, boxes, intervals):
         from geomesa_tpu.index.prune import MAX_RANGES, ranges_to_slices
-        rs = Z2SFC().ranges(boxes, max_ranges=MAX_RANGES)
+        rs = Z2SFC().ranges_arrays(boxes, max_ranges=MAX_RANGES)
         return ranges_to_slices(self.sorted_z, rs)
 
 

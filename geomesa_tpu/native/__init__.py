@@ -113,6 +113,11 @@ def _load():
             lib.gm_fp62.argtypes = [
                 f64, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
                 i32, i32, ctypes.c_int32]
+            u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            lib.gm_zranges.argtypes = [
+                i64, i64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int32, i64, i64, u8, ctypes.c_int64]
+            lib.gm_zranges.restype = ctypes.c_int64
             _lib = lib
         except Exception:
             _load_failed = True
@@ -177,6 +182,27 @@ def z2_encode(x: np.ndarray, y: np.ndarray):
                      out["xf"], out["yf"], out["zhi"], out["zlo"], out["z"],
                      _nthreads())
     return out
+
+
+def zranges(blo: np.ndarray, bhi: np.ndarray, dims: int, bits: int,
+            max_ranges: int, max_levels: int):
+    """Morton range cover (≙ sfcurve zranges on the query-planning path).
+    (lo, hi, contained) merged inclusive z-interval arrays, or None for the
+    Python fallback. blo/bhi: (n_boxes, dims) inclusive normalized ints."""
+    lib = _load()
+    if lib is None:
+        return None
+    blo = np.ascontiguousarray(blo, dtype=np.int64)
+    bhi = np.ascontiguousarray(bhi, dtype=np.int64)
+    cap = 2 * int(max_ranges) + 4 * (1 << dims)
+    lo = np.empty(cap, np.int64)
+    hi = np.empty(cap, np.int64)
+    cont = np.empty(cap, np.uint8)
+    n = lib.gm_zranges(blo, bhi, blo.shape[0], dims, bits, int(max_ranges),
+                       int(max_levels), lo, hi, cont, cap)
+    if n < 0:
+        return None
+    return lo[:n], hi[:n], cont[:n].astype(bool)
 
 
 def fp62_planes(x: np.ndarray, lo: float, hi: float):

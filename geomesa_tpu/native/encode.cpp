@@ -196,4 +196,108 @@ void gm_fp62(const double* x, int64_t n, double lo, double hi, int32_t* phi,
   });
 }
 
+// Morton range cover (the query-planning hot loop).
+//
+// ≙ sfcurve Z2.zranges / Z3.zranges as used by Z3IndexKeySpace.getRanges
+// (Z3IndexKeySpace.scala:162-189) — the JVM runs this in single-digit ms and
+// it sits on the cold-query path, so the Python BFS (~5ms/cover) moves here
+// (~50us). Semantics mirror curves/ranges.py _zranges exactly (parity pinned
+// by tests/test_native.py): level-synchronous BFS over the quad/octree,
+// contained cells emit tight ranges, the budget/depth stop flushes the live
+// frontier as coarse ranges, then sort + adjacent-merge.
+//
+// blo/bhi: (n_boxes, dims) row-major inclusive int bounds. Returns the
+// merged range count written to out_lo/out_hi/out_cont, or -1 if it would
+// exceed cap (caller falls back to the Python path).
+int64_t gm_zranges(const int64_t* blo, const int64_t* bhi, int64_t n_boxes,
+                   int32_t dims, int32_t bits, int64_t max_ranges,
+                   int32_t max_levels, int64_t* out_lo, int64_t* out_hi,
+                   uint8_t* out_cont, int64_t cap) {
+  if (n_boxes == 0) return 0;
+  struct ZRange { int64_t lo, hi; uint8_t cont; };
+  struct Cell { int64_t c[3]; };
+  const int fan = 1 << dims;
+  if (max_levels > bits) max_levels = bits;
+
+  std::vector<Cell> cells(1, Cell{{0, 0, 0}});
+  std::vector<Cell> live, next;
+  std::vector<ZRange> out;
+  out.reserve((size_t)std::min<int64_t>(max_ranges + fan, 1 << 20));
+
+  auto emit = [&](const Cell& c, int shift, bool cont) {
+    uint64_t z;
+    if (dims == 2) {
+      z = spread2((uint64_t)(c.c[0] << shift))
+          | (spread2((uint64_t)(c.c[1] << shift)) << 1);
+    } else {
+      z = spread3((uint64_t)(c.c[0] << shift))
+          | (spread3((uint64_t)(c.c[1] << shift)) << 1)
+          | (spread3((uint64_t)(c.c[2] << shift)) << 2);
+    }
+    uint64_t span = (shift ? (((uint64_t)1 << (dims * shift)) - 1) : 0);
+    out.push_back(ZRange{(int64_t)z, (int64_t)(z + span), (uint8_t)cont});
+  };
+
+  int level = 0;
+  int64_t emitted = 0;
+  while (!cells.empty()) {
+    const int shift = bits - level;
+    live.clear();
+    for (const Cell& c : cells) {
+      bool inside = false, touches = false;
+      for (int64_t b = 0; b < n_boxes; ++b) {
+        bool ins = true, tch = true;
+        for (int d = 0; d < dims; ++d) {
+          const int64_t clo = c.c[d] << shift;
+          const int64_t chi = ((c.c[d] + 1) << shift) - 1;
+          const int64_t lo = blo[b * dims + d], hi = bhi[b * dims + d];
+          ins &= (lo <= clo) & (chi <= hi);
+          tch &= (chi >= lo) & (clo <= hi);
+        }
+        touches |= tch;
+        if (ins) { inside = true; break; }
+      }
+      if (inside) { emit(c, shift, true); ++emitted; }
+      else if (touches) live.push_back(c);
+    }
+    if (live.empty()) break;
+    if (level >= max_levels
+        || emitted + (int64_t)live.size() * fan > max_ranges) {
+      for (const Cell& c : live) emit(c, shift, false);
+      break;
+    }
+    next.clear();
+    next.reserve(live.size() * fan);
+    for (const Cell& c : live) {
+      for (int ch = 0; ch < fan; ++ch) {
+        Cell nc{{0, 0, 0}};
+        for (int d = 0; d < dims; ++d)
+          nc.c[d] = (c.c[d] << 1) | ((ch >> d) & 1);
+        next.push_back(nc);
+      }
+    }
+    cells.swap(next);
+    ++level;
+  }
+
+  std::sort(out.begin(), out.end(), [](const ZRange& a, const ZRange& b) {
+    return a.lo < b.lo || (a.lo == b.lo && a.hi < b.hi);
+  });
+  int64_t m = 0;
+  for (const ZRange& r : out) {
+    // hi can be INT64_MAX (root emit): guard the +1 against overflow
+    if (m && (out_hi[m - 1] == INT64_MAX || r.lo <= out_hi[m - 1] + 1)) {
+      if (r.hi > out_hi[m - 1]) out_hi[m - 1] = r.hi;
+      out_cont[m - 1] = out_cont[m - 1] && r.cont;
+    } else {
+      if (m == cap) return -1;
+      out_lo[m] = r.lo;
+      out_hi[m] = r.hi;
+      out_cont[m] = r.cont;
+      ++m;
+    }
+  }
+  return m;
+}
+
 }  // extern "C"

@@ -151,7 +151,8 @@ class DistributedScan:
             d = haversine_m(gx[idxs].astype(np.float64),
                             gy[idxs].astype(np.float64), x, y)
             order = np.argsort(d, kind="stable")[:k]
-            return idxs[order], d[order]
+            # rank in f64, deliver f32 (the documented contract either path)
+            return idxs[order], d[order].astype(np.float32)
         return idxs[:k], dists[:k]
 
     def mask(self, plan) -> np.ndarray:

@@ -90,3 +90,38 @@ def test_bin_overflow_falls_back():
     assert native.z3_encode(x[:4], y[:4], np.full(4, -1, np.int64), "day") is None
     # week bins reach much further; 2060 is fine there
     assert native.z3_encode(x[:4], y[:4], np.full(4, far), "week") is not None
+
+
+def test_zranges_parity_with_python_bfs():
+    """Native gm_zranges must be bit-identical to the numpy BFS cover
+    (same budget rule, same emit, same merge)."""
+    import geomesa_tpu.native as N
+    from geomesa_tpu import config
+    from geomesa_tpu.curves import ranges as R
+
+    rng = np.random.default_rng(7)
+    for trial in range(60):
+        dims = 2 if trial % 2 else 3
+        bits = 31 if dims == 2 else 21
+        boxes = []
+        for _ in range(int(rng.integers(1, 4))):
+            b = []
+            for _d in range(dims):
+                lo = int(rng.integers(0, (1 << bits) - 1))
+                hi = int(rng.integers(lo, min((1 << bits) - 1,
+                                              lo + (1 << rng.integers(5, bits)))))
+                b.append((lo, hi))
+            boxes.append(b)
+        mr = int(rng.choice([50, 500, 2000]))
+        nat = R._zranges_arrays(boxes, bits, dims, mr, 64)
+        config.NO_NATIVE.set(True)
+        N._lib, N._load_failed = None, False
+        try:
+            py = R._zranges_arrays(boxes, bits, dims, mr, 64)
+        finally:
+            config.NO_NATIVE.unset()
+            N._lib, N._load_failed = None, False
+        for a, b2, name in zip(nat, py, ("lo", "hi", "cont")):
+            assert np.array_equal(a, b2), (trial, name)
+        # the budget rule really bounds output
+        assert len(nat[0]) <= 2 * mr
