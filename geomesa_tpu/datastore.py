@@ -87,9 +87,23 @@ class FeatureWriter:
 
 
 class TpuDataStore:
-    """In-process TPU-backed datastore."""
+    """In-process TPU-backed datastore.
+
+    Concurrency model (≙ the reference's immutable-plans + concurrent-store
+    discipline, SURVEY.md §5): mutators (_append/flush/update_*/remove_*)
+    serialize on a store-wide writer lock and follow build-then-swap — new
+    tables/planners are constructed fully before any shared reference is
+    reassigned, and existing FeatureTable/QueryPlanner objects are never
+    mutated in place. Readers never take the lock for query execution; they
+    grab one consistent (planner, delta) snapshot via ``_snapshot`` (a brief
+    lock acquire, so a mid-flush reader can't pair a pre-flush planner with
+    a post-flush delta and under/double-count) and then work purely on the
+    captured objects. Exercised by tests/test_web.py's concurrent
+    ingest+query stress test through the REST server's thread pool."""
 
     def __init__(self, params: Optional[dict] = None):
+        import threading
+        self._lock = threading.RLock()
         self.params = params or {}
         self.schemas: Dict[str, SimpleFeatureType] = {}
         self.tables: Dict[str, FeatureTable] = {}
@@ -127,10 +141,11 @@ class TpuDataStore:
                       spec: Optional[str] = None) -> SimpleFeatureType:
         if isinstance(sft, str):
             sft = SimpleFeatureType.from_spec(sft, spec or "")
-        if sft.name in self.schemas:
-            raise ValueError(f"Schema {sft.name} already exists")
-        self.schemas[sft.name] = sft
-        self.tables[sft.name] = None
+        with self._lock:
+            if sft.name in self.schemas:
+                raise ValueError(f"Schema {sft.name} already exists")
+            self.schemas[sft.name] = sft
+            self.tables[sft.name] = None
         return sft
 
     def get_schema(self, type_name: str) -> SimpleFeatureType:
@@ -140,9 +155,10 @@ class TpuDataStore:
         return list(self.schemas)
 
     def remove_schema(self, type_name: str) -> None:
-        for d in (self.schemas, self.tables, self.planners, self._stats,
-                  self.deltas):
-            d.pop(type_name, None)
+        with self._lock:
+            for d in (self.schemas, self.tables, self.planners, self._stats,
+                      self.deltas):
+                d.pop(type_name, None)
 
     # -- writes -------------------------------------------------------------
 
@@ -164,6 +180,10 @@ class TpuDataStore:
         delta run (cost ~ O(batch), not O(table)); the main device index
         rebuilds only on the first load or when the delta crosses the flush
         threshold. Queries merge main + delta exactly (see count/query)."""
+        with self._lock:
+            self._append_locked(type_name, batch, stats_cached)
+
+    def _append_locked(self, type_name, batch, stats_cached=None) -> None:
         from geomesa_tpu.metrics import REGISTRY as _metrics
         _metrics.inc("ingest.features", len(batch))
         current = self.tables.get(type_name)
@@ -198,23 +218,35 @@ class TpuDataStore:
     def flush(self, type_name: str) -> None:
         """Merge the delta run into the main device index (≙ the Lambda
         tier's persistence flush). No-op when the delta is empty."""
-        delta = self.deltas.get(type_name)
-        if delta is None:
-            return
-        self.deltas[type_name] = None
-        self.tables[type_name] = FeatureTable.concat(
-            [self.tables[type_name], delta])
-        self._rebuild_indexes(type_name)
+        with self._lock:
+            delta = self.deltas.get(type_name)
+            if delta is None:
+                return
+            self.deltas[type_name] = None
+            self.tables[type_name] = FeatureTable.concat(
+                [self.tables[type_name], delta])
+            self._rebuild_indexes(type_name)
 
-    def _delta_rows(self, type_name: str, f, auths) -> "np.ndarray":
-        """Matching row indices WITHIN the delta run (host f64 evaluation —
-        the delta is bounded small, so brute force is exact and cheap)."""
+    def _snapshot(self, type_name: str):
+        """One consistent (planner, delta) pair. The brief lock acquire is
+        the whole reader-side protocol: both refs are captured atomically
+        w.r.t. flush/append swaps, then the query runs lock-free on the
+        captured (immutable) objects."""
+        with self._lock:
+            return self._main_planner(type_name), self.deltas.get(type_name)
+
+    def _delta_rows(self, delta: Optional[FeatureTable], f,
+                    auths) -> "np.ndarray":
+        """Matching row indices WITHIN a snapshotted delta run (host f64
+        evaluation — the delta is bounded small, so brute force is exact and
+        cheap). Takes the delta table itself, not the type name: readers must
+        evaluate the SAME delta object their snapshot captured, never a
+        re-read that a concurrent flush could have swapped."""
         import numpy as np
 
         from geomesa_tpu.filter.evaluate import evaluate as _evaluate
         from geomesa_tpu.filter.parser import parse_ecql
 
-        delta = self.deltas.get(type_name)
         if delta is None:
             return np.empty(0, dtype=np.int64)
         fir = parse_ecql(f) if isinstance(f, str) else f
@@ -248,7 +280,10 @@ class TpuDataStore:
         for attr in indexed_attributes(sft):
             indexes.append(AttributeIndex(sft, table, attr))
         indexes.append(FullScanIndex(sft, table))
-        stats = self._stats.get(type_name) or GeoMesaStats(sft)
+        # fresh battery per rebuild (true build-then-swap): re-observing into
+        # the SHARED GeoMesaStats would let a lock-free reader's snapshotted
+        # planner see a half-populated sketch battery mid-rebuild
+        stats = GeoMesaStats(sft)
         timeout = sft.user_data.get("geomesa.query.timeout")
         planner = QueryPlanner(
             sft, table, indexes, stats=stats,
@@ -264,9 +299,10 @@ class TpuDataStore:
         self.planners[type_name] = planner
 
     def _fid_counter(self, type_name: str) -> int:
-        c = self._counters.get(type_name, 0)
-        self._counters[type_name] = c + 1
-        return c
+        with self._lock:  # read-modify-write: two writers must never share a fid
+            c = self._counters.get(type_name, 0)
+            self._counters[type_name] = c + 1
+            return c
 
     # -- queries ------------------------------------------------------------
 
@@ -275,8 +311,9 @@ class TpuDataStore:
         delta run flushes first, so external consumers (processes, exports,
         aggregation helpers) always see exact state. Datastore-level
         count/query merge the delta inline instead and never force a flush."""
-        self.flush(type_name)
-        return self._main_planner(type_name)
+        with self._lock:
+            self.flush(type_name)
+            return self._main_planner(type_name)
 
     def _main_planner(self, type_name: str) -> QueryPlanner:
         if type_name not in self.planners:
@@ -306,12 +343,11 @@ class TpuDataStore:
           hints["crs"]       = "EPSG:3857"                (output reprojection)
         """
         if not hints:
-            planner = self._main_planner(type_name)
+            planner, delta = self._snapshot(type_name)
             res = planner.query(f, auths=auths)
-            delta = self.deltas.get(type_name)
             if delta is None:
                 return res
-            drows = self._delta_rows(type_name, f, auths)
+            drows = self._delta_rows(delta, f, auths)
             # stacked row space: delta rows ride above the main table
             # (QueryResult.indices document this via the plan's explain;
             # res.table holds the fully-hydrated rows either way)
@@ -330,17 +366,16 @@ class TpuDataStore:
             from geomesa_tpu.index.shaping import (reproject_table,
                                                    shape_local,
                                                    transform_table)
-            planner = self._main_planner(type_name)
+            planner, delta = self._snapshot(type_name)
             plan = planner.plan(f)
             rows = planner.select_indices(f, plan=plan, auths=auths)
-            delta = self.deltas.get(type_name)
             if delta is None:
                 from geomesa_tpu.index.shaping import shape_rows
                 rows = shape_rows(planner.table, rows, hints.get("sort"),
                                   hints.get("limit"))
                 sub = planner.table.take(rows)
             else:
-                drows = self._delta_rows(type_name, f, auths)
+                drows = self._delta_rows(delta, f, auths)
                 sub = FeatureTable.concat(
                     [planner.table.take(rows), delta.take(drows)])
                 rows = np.concatenate(
@@ -362,14 +397,13 @@ class TpuDataStore:
             # for the delta rows adds onto the device grid) — a dashboard
             # repaint must never trigger an O(table) flush
             from geomesa_tpu.aggregates.density import density, host_grid
-            planner = self._main_planner(type_name)
+            planner, delta = self._snapshot(type_name)
             d = dict(hints["density"])
             grid = density(planner, f, d["bbox"], d.get("width", 256),
                            d.get("height", 256), d.get("weight"),
                            auths=auths)
-            delta = self.deltas.get(type_name)
             if delta is not None:
-                drows = self._delta_rows(type_name, f, auths)
+                drows = self._delta_rows(delta, f, auths)
                 grid.weights = grid.weights + host_grid(
                     delta, drows, d["bbox"], grid.width, grid.height,
                     d.get("weight"))
@@ -401,14 +435,15 @@ class TpuDataStore:
             return self._count_impl(type_name, f, auths)
 
     def _count_impl(self, type_name, f, auths) -> int:
-        c = self._main_planner(type_name).count(f, auths=auths)
-        if self.deltas.get(type_name) is not None:
-            c += len(self._delta_rows(type_name, f, auths))
+        planner, delta = self._snapshot(type_name)
+        c = planner.count(f, auths=auths)
+        if delta is not None:
+            c += len(self._delta_rows(delta, f, auths))
         return c
 
     def explain(self, type_name: str, f: Union[str, ir.Filter]) -> dict:
-        out = self._main_planner(type_name).explain(f)
-        delta = self.deltas.get(type_name)
+        planner, delta = self._snapshot(type_name)
+        out = planner.explain(f)
         if delta is not None:
             out["delta_rows"] = len(delta)  # unflushed LSM run merged inline
         return out
@@ -434,55 +469,71 @@ class TpuDataStore:
         discipline — key-bearing attributes change index keys anyway).
 
         ``updates``: attr → scalar, array (len == matches), or callable
-        receiving the matching sub-table and returning values."""
-        planner = self.planner(type_name)  # flushes any delta first
-        rows = planner.select_indices(f)
-        if len(rows) == 0:
-            return 0
-        table = planner.table
-        sub = None
-        for name, val in updates.items():
-            attr = self.schemas[type_name].attribute(name)
-            if callable(val):
-                sub = sub if sub is not None else table.take(rows)
-                val = val(sub)
-            col = table.columns[name]
-            if isinstance(col, GeometryArray):
-                new_geoms = val if isinstance(val, GeometryArray) \
-                    else GeometryArray.from_rows(
-                        [val] * len(rows) if isinstance(val, str) else list(val))
-                keep = np.ones(len(table), dtype=bool)
-                keep[rows] = False
-                order = np.concatenate([np.flatnonzero(keep), rows])
-                inv = np.empty(len(table), dtype=np.int64)
-                inv[order] = np.arange(len(table))
-                merged = GeometryArray.concat([col.take(np.flatnonzero(keep)),
-                                               new_geoms])
-                table.columns[name] = merged.take(inv)
-            elif isinstance(col, StringColumn):
-                # vectorized decode→patch→re-encode (never a per-row Python
-                # loop over the full column)
-                values = np.asarray(col.vocab, dtype=object)[col.codes]
-                values[rows] = val if isinstance(val, str) \
-                    else np.asarray([str(v) for v in val], dtype=object)
-                table.columns[name] = StringColumn.encode(values)
-            else:
-                # copy-on-write: loaded tables may alias caller arrays
-                arr = np.array(col, copy=True)
-                if attr.type_name == "Date":
-                    v = np.asarray(val)
-                    if v.dtype.kind in "MUS":
-                        val = v.astype("datetime64[ms]").astype(np.int64)
-                arr[rows] = val
-                table.columns[name] = arr
-        self._rebuild_indexes(type_name)
-        return int(len(rows))
+        receiving the matching sub-table and returning values.
+
+        Build-then-swap: patched columns land in a NEW FeatureTable that
+        replaces the shared one only at the end — a concurrent reader's
+        snapshot keeps seeing the consistent pre-update table, never a mix
+        of patched and unpatched columns."""
+        with self._lock:
+            planner = self.planner(type_name)  # flushes any delta first
+            rows = planner.select_indices(f)
+            if len(rows) == 0:
+                return 0
+            table = planner.table
+            cols: Dict[str, object] = dict(table.columns)
+            sub = None
+            for name, val in updates.items():
+                attr = self.schemas[type_name].attribute(name)
+                if callable(val):
+                    sub = sub if sub is not None else table.take(rows)
+                    val = val(sub)
+                col = table.columns[name]
+                if isinstance(col, GeometryArray):
+                    new_geoms = val if isinstance(val, GeometryArray) \
+                        else GeometryArray.from_rows(
+                            [val] * len(rows) if isinstance(val, str)
+                            else list(val))
+                    keep = np.ones(len(table), dtype=bool)
+                    keep[rows] = False
+                    order = np.concatenate([np.flatnonzero(keep), rows])
+                    inv = np.empty(len(table), dtype=np.int64)
+                    inv[order] = np.arange(len(table))
+                    merged = GeometryArray.concat(
+                        [col.take(np.flatnonzero(keep)), new_geoms])
+                    cols[name] = merged.take(inv)
+                elif isinstance(col, StringColumn):
+                    # vectorized decode→patch→re-encode (never a per-row
+                    # Python loop over the full column)
+                    values = np.asarray(col.vocab, dtype=object)[col.codes]
+                    values[rows] = val if isinstance(val, str) \
+                        else np.asarray([str(v) for v in val], dtype=object)
+                    cols[name] = StringColumn.encode(values)
+                else:
+                    # copy-on-write: loaded tables may alias caller arrays
+                    arr = np.array(col, copy=True)
+                    if attr.type_name == "Date":
+                        v = np.asarray(val)
+                        if v.dtype.kind in "MUS":
+                            val = v.astype("datetime64[ms]").astype(np.int64)
+                    arr[rows] = val
+                    cols[name] = arr
+            self.tables[type_name] = FeatureTable(
+                table.sft, table._fids, cols, table.visibility,
+                _n=len(table))
+            self._rebuild_indexes(type_name)
+            return int(len(rows))
 
     def update_schema(self, type_name: str, add_attributes: str = "",
                       new_name: Optional[str] = None) -> SimpleFeatureType:
         """Schema evolution (≙ MetadataBackedDataStore.updateSchema:227):
         append new attributes (spec-string syntax; existing rows take the
         type's zero/empty value) and/or rename the type."""
+        with self._lock:
+            return self._update_schema_locked(type_name, add_attributes,
+                                             new_name)
+
+    def _update_schema_locked(self, type_name, add_attributes, new_name):
         sft = self.schemas[type_name]
         spec = sft.to_spec()
         if add_attributes:
@@ -531,15 +582,16 @@ class TpuDataStore:
         """Delete matching features; returns the number removed (≙ GeoTools
         removeFeatures / the age-off iterators). Rebuilds indexes over the
         survivors — bulk deletion, matching the columnar build discipline."""
-        planner = self.planner(type_name)
-        rows = planner.select_indices(f)
-        if len(rows) == 0:
-            return 0
-        keep = np.ones(len(planner.table), dtype=bool)
-        keep[rows] = False
-        self.tables[type_name] = planner.table.take(np.nonzero(keep)[0])
-        self._rebuild_indexes(type_name)
-        return int(len(rows))
+        with self._lock:
+            planner = self.planner(type_name)
+            rows = planner.select_indices(f)
+            if len(rows) == 0:
+                return 0
+            keep = np.ones(len(planner.table), dtype=bool)
+            keep[rows] = False
+            self.tables[type_name] = planner.table.take(np.nonzero(keep)[0])
+            self._rebuild_indexes(type_name)
+            return int(len(rows))
 
 
 class DataStoreFinder:

@@ -66,7 +66,10 @@ def ranges_to_slices(sorted_keys: np.ndarray,
     no per-range Python objects)."""
     if hi is None:
         hi = len(sorted_keys)
-    if isinstance(ranges, tuple):
+    if (isinstance(ranges, tuple) and len(ranges) >= 2
+            and isinstance(ranges[0], np.ndarray)):
+        # the array form; a tuple OF IndexRange objects (legal under the
+        # Sequence contract) falls through to the object branch below
         lowers, uppers = ranges[0], ranges[1]
     elif ranges:
         lowers = np.fromiter((r.lower for r in ranges), np.int64, len(ranges))

@@ -64,14 +64,19 @@ class GeoJsonApi:
             auths = query["auths"][0].split(",") if "auths" in query else None
             if not rest:
                 sft = self.store.get_schema(t)
-                n = len(self.store.tables[t]) if self.store.tables.get(t) is not None else 0
-                delta = self.store.deltas.get(t)
+                # one consistent (planner, delta) snapshot — two unlocked
+                # reads could straddle a flush and under-count by the delta
+                if self.store.tables.get(t) is None:
+                    count = 0
+                else:
+                    planner, delta = self.store._snapshot(t)
+                    count = len(planner.table) + (len(delta) if delta is not None else 0)
                 return 200, {"name": t, "spec": sft.to_spec(),
                              "attributes": [
                                  {"name": a.name, "type": a.type_name,
                                   "default": a.default}
                                  for a in sft.attributes],
-                             "count": n + (len(delta) if delta is not None else 0)}
+                             "count": count}
             if rest == ["count"]:
                 return 200, {"count": self.store.count(t, cql, auths=auths)}
             if rest == ["explain"]:

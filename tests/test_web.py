@@ -98,3 +98,76 @@ def test_bad_cql_is_400(server):
         assert False, "expected 400"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_concurrent_ingest_and_query_stress():
+    """Writers POSTing features while readers GET counts: every response
+    must be a consistent snapshot — counts monotonically non-decreasing
+    (append-only workload), never an error, and the final count exact.
+    Exercises the store's writer-lock + snapshot discipline end to end
+    through the REST thread pool (ThreadingHTTPServer)."""
+    import threading
+
+    rng = np.random.default_rng(17)
+    n0 = 20000
+    ds = TpuDataStore()
+    ds.create_schema("c", "v:Int,dtg:Date,*geom:Point")
+    base = np.datetime64("2024-05-01T00:00:00", "ms").astype(np.int64)
+    ds.load("c", FeatureTable.build(ds.get_schema("c"), {
+        "v": rng.integers(0, 100, n0).astype(np.int32),
+        "dtg": base + rng.integers(0, 86400000, n0),
+        "geom": (rng.uniform(-20, 20, n0), rng.uniform(-20, 20, n0))}))
+    httpd = serve(ds, port=0, background=True)
+    port = httpd.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+    errors = []
+    counts = []
+    n_writers, per_writer, batch = 4, 12, 7
+
+    def writer(wid):
+        try:
+            for i in range(per_writer):
+                fc = {"type": "FeatureCollection", "features": [
+                    {"type": "Feature",
+                     "geometry": {"type": "Point",
+                                  "coordinates": [float(wid), float(i % 10)]},
+                     "properties": {"v": wid, "dtg": "2024-05-01T12:00:00Z"}}
+                    for _ in range(batch)]}
+                req = urllib.request.Request(
+                    f"{url}/types/c/features", method="POST",
+                    data=json.dumps(fc).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as r:
+                    assert r.status == 200
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(("writer", wid, repr(e)))
+
+    def reader(rid):
+        try:
+            got = []
+            for _ in range(40):
+                with urllib.request.urlopen(f"{url}/types/c/count") as r:
+                    assert r.status == 200
+                    got.append(json.loads(r.read())["count"])
+            counts.append(got)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(("reader", rid, repr(e)))
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_writers)]
+    threads += [threading.Thread(target=reader, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    httpd.shutdown()
+    assert not errors, errors
+    # consistent snapshots: append-only counts never go backwards per reader
+    for got in counts:
+        assert got == sorted(got), got
+        assert all(g >= n0 for g in got)
+    expected = n0 + n_writers * per_writer * batch
+    assert ds.count("c", "INCLUDE") == expected
+    # the delta path (not a full rebuild per batch) absorbed the writes
+    assert ds.count("c", f"BBOX(geom, -0.5, -0.5, {n_writers}.5, 10.5)") \
+        >= n_writers * per_writer * batch
