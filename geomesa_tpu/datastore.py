@@ -141,6 +141,7 @@ class TpuDataStore:
                       spec: Optional[str] = None) -> SimpleFeatureType:
         if isinstance(sft, str):
             sft = SimpleFeatureType.from_spec(sft, spec or "")
+        sft.feature_expiry  # validate up front, not on the first write
         with self._lock:
             if sft.name in self.schemas:
                 raise ValueError(f"Schema {sft.name} already exists")
@@ -156,8 +157,10 @@ class TpuDataStore:
 
     def remove_schema(self, type_name: str) -> None:
         with self._lock:
+            # _interceptors/_counters included: a re-created type of the same
+            # name must not inherit the old type's guards or fid sequence
             for d in (self.schemas, self.tables, self.planners, self._stats,
-                      self.deltas):
+                      self.deltas, self._counters, self._interceptors):
                 d.pop(type_name, None)
 
     # -- writes -------------------------------------------------------------
@@ -186,6 +189,9 @@ class TpuDataStore:
     def _append_locked(self, type_name, batch, stats_cached=None) -> None:
         from geomesa_tpu.metrics import REGISTRY as _metrics
         _metrics.inc("ingest.features", len(batch))
+        # already-expired incoming rows never land (O(batch) mask; the
+        # reference's write-path expiry check)
+        batch, _ = self._apply_age_off(type_name, batch)
         current = self.tables.get(type_name)
         if current is None:
             self.tables[type_name] = batch
@@ -203,7 +209,13 @@ class TpuDataStore:
             # land its cached sketches against the merged table)
             _metrics.inc("ingest.flushes")
             self.deltas[type_name] = None
-            self.tables[type_name] = FeatureTable.concat([current, merged_delta])
+            merged = FeatureTable.concat([current, merged_delta])
+            merged, n_exp = self._apply_age_off(type_name, merged)
+            if n_exp:
+                # checkpointed sketches describe rows age-off just dropped —
+                # re-observe rather than restore an overcounting battery
+                stats_cached = None
+            self.tables[type_name] = merged
             with _metrics.time("ingest.index_build"):
                 self._rebuild_indexes(type_name, stats_cached)
         else:
@@ -223,9 +235,55 @@ class TpuDataStore:
             if delta is None:
                 return
             self.deltas[type_name] = None
-            self.tables[type_name] = FeatureTable.concat(
-                [self.tables[type_name], delta])
+            merged = FeatureTable.concat([self.tables[type_name], delta])
+            # dtg age-off rides the flush (≙ compaction-time age-off
+            # iterators): rows whose TTL lapsed since ingest drop here
+            merged, _ = self._apply_age_off(type_name, merged)
+            self.tables[type_name] = merged
             self._rebuild_indexes(type_name)
+
+    def _apply_age_off(self, type_name: str, table: Optional[FeatureTable],
+                       now_ms: Optional[int] = None):
+        """(surviving table, n_expired) under the type's
+        ``geomesa.feature.expiry`` TTL; no-op without one."""
+        sft = self.schemas[type_name]
+        exp = sft.feature_expiry
+        if exp is None or table is None or len(table) == 0:
+            return table, 0
+        import time as _time
+        attr, ttl_ms = exp
+        now = int(_time.time() * 1000) if now_ms is None else int(now_ms)
+        vals = np.asarray(table.columns[attr], dtype=np.int64)
+        # null dates (NaT → int64 min) never expire — age-off drops only
+        # rows whose date actually lapsed, like the reference iterators
+        keep = (vals > now - ttl_ms) | (vals == np.iinfo(np.int64).min)
+        n_exp = int(len(keep) - keep.sum())
+        if n_exp == 0:
+            return table, 0
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        _metrics.inc("ingest.aged_off", n_exp)
+        return table.take(np.flatnonzero(keep)), n_exp
+
+    def age_off(self, type_name: str, now_ms: Optional[int] = None) -> int:
+        """Force an age-off compaction of the main table + delta (≙ running
+        the reference's DtgAgeOffIterator at major compaction): drops every
+        row whose ``geomesa.feature.expiry`` TTL has lapsed and rebuilds the
+        device index if anything dropped. Returns the number removed.
+        ``now_ms`` overrides the clock (maintenance jobs, tests)."""
+        with self._lock:
+            table = self.tables.get(type_name)
+            delta = self.deltas.get(type_name)
+            # merge the delta WITHOUT flush(): its age-off pass runs on the
+            # real clock and would both ignore now_ms and hide its removals
+            # from this method's returned count
+            if delta is not None:
+                table = FeatureTable.concat([table, delta])
+            table2, n = self._apply_age_off(type_name, table, now_ms)
+            if n or delta is not None:
+                self.deltas[type_name] = None
+                self.tables[type_name] = table2
+                self._rebuild_indexes(type_name)
+            return n
 
     def _snapshot(self, type_name: str):
         """One consistent (planner, delta) pair. The brief lock acquire is

@@ -1,0 +1,162 @@
+"""Main-table dtg age-off riding LSM flush/compaction.
+
+≙ reference AgeOffIterator/DtgAgeOffIterator (geomesa-accumulo/.../iterators/
+AgeOffIterator.scala): TTL configured per type via ``geomesa.feature.expiry``
+user data; expired rows drop at ingest, at every LSM flush, and under the
+explicit ``age_off`` compaction."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.sft import SimpleFeatureType, parse_duration_ms
+from geomesa_tpu.features.table import FeatureTable
+
+NOW = np.datetime64("2026-07-30T00:00:00", "ms").astype(np.int64)
+DAY = 86_400_000
+
+
+def _table(ds, name, dtg):
+    n = len(dtg)
+    rng = np.random.default_rng(5)
+    return FeatureTable.build(ds.get_schema(name), {
+        "v": np.arange(n, dtype=np.int32), "dtg": np.asarray(dtg),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+
+
+def _store(expiry="dtg(7 days)"):
+    ds = TpuDataStore()
+    ds.create_schema(
+        "t", f"v:Int,dtg:Date,*geom:Point;geomesa.feature.expiry={expiry}")
+    return ds
+
+
+def test_duration_grammar():
+    assert parse_duration_ms("7 days") == 7 * DAY
+    assert parse_duration_ms("30min") == 30 * 60_000
+    assert parse_duration_ms("500 ms") == 500
+    with pytest.raises(ValueError):
+        parse_duration_ms("7 fortnights")
+    with pytest.raises(ValueError):
+        parse_duration_ms("eleven days")
+
+
+def test_expiry_spec_parsing():
+    s = SimpleFeatureType.from_spec(
+        "t", "v:Int,dtg:Date,*geom:Point;geomesa.feature.expiry=2 hours")
+    assert s.feature_expiry == ("dtg", 2 * 3_600_000)
+    s = SimpleFeatureType.from_spec(
+        "t", "a:Date,b:Date,*geom:Point;geomesa.feature.expiry=b(1 day)")
+    assert s.feature_expiry == ("b", DAY)
+    with pytest.raises(ValueError):
+        SimpleFeatureType.from_spec(
+            "t", "v:Int,*geom:Point;geomesa.feature.expiry=v(1 day)"
+        ).feature_expiry
+
+
+def test_expired_rows_dropped_at_load():
+    ds = _store()
+    import time
+    now = int(time.time() * 1000)
+    dtg = np.concatenate([np.full(50, now - 30 * DAY),  # long expired
+                          np.full(70, now - DAY)])      # fresh
+    ds.load("t", _table(ds, "t", dtg))
+    assert ds.count("t", "INCLUDE") == 70
+
+
+def test_flush_ages_off_main_table():
+    ds = _store()
+    import time
+    now = int(time.time() * 1000)
+    # main table holds rows that will "expire" under a forced future clock
+    ds.load("t", _table(ds, "t", np.full(1000, now - DAY)))
+    assert ds.count("t", "INCLUDE") == 1000
+    # nothing expired yet under the real clock
+    assert ds.age_off("t") == 0
+    assert ds.count("t", "INCLUDE") == 1000
+    # advance the clock past the TTL: compaction removes every row
+    assert ds.age_off("t", now_ms=now + 30 * DAY) == 1000
+    assert ds.count("t", "INCLUDE") == 0
+
+
+def test_delta_flush_applies_ttl():
+    ds = _store()
+    import time
+    now = int(time.time() * 1000)
+    ds.load("t", _table(ds, "t", np.full(100_000, now - DAY)))
+    # delta append of fresh rows, then a mixed main: flush must re-check TTL
+    ds.load("t", _table(ds, "t", np.full(500, now - 2 * DAY)))
+    assert ds.deltas["t"] is not None  # took the delta path
+    assert ds.count("t", "INCLUDE") == 100_500
+    ds.flush("t")
+    assert ds.count("t", "INCLUDE") == 100_500  # all still within 7 days
+    # clock +5 days: the 2-day-old rows hit exactly TTL (dropped — strict
+    # cutoff), the 1-day-old main rows sit at 6 days (kept)
+    removed = ds.age_off("t", now_ms=now + 5 * DAY)
+    assert removed == 500
+    assert ds.count("t", "INCLUDE") == 100_000
+
+
+def test_no_expiry_schema_unaffected():
+    ds = TpuDataStore()
+    ds.create_schema("p", "v:Int,dtg:Date,*geom:Point")
+    dtg = np.full(200, np.datetime64("1999-01-01", "ms").astype(np.int64))
+    ds.load("p", _table(ds, "p", dtg))
+    assert ds.count("p", "INCLUDE") == 200
+    assert ds.age_off("p") == 0
+    assert ds.count("p", "INCLUDE") == 200
+
+
+def test_null_dates_never_expire():
+    ds = _store()
+    import time
+    now = int(time.time() * 1000)
+    nat = np.iinfo(np.int64).min  # NaT encoding
+    dtg = np.array([now - DAY, nat, now - 30 * DAY], dtype=np.int64)
+    ds.load("t", _table(ds, "t", dtg))
+    # the lapsed row drops; the null-dated row survives
+    assert ds.count("t", "INCLUDE") == 2
+    assert ds.age_off("t", now_ms=now + 365 * DAY) == 1
+    assert ds.count("t", "INCLUDE") == 1  # only the NaT row remains
+
+
+def test_age_off_counts_delta_removals_at_now_ms():
+    ds = _store()
+    import time
+    now = int(time.time() * 1000)
+    ds.load("t", _table(ds, "t", np.full(100_000, now - DAY)))
+    ds.load("t", _table(ds, "t", np.full(300, now - 2 * DAY)))  # delta
+    assert ds.deltas["t"] is not None
+    # every row (main + delta) lapses at +30 days; the return value must
+    # count ALL of them, including the delta rows merged on the way
+    assert ds.age_off("t", now_ms=now + 30 * DAY) == 100_300
+    assert ds.count("t", "INCLUDE") == 0
+
+
+def test_invalid_expiry_rejected_at_create_schema():
+    ds = TpuDataStore()
+    with pytest.raises(ValueError):
+        ds.create_schema("a", "v:Int,*geom:Point;geomesa.feature.expiry=1 day")
+    with pytest.raises(ValueError):
+        ds.create_schema(
+            "b", "v:Int,dtg:Date,*geom:Point;geomesa.feature.expiry=v(1 day)")
+    with pytest.raises(ValueError):
+        ds.create_schema(
+            "c", "dtg:Date,*geom:Point;geomesa.feature.expiry=7 fortnights")
+    assert ds.get_type_names() == []
+
+
+def test_interceptors_do_not_survive_schema_removal():
+    ds = TpuDataStore()
+    ds.create_schema("r", "v:Int,dtg:Date,*geom:Point")
+    rejected = []
+
+    class Guard:
+        def intercept(self, *a, **k):
+            rejected.append(1)
+            return None
+
+    ds.add_interceptor("r", Guard())
+    ds.remove_schema("r")
+    ds.create_schema("r", "v:Int,dtg:Date,*geom:Point")
+    assert ds._interceptors.get("r") in (None, [])
