@@ -169,3 +169,27 @@ def or_filters(filters: Sequence[Filter]) -> Filter:
     if any(isinstance(f, Include) for f in fs):
         return Include()
     return fs[0] if len(fs) == 1 else Or(fs)
+
+
+def attributes_of(f: Filter) -> Optional[set]:
+    """Attribute names a filter references, or None when it needs more than
+    attribute columns (fid filters read the fid sidecar). Drives projection
+    push-down: a columnar reader can hydrate only these columns to evaluate
+    the filter (≙ the reference's ArrowFilterOptimizer / ORC column pruning,
+    OrcFileSystemStorage's read schemas)."""
+    if isinstance(f, (Include, Exclude)):
+        return set()
+    if isinstance(f, FidFilter):
+        return None
+    if isinstance(f, Not):
+        return attributes_of(f.child)
+    if isinstance(f, (And, Or)):
+        out: set = set()
+        for c in f.children:
+            sub = attributes_of(c)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    attr = getattr(f, "attr", None)
+    return {attr} if attr is not None else None

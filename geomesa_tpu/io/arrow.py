@@ -225,3 +225,21 @@ def merge_deltas(paths, out_path: str, sort: Optional[str] = None,
             w.write(merged.take(np.arange(
                 lo, min(len(merged), lo + batch_rows))))
 
+
+
+def orc_compatible(at: "pa.Table") -> "pa.Table":
+    """Arrow table reshaped for the ORC writer: dictionary columns cast to
+    their value type (ORC has no dictionary encoding; its RLE recovers the
+    compression on disk) and ms timestamps to int64 (ORC timestamps are
+    seconds+nanos and overflow on epoch-ms magnitudes; from_arrow casts
+    Date columns back to int64 ms either way)."""
+    for i, f in enumerate(at.schema):
+        if pa.types.is_dictionary(f.type):
+            at = at.set_column(
+                i, pa.field(f.name, f.type.value_type, metadata=f.metadata),
+                at.column(i).cast(f.type.value_type))
+        elif pa.types.is_timestamp(f.type):
+            at = at.set_column(
+                i, pa.field(f.name, pa.int64(), metadata=f.metadata),
+                at.column(i).cast(pa.int64()))
+    return at

@@ -17,7 +17,8 @@ import numpy as np
 from geomesa_tpu.features.geometry import GeometryArray
 from geomesa_tpu.features.table import FeatureTable, StringColumn
 
-FORMATS = ("csv", "tsv", "geojson", "json", "wkt", "arrow", "parquet", "avro")
+FORMATS = ("csv", "tsv", "geojson", "json", "wkt", "arrow", "parquet",
+           "avro", "orc", "gml", "shp")
 
 
 def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
@@ -51,6 +52,19 @@ def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
             raise ValueError("parquet export requires a path")
         pq.write_table(to_arrow(table), path)
         return path
+    if fmt == "orc":
+        from pyarrow import orc
+        from geomesa_tpu.io.arrow import to_arrow, orc_compatible
+        if path is None:
+            raise ValueError("orc export requires a path")
+        orc.write_table(orc_compatible(to_arrow(table)), path)
+        return path
+    if fmt == "gml":
+        return _gml(table, path)
+    if fmt == "shp":
+        if path is None:
+            raise ValueError("shp export requires a path (base name)")
+        return _shapefile(table, path)
     raise ValueError(f"Unknown export format {fmt!r} (have {FORMATS})")
 
 
@@ -134,3 +148,233 @@ def _wkt(table: FeatureTable, path):
     for i in range(len(table)):
         f.write(garr.wkt(i) + "\n")
     return _finish(f, path)
+
+
+# -- GML (Geography Markup Language; ≙ ExportFormat.Gml / GML3 encoder) ------
+
+
+def _gml_coords(pts) -> str:
+    return " ".join(f"{float(p[0])!r} {float(p[1])!r}" for p in pts)
+
+
+def _gml_geometry(code: int, data) -> str:
+    from geomesa_tpu.features import geometry as geo
+    srs = ' srsName="urn:ogc:def:crs:EPSG::4326"'
+    if code == geo.POINT:
+        return (f"<gml:Point{srs}><gml:pos>{float(data[0])!r} "
+                f"{float(data[1])!r}</gml:pos></gml:Point>")
+    if code == geo.LINESTRING:
+        return (f"<gml:LineString{srs}><gml:posList>{_gml_coords(data)}"
+                "</gml:posList></gml:LineString>")
+    if code == geo.POLYGON:
+        rings = [f"<gml:{tag}><gml:LinearRing><gml:posList>"
+                 f"{_gml_coords(r)}</gml:posList></gml:LinearRing></gml:{tag}>"
+                 for r, tag in zip(data, ["exterior"]
+                                   + ["interior"] * (len(data) - 1))]
+        return f"<gml:Polygon{srs}>{''.join(rings)}</gml:Polygon>"
+    if code == geo.MULTIPOINT:
+        members = "".join(f"<gml:pointMember>{_gml_geometry(geo.POINT, p)}"
+                          "</gml:pointMember>" for p in data)
+        return f"<gml:MultiPoint{srs}>{members}</gml:MultiPoint>"
+    if code == geo.MULTILINESTRING:
+        members = "".join(
+            f"<gml:curveMember>{_gml_geometry(geo.LINESTRING, l)}"
+            "</gml:curveMember>" for l in data)
+        return f"<gml:MultiCurve{srs}>{members}</gml:MultiCurve>"
+    if code == geo.MULTIPOLYGON:
+        members = "".join(
+            f"<gml:surfaceMember>{_gml_geometry(geo.POLYGON, p)}"
+            "</gml:surfaceMember>" for p in data)
+        return f"<gml:MultiSurface{srs}>{members}</gml:MultiSurface>"
+    raise ValueError(f"Unsupported geometry code {code}")
+
+
+def _gml(table: FeatureTable, path):
+    from xml.sax.saxutils import escape, quoteattr
+    sft = table.sft
+    gname = sft.geometry_attribute.name if sft.geometry_attribute else None
+    garr = table.geometry() if gname else None
+    f = _out(path)
+    f.write('<?xml version="1.0" encoding="UTF-8"?>\n')
+    f.write('<gml:FeatureCollection '
+            'xmlns:gml="http://www.opengis.net/gml/3.2" '
+            'xmlns:gt="urn:geomesa-tpu">\n')
+    for i in range(len(table)):
+        f.write(f' <gml:featureMember>\n  <gt:{sft.name} '
+                f'gml:id={quoteattr(str(table.fids[i]))}>\n')
+        for a in sft.attributes:
+            if a.name == gname:
+                code, data = garr.shape(i)
+                f.write(f"   <gt:{a.name}>{_gml_geometry(code, data)}"
+                        f"</gt:{a.name}>\n")
+            else:
+                v = _cell(table.columns[a.name], a, i)
+                f.write(f"   <gt:{a.name}>{escape(str(v))}</gt:{a.name}>\n")
+        f.write(f"  </gt:{sft.name}>\n </gml:featureMember>\n")
+    f.write("</gml:FeatureCollection>\n")
+    return _finish(f, path)
+
+
+# -- ESRI shapefile (.shp/.shx/.dbf; ≙ ExportFormat.Shp) ---------------------
+# Wire layouts per the public ESRI whitepaper; the reader counterpart lives
+# in convert/formats.py (read_shapefile) and round-trips these files.
+
+
+def _ring_area(pts) -> float:
+    a = 0.0
+    for i in range(len(pts) - 1):
+        a += pts[i][0] * pts[i + 1][1] - pts[i + 1][0] * pts[i][1]
+    return a / 2.0
+
+
+def _shp_record(code: int, data):
+    """(shape_type, content bytes after the type word) for one geometry."""
+    import struct
+    from geomesa_tpu.features import geometry as geo
+
+    def parts_record(shape_type, parts):
+        pts = [p for part in parts for p in part]
+        xs = [p[0] for p in pts]
+        ys = [p[1] for p in pts]
+        head = struct.pack("<4d", min(xs), min(ys), max(xs), max(ys))
+        head += struct.pack("<ii", len(parts), len(pts))
+        off = 0
+        for part in parts:
+            head += struct.pack("<i", off)
+            off += len(part)
+        body = b"".join(struct.pack("<dd", float(p[0]), float(p[1]))
+                        for p in pts)
+        return shape_type, head + body
+
+    if code == geo.POINT:
+        return 1, struct.pack("<dd", float(data[0]), float(data[1]))
+    if code == geo.MULTIPOINT:
+        xs = [p[0] for p in data]
+        ys = [p[1] for p in data]
+        head = struct.pack("<4d", min(xs), min(ys), max(xs), max(ys))
+        head += struct.pack("<i", len(data))
+        body = b"".join(struct.pack("<dd", float(p[0]), float(p[1]))
+                        for p in data)
+        return 8, head + body
+    if code == geo.LINESTRING:
+        return parts_record(3, [data])
+    if code == geo.MULTILINESTRING:
+        return parts_record(3, data)
+    if code in (geo.POLYGON, geo.MULTIPOLYGON):
+        polys = [data] if code == geo.POLYGON else data
+        rings = []
+        for poly in polys:
+            for j, ring in enumerate(poly):
+                # spec orientation: exterior clockwise (negative signed
+                # area), holes counter-clockwise
+                cw = _ring_area(ring) < 0
+                want_cw = j == 0
+                rings.append(list(ring) if cw == want_cw else list(ring)[::-1])
+        return parts_record(5, rings)
+    raise ValueError(f"Unsupported geometry code {code} for shapefile")
+
+
+def _dbf_fields(sft):
+    """(name, type, width, decimals, formatter) per non-geometry attr."""
+    out = []
+    seen = {}
+    for a in sft.attributes:
+        if a.is_geometry:
+            continue
+        name = a.name[:10]
+        # DBF names are 10 chars: unique the truncations or the reader
+        # merges colliding columns into interleaved garbage
+        if name in seen:
+            seen[name] += 1
+            name = f"{name[:10 - len(str(seen[name]))]}{seen[name]}"
+        seen.setdefault(name, 0)
+        if a.type_name in ("Int", "Integer", "Long"):
+            # width 20 holds any int64 incl. the sign; never slice digits
+            out.append((name, b"N", 20, 0,
+                        lambda v: f"{int(v):>20d}"))
+        elif a.type_name in ("Float", "Double"):
+            out.append((name, b"F", 19, 11,
+                        lambda v: f"{float(v):>19.11g}"[:19].rjust(19)))
+        elif a.type_name == "Date":
+            out.append((name, b"D", 8, 0,
+                        lambda v: str(np.datetime64(int(v), "ms"))[:10]
+                        .replace("-", "")))
+        elif a.type_name == "Boolean":
+            out.append((name, b"L", 1, 0,
+                        lambda v: "T" if v else "F"))
+        else:
+            out.append((name, b"C", 64, 0,
+                        lambda v: str(v)[:64].ljust(64)))
+    return out
+
+
+def _shapefile(table: FeatureTable, path: str) -> str:
+    """Write ``path``.shp/.shx/.dbf. Geometry column required."""
+    import os
+    import struct
+
+    base, ext = os.path.splitext(path)
+    if ext not in ("", ".shp"):
+        base = path
+    garr = table.geometry()
+    n = len(table)
+    records = []
+    shape_type = 0
+    for i in range(n):
+        st, content = _shp_record(*garr.shape(i))
+        if shape_type == 0:
+            shape_type = st
+        elif st != shape_type:
+            raise ValueError("shapefile export needs a single shape type "
+                             f"(got {shape_type} and {st})")
+        records.append(struct.pack("<i", st) + content)
+
+    bbs = garr.bboxes()
+    if n:
+        bbox = (float(bbs[:, 0].min()), float(bbs[:, 1].min()),
+                float(bbs[:, 2].max()), float(bbs[:, 3].max()))
+    else:
+        bbox = (0.0, 0.0, 0.0, 0.0)
+
+    def header(total_words):
+        return (struct.pack(">i", 9994) + b"\x00" * 20
+                + struct.pack(">i", total_words)
+                + struct.pack("<ii", 1000, shape_type)
+                + struct.pack("<4d", *bbox) + struct.pack("<4d", 0, 0, 0, 0))
+
+    shp_words = 50 + sum(4 + len(r) // 2 for r in records)
+    with open(base + ".shp", "wb") as f:
+        f.write(header(shp_words))
+        offset = 50
+        offsets = []
+        for num, rec in enumerate(records, 1):
+            f.write(struct.pack(">ii", num, len(rec) // 2) + rec)
+            offsets.append((offset, len(rec) // 2))
+            offset += 4 + len(rec) // 2
+    with open(base + ".shx", "wb") as f:
+        f.write(header(50 + 4 * n))
+        for off, words in offsets:
+            f.write(struct.pack(">ii", off, words))
+
+    fields = _dbf_fields(table.sft)
+    rec_size = 1 + sum(w for _, _, w, _, _ in fields)
+    attrs = [a for a in table.sft.attributes if not a.is_geometry]
+    with open(base + ".dbf", "wb") as f:
+        hdr_size = 32 + 32 * len(fields) + 1
+        f.write(struct.pack("<BBBBIHH20x", 3, 26, 7, 30, n, hdr_size,
+                            rec_size))
+        for name, typ, width, dec, _fmt in fields:
+            f.write(name.encode("ascii", "replace")[:11].ljust(11, b"\x00")
+                    + typ + b"\x00" * 4
+                    + struct.pack("<BB", width, dec) + b"\x00" * 14)
+        f.write(b"\x0d")
+        for i in range(n):
+            row = b" "
+            for (name, typ, width, dec, fmt), a in zip(fields, attrs):
+                v = _cell(table.columns[a.name], a, i)
+                if a.type_name == "Date":
+                    v = int(np.asarray(table.columns[a.name])[i])
+                row += fmt(v).encode("ascii", "replace")[:width].ljust(width)
+            f.write(row)
+        f.write(b"\x1a")
+    return base + ".shp"

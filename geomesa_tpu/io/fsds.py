@@ -262,12 +262,16 @@ def _equality_values(f: ir.Filter, attr: str) -> Optional[set]:
 
 
 class FileSystemStorage:
-    """Partitioned Parquet store with pruned reads and compaction."""
+    """Partitioned columnar store (Parquet or ORC files) with pruned reads
+    and compaction (≙ geomesa-fs-storage-parquet / -orc,
+    OrcFileSystemStorage.scala)."""
 
     _META = "_metadata.json"
+    ENCODINGS = ("parquet", "orc")
 
     def __init__(self, root: str, sft: Optional[SimpleFeatureType] = None,
-                 scheme: Optional[PartitionScheme] = None):
+                 scheme: Optional[PartitionScheme] = None,
+                 encoding: str = "parquet"):
         self.root = root
         os.makedirs(root, exist_ok=True)
         meta_path = os.path.join(root, self._META)
@@ -276,23 +280,61 @@ class FileSystemStorage:
                 meta = json.load(fh)
             self.sft = SimpleFeatureType.from_spec(meta["name"], meta["spec"])
             self.scheme = PartitionScheme.from_dict(meta["scheme"])
+            self.encoding = meta.get("encoding", "parquet")
         else:
             if sft is None or scheme is None:
                 raise ValueError("New storage needs sft= and scheme=")
+            if encoding not in self.ENCODINGS:
+                raise ValueError(f"encoding must be one of {self.ENCODINGS}")
             scheme.validate(sft)
             self.sft = sft
             self.scheme = scheme
+            self.encoding = encoding
             with open(meta_path, "w") as fh:
                 json.dump({"name": sft.name, "spec": sft.to_spec(),
-                           "scheme": scheme.to_dict()}, fh)
+                           "scheme": scheme.to_dict(),
+                           "encoding": encoding}, fh)
+
+    # -- file codec (parquet | orc) ------------------------------------------
+
+    @property
+    def _ext(self) -> str:
+        return "." + self.encoding
+
+    def _write_file(self, at, path: str) -> None:
+        if self.encoding == "orc":
+            from pyarrow import orc
+            from geomesa_tpu.io.arrow import orc_compatible
+            orc.write_table(orc_compatible(at), path)
+        else:
+            import pyarrow.parquet as pq
+            pq.write_table(at, path)
+
+    def _read_file(self, path: str, columns: Optional[List[str]] = None):
+        """Arrow table, optionally projected to ``columns`` (both readers
+        push column pruning into the file format)."""
+        if self.encoding == "orc":
+            from pyarrow import orc
+            return orc.ORCFile(path).read(columns=columns)
+        import pyarrow.parquet as pq
+        return pq.read_table(path, columns=columns)
+
+    def _file_columns(self, path: str) -> List[str]:
+        """Column names stored in a file (attributes + the fid sidecar)."""
+        if self.encoding == "orc":
+            from pyarrow import orc
+            sch = orc.ORCFile(path).schema
+        else:
+            import pyarrow.parquet as pq
+            sch = pq.ParquetFile(path).schema_arrow
+        return list(sch.names)
 
     # -- writes --------------------------------------------------------------
 
     def write(self, table: FeatureTable) -> Dict[str, int]:
-        """Append a batch: rows split by partition, one new Parquet file per
-        touched partition (compaction merges later)."""
+        """Append a batch: rows split by partition, one new file per touched
+        partition (compaction merges later)."""
         from geomesa_tpu.io.arrow import to_arrow
-        import pyarrow.parquet as pq
 
         parts = self.scheme.partition_of(table)
         out: Dict[str, int] = {}
@@ -301,8 +343,8 @@ class FileSystemStorage:
             sub = table.take(rows)
             pdir = os.path.join(self.root, str(p))
             os.makedirs(pdir, exist_ok=True)
-            pq.write_table(to_arrow(sub),
-                           os.path.join(pdir, f"{uuid.uuid4().hex}.parquet"))
+            self._write_file(to_arrow(sub), os.path.join(
+                pdir, f"{uuid.uuid4().hex}{self._ext}"))
             out[str(p)] = len(rows)
         return out
 
@@ -311,31 +353,68 @@ class FileSystemStorage:
     def partitions(self) -> List[str]:
         out = []
         for dirpath, _dirs, files in os.walk(self.root):
-            if any(f.endswith(".parquet") for f in files):
+            if any(f.endswith(self._ext) for f in files):
                 out.append(os.path.relpath(dirpath, self.root))
         return sorted(out)
 
     def files(self, partition: str) -> List[str]:
         pdir = os.path.join(self.root, partition)
         return sorted(os.path.join(pdir, f) for f in os.listdir(pdir)
-                      if f.endswith(".parquet"))
+                      if f.endswith(self._ext))
 
     def read(self, f=None) -> FeatureTable:
-        """Read matching features: partition pruning → parquet reads →
+        """Read matching features: partition pruning → column-pruned reads →
         exact host refine (≙ the FSDS query path: prune, columnar scan,
-        client filter)."""
+        client filter).
+
+        Projection push-down (≙ ArrowFilterOptimizer / the ORC reader's
+        search-argument schemas): only the filter's referenced columns
+        hydrate to evaluate the mask; the remaining columns of a file read
+        back only for the rows that matched (arrow-level take BEFORE the
+        python-side decode, so non-matching rows never pay WKB/dictionary
+        conversion)."""
         from geomesa_tpu.io.arrow import from_arrow
-        import pyarrow.parquet as pq
 
         fir = parse_ecql(f) if isinstance(f, str) else f
+        unfiltered = fir is None or isinstance(fir, ir.Include)
+        fcols = None if unfiltered else ir.attributes_of(fir)
+        proj = None
+        if fcols:
+            proj_attrs = [a for a in self.sft.attributes if a.name in fcols]
+            if {a.name for a in proj_attrs} == fcols \
+                    and len(proj_attrs) < len(self.sft.attributes):
+                proj = SimpleFeatureType(self.sft.name, proj_attrs,
+                                         self.sft.user_data)
         parts = self.scheme.matching(fir, self.sft, self.partitions())
         tables = []
         for p in parts:
             for fp in self.files(p):
-                t = from_arrow(pq.read_table(fp), self.sft)
-                if fir is not None and not isinstance(fir, ir.Include):
-                    mask = _evaluate(fir, t)
-                    t = t.take(np.flatnonzero(mask))
+                if unfiltered:
+                    t = from_arrow(self._read_file(fp), self.sft)
+                elif proj is not None:
+                    pnames = [a.name for a in proj.attributes]
+                    at1 = self._read_file(fp, columns=pnames)
+                    tf = from_arrow(at1, proj)
+                    rows = np.flatnonzero(_evaluate(fir, tf))
+                    if len(rows) == 0:
+                        continue
+                    # phase 2: only the columns phase 1 didn't read — the
+                    # already-hydrated filter columns append at arrow level
+                    # (never re-read; never decode non-matching rows)
+                    rest = [n for n in self._file_columns(fp)
+                            if n not in set(pnames)]
+                    at = self._read_file(fp, columns=rest).take(rows) \
+                        if rest else at1.take(rows)
+                    if rest:
+                        for name in pnames:
+                            at = at.append_column(at1.schema.field(name),
+                                                  at1.column(name).take(rows))
+                    t = from_arrow(at, self.sft)
+                else:
+                    # filter needs more than attribute columns (fids) or an
+                    # unknown attribute: full hydrate + refine
+                    t = from_arrow(self._read_file(fp), self.sft)
+                    t = t.take(np.flatnonzero(_evaluate(fir, t)))
                 if len(t):
                     tables.append(t)
         if not tables:
@@ -351,7 +430,6 @@ class FileSystemStorage:
     def compact(self, partition: Optional[str] = None) -> Dict[str, int]:
         """Merge each partition's files into one (≙ FSDS compaction)."""
         from geomesa_tpu.io.arrow import from_arrow, to_arrow
-        import pyarrow.parquet as pq
 
         targets = [partition] if partition else self.partitions()
         out: Dict[str, int] = {}
@@ -361,9 +439,9 @@ class FileSystemStorage:
                 out[p] = len(files)
                 continue
             merged = FeatureTable.concat(
-                [from_arrow(pq.read_table(fp), self.sft) for fp in files])
-            tmp = os.path.join(self.root, p, f"{uuid.uuid4().hex}.parquet")
-            pq.write_table(to_arrow(merged), tmp)
+                [from_arrow(self._read_file(fp), self.sft) for fp in files])
+            tmp = os.path.join(self.root, p, f"{uuid.uuid4().hex}{self._ext}")
+            self._write_file(to_arrow(merged), tmp)
             for fp in files:
                 os.remove(fp)
             out[p] = 1

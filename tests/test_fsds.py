@@ -130,3 +130,58 @@ def test_z2_scheme_rejects_extent_layers(tmp_path):
     lsft = SimpleFeatureType.from_spec("l", "*geom:LineString")
     with pytest.raises(ValueError, match="Point"):
         FileSystemStorage(str(tmp_path / "s"), lsft, Z2Scheme())
+
+
+@pytest.mark.parametrize("encoding", ["parquet", "orc"])
+def test_encoding_round_trip_and_pruned_read(tmp_path, encoding):
+    """Both file encodings answer the same filtered read exactly (the ORC
+    slot of geomesa-fs-storage-orc/OrcFileSystemStorage)."""
+    table, rng = _table()
+    fs = FileSystemStorage(str(tmp_path / encoding), SFT, Z2Scheme(bits=2),
+                           encoding=encoding)
+    fs.write(table)
+    assert all(f.endswith("." + encoding)
+               for p in fs.partitions() for f in fs.files(p))
+    q = "BBOX(geom, -20, -20, 20, 20) AND v < 50"
+    got = fs.read(q)
+    x, y = table.geometry().point_xy()
+    v = np.asarray(table.columns["v"])
+    ref = int(np.sum((x >= -20) & (x <= 20) & (y >= -20) & (y <= 20)
+                     & (v < 50)))
+    assert len(got) == ref
+    # metadata remembers the encoding across reopen
+    fs2 = FileSystemStorage(str(tmp_path / encoding))
+    assert fs2.encoding == encoding
+    assert len(fs2.read(q)) == ref
+    # compaction preserves content under either codec
+    fs2.write(table.take(np.arange(100)))
+    fs2.compact()
+    assert all(len(fs2.files(p)) == 1 for p in fs2.partitions())
+    assert len(fs2.read("INCLUDE")) == len(table) + 100
+
+
+def test_projection_pushdown_reads_only_filter_columns(tmp_path, monkeypatch):
+    """The filter pass must hydrate only the referenced columns; full rows
+    only for files with matches (≙ ArrowFilterOptimizer / ORC search args)."""
+    table, rng = _table()
+    fs = FileSystemStorage(str(tmp_path / "proj"), SFT, Z2Scheme(bits=2))
+    fs.write(table)
+    calls = []
+    orig = FileSystemStorage._read_file
+
+    def spy(self, path, columns=None):
+        calls.append(columns)
+        return orig(self, path, columns)
+
+    monkeypatch.setattr(FileSystemStorage, "_read_file", spy)
+    got = fs.read("v > 1000")  # matches nothing, references only v
+    assert len(got) == 0
+    assert calls and all(c == ["v"] for c in calls), calls  # never full reads
+    calls.clear()
+    got = fs.read("v >= 0")  # matches everything
+    assert len(got) == len(table)
+    # phase 1 projected to v; phase 2 reads ONLY the remaining columns
+    # (the filter column never reads twice, and no call is a full read)
+    assert all(c is not None for c in calls), calls
+    phase2 = [c for c in calls if c != ["v"]]
+    assert phase2 and all("v" not in c and "geom" in c for c in phase2)

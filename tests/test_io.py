@@ -228,3 +228,76 @@ def test_export_parquet(store, tmp_path):
 def test_export_unknown_format(store):
     with pytest.raises(ValueError):
         export(store.tables["chk"], "shapefile3000")
+
+
+def test_export_orc_round_trip(store, tmp_path):
+    from pyarrow import orc
+    from geomesa_tpu.io.arrow import from_arrow
+    res = store.query("chk", "val < 10")
+    p = str(tmp_path / "out.orc")
+    export(res.table, "orc", p)
+    back = from_arrow(orc.ORCFile(p).read(), store.get_schema("chk"))
+    assert len(back) == res.count
+    np.testing.assert_array_equal(np.asarray(back.columns["val"]),
+                                  np.asarray(res.table.columns["val"]))
+    np.testing.assert_array_equal(np.asarray(back.columns["dtg"]),
+                                  np.asarray(res.table.columns["dtg"]))
+    bx, by = back.geometry().point_xy()
+    ox, oy = res.table.geometry().point_xy()
+    np.testing.assert_allclose(bx, ox)
+    np.testing.assert_allclose(by, oy)
+
+
+def test_export_gml(store):
+    import xml.etree.ElementTree as ET
+    res = store.query("chk", "val < 5")
+    out = export(res.table, "gml")
+    root = ET.fromstring(out)  # well-formed XML
+    ns = {"gml": "http://www.opengis.net/gml/3.2", "gt": "urn:geomesa-tpu"}
+    members = root.findall("gml:featureMember", ns)
+    assert len(members) == res.count
+    pos = members[0].find(".//gml:pos", ns).text.split()
+    x, y = res.table.geometry().point_xy()
+    assert float(pos[0]) == pytest.approx(x[0])
+    assert float(pos[1]) == pytest.approx(y[0])
+    assert members[0].find(".//gt:val", ns).text is not None
+
+
+def test_export_shapefile_round_trips_through_reader(store, tmp_path):
+    from geomesa_tpu.convert.formats import read_shapefile
+    res = store.query("chk", "val < 10")
+    p = str(tmp_path / "out.shp")
+    got = export(res.table, "shp", p)
+    assert got.endswith(".shp")
+    garr, attrs = read_shapefile(p)
+    assert len(garr) == res.count
+    gx, gy = garr.point_xy()
+    ox, oy = res.table.geometry().point_xy()
+    np.testing.assert_allclose(gx, ox)
+    np.testing.assert_allclose(gy, oy)
+    np.testing.assert_array_equal(
+        np.asarray(attrs["val"], dtype=np.int64),
+        np.asarray(res.table.columns["val"], dtype=np.int64))
+    # string attribute survives the dbf round trip
+    names = [str(v).strip() for v in attrs["name"]]
+    assert names == [str(v) for v in np.asarray(
+        res.table.columns["name"].decode(
+            np.arange(res.count)) if hasattr(res.table.columns["name"],
+                                             "decode")
+        else res.table.columns["name"])]
+
+
+def test_export_shapefile_polygons(tmp_path):
+    from geomesa_tpu.convert.formats import read_shapefile
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    sft = SimpleFeatureType.from_spec("poly", "v:Int,*geom:Polygon")
+    wkts = ["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))",
+            "POLYGON ((10 10, 12 10, 12 12, 10 10))"]
+    t = FeatureTable.build(sft, {"v": [1, 2], "geom": wkts})
+    p = str(tmp_path / "p.shp")
+    export(t, "shp", p)
+    garr, attrs = read_shapefile(p)
+    assert len(garr) == 2
+    bb = garr.bboxes()
+    np.testing.assert_allclose(bb[0], [0, 0, 4, 4])
+    np.testing.assert_allclose(bb[1], [10, 10, 12, 12])
