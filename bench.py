@@ -18,6 +18,9 @@ Covers the five BASELINE.md configs:
   3. Spatial join: point-in-polygon counts, points/sec/chip.
   4. Density (512x512, compact/pruned scatter) + KNN (device top-k over
      candidate blocks) — requires config 1 (reported explicitly if missing).
+  5. S2 vs Z2 cover calibration (host-only): scanned-rows slop of each
+     curve's cover over random boxes, pinning the cost model's S2
+     cover_slop (curves/s2.py) against measurement.
 
 Headline metric = config 1 blocking p50 (RTT included; see rtt field).
 ``vs_baseline`` = indexed-CPU comparator p50 / batch64 per-query (sustained
@@ -138,7 +141,8 @@ def main() -> None:
 
     n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
-    configs = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS", "0,1,2,3,4").split(","))
+    configs = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
+                                 "0,1,2,3,4,5").split(","))
     rng = np.random.default_rng(1234)
     detail: dict = {"n_points": n, "device": str(jax.devices()[0]),
                     "host_cores": os.cpu_count()}
@@ -576,6 +580,42 @@ def main() -> None:
                 lat5.append(time.perf_counter() - t0)
             detail["cfg4_knn10_ms"] = round(_p50(lat5), 1)
             detail["cfg4_knn_max_m"] = round(float(dists.max()), 1)
+
+    # ---- config 5: S2 vs Z2 cover calibration (host-only) -----------------
+    if "5" in configs:
+        # scanned_fraction is a pure host quantity (cover -> searchsorted
+        # over sorted keys), so this costs no chip time; it pins the cost
+        # model's S2 cover_slop against reality (curves/s2.py)
+        from geomesa_tpu.curves.s2 import S2SFC, cell_id
+        from geomesa_tpu.curves.sfc import Z2SFC
+
+        m = min(2_000_000, n)
+        t0 = time.perf_counter()
+        s2k = np.sort(cell_id(x[:m], y[:m]))
+        z2sfc = Z2SFC()
+        z2k = np.sort(z2sfc.index(x[:m], y[:m], lenient=True))
+        s2sfc = S2SFC.apply()
+        tots = {"s2": 0, "z2": 0, "true": 0}
+        rng5 = np.random.default_rng(5)
+        for _ in range(24):
+            cx, cy = rng5.uniform(-150, 120), rng5.uniform(-55, 45)
+            box = (cx, cy, cx + 25.0, cy + 14.0)
+            tots["true"] += int(np.sum(
+                (x[:m] >= box[0]) & (x[:m] <= box[2])
+                & (y[:m] >= box[1]) & (y[:m] <= box[3])))
+            for name, keys, rs in (("s2", s2k, s2sfc.ranges([box])),
+                                   ("z2", z2k, z2sfc.ranges([box]))):
+                lo = np.array([r.lower for r in rs])
+                hi = np.array([r.upper for r in rs])
+                tots[name] += int(np.sum(
+                    np.searchsorted(keys, hi, side="right")
+                    - np.searchsorted(keys, lo, side="left")))
+        true_rows = max(1, tots["true"])
+        detail["cfg5_n"] = m
+        detail["cfg5_z2_cover_slop"] = round(tots["z2"] / true_rows, 3)
+        detail["cfg5_s2_cover_slop"] = round(tots["s2"] / true_rows, 3)
+        detail["cfg5_s2_scanned_fraction"] = round(tots["s2"] / (24 * m), 5)
+        detail["cfg5_s"] = round(time.perf_counter() - t0, 2)
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

@@ -803,6 +803,9 @@ class S2Index(BaseSpatialIndex):
     name = "s2"
     temporal = False
     points = True
+    # measured cover slop vs true rows (curves/s2.py _cell_rect): the cost
+    # model prices S2 plans above an equally-selective Z cover
+    cover_slop = 1.1
 
     @classmethod
     def supports(cls, sft) -> bool:
@@ -837,6 +840,7 @@ class S3Index(BaseSpatialIndex):
     name = "s3"
     temporal = True
     points = True
+    cover_slop = 1.1  # see S2Index
 
     @classmethod
     def supports(cls, sft) -> bool:

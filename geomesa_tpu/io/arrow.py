@@ -110,6 +110,9 @@ def from_arrow(at: pa.Table, sft: Optional[SimpleFeatureType] = None) -> Feature
             else:
                 data[attr.name] = combined.to_pylist()
         elif attr.type_name == "Date":
+            # normalize any timestamp unit (ORC reads back as ns) to ms
+            if pa.types.is_timestamp(col.type):
+                col = col.cast(pa.timestamp("ms"))
             data[attr.name] = np.asarray(col.cast(pa.int64()))
         else:
             data[attr.name] = np.asarray(col)
@@ -230,16 +233,12 @@ def merge_deltas(paths, out_path: str, sort: Optional[str] = None,
 def orc_compatible(at: "pa.Table") -> "pa.Table":
     """Arrow table reshaped for the ORC writer: dictionary columns cast to
     their value type (ORC has no dictionary encoding; its RLE recovers the
-    compression on disk) and ms timestamps to int64 (ORC timestamps are
-    seconds+nanos and overflow on epoch-ms magnitudes; from_arrow casts
-    Date columns back to int64 ms either way)."""
+    compression on disk). Timestamps write as real ORC timestamps so
+    external readers (Spark/Hive) see the proper type; from_arrow
+    normalizes whatever unit comes back to epoch ms."""
     for i, f in enumerate(at.schema):
         if pa.types.is_dictionary(f.type):
             at = at.set_column(
                 i, pa.field(f.name, f.type.value_type, metadata=f.metadata),
                 at.column(i).cast(f.type.value_type))
-        elif pa.types.is_timestamp(f.type):
-            at = at.set_column(
-                i, pa.field(f.name, pa.int64(), metadata=f.metadata),
-                at.column(i).cast(pa.int64()))
     return at

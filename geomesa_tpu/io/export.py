@@ -277,17 +277,19 @@ def _shp_record(code: int, data):
 def _dbf_fields(sft):
     """(name, type, width, decimals, formatter) per non-geometry attr."""
     out = []
-    seen = {}
+    taken = set()
     for a in sft.attributes:
         if a.is_geometry:
             continue
-        name = a.name[:10]
         # DBF names are 10 chars: unique the truncations or the reader
-        # merges colliding columns into interleaved garbage
-        if name in seen:
-            seen[name] += 1
-            name = f"{name[:10 - len(str(seen[name]))]}{seen[name]}"
-        seen.setdefault(name, 0)
+        # merges colliding columns into interleaved garbage. Loop because a
+        # renamed candidate can itself collide (attribute1/attribute12)
+        base10 = a.name[:10]
+        name, k = base10, 0
+        while name in taken:
+            k += 1
+            name = f"{base10[:10 - len(str(k))]}{k}"
+        taken.add(name)
         if a.type_name in ("Int", "Integer", "Long"):
             # width 20 holds any int64 incl. the sign; never slice digits
             out.append((name, b"N", 20, 0,
@@ -360,9 +362,12 @@ def _shapefile(table: FeatureTable, path: str) -> str:
     rec_size = 1 + sum(w for _, _, w, _, _ in fields)
     attrs = [a for a in table.sft.attributes if not a.is_geometry]
     with open(base + ".dbf", "wb") as f:
+        import datetime
+        today = datetime.date.today()
         hdr_size = 32 + 32 * len(fields) + 1
-        f.write(struct.pack("<BBBBIHH20x", 3, 26, 7, 30, n, hdr_size,
-                            rec_size))
+        # header date bytes are (years since 1900, month, day)
+        f.write(struct.pack("<BBBBIHH20x", 3, today.year - 1900, today.month,
+                            today.day, n, hdr_size, rec_size))
         for name, typ, width, dec, _fmt in fields:
             f.write(name.encode("ascii", "replace")[:11].ljust(11, b"\x00")
                     + typ + b"\x00" * 4

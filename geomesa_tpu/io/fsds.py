@@ -319,16 +319,6 @@ class FileSystemStorage:
         import pyarrow.parquet as pq
         return pq.read_table(path, columns=columns)
 
-    def _file_columns(self, path: str) -> List[str]:
-        """Column names stored in a file (attributes + the fid sidecar)."""
-        if self.encoding == "orc":
-            from pyarrow import orc
-            sch = orc.ORCFile(path).schema
-        else:
-            import pyarrow.parquet as pq
-            sch = pq.ParquetFile(path).schema_arrow
-        return list(sch.names)
-
     # -- writes --------------------------------------------------------------
 
     def write(self, table: FeatureTable) -> Dict[str, int]:
@@ -400,15 +390,17 @@ class FileSystemStorage:
                         continue
                     # phase 2: only the columns phase 1 didn't read — the
                     # already-hydrated filter columns append at arrow level
-                    # (never re-read; never decode non-matching rows)
-                    rest = [n for n in self._file_columns(fp)
-                            if n not in set(pnames)]
-                    at = self._read_file(fp, columns=rest).take(rows) \
-                        if rest else at1.take(rows)
-                    if rest:
-                        for name in pnames:
-                            at = at.append_column(at1.schema.field(name),
-                                                  at1.column(name).take(rows))
+                    # (never re-read; never decode non-matching rows). Files
+                    # always store __fid__ + every attribute (to_arrow), so
+                    # the remainder is schema-derived and never empty (proj
+                    # is a strict attribute subset and __fid__ remains)
+                    rest = [c for c in
+                            ["__fid__"] + [a.name for a in self.sft.attributes]
+                            if c not in set(pnames)]
+                    at = self._read_file(fp, columns=rest).take(rows)
+                    for name in pnames:
+                        at = at.append_column(at1.schema.field(name),
+                                              at1.column(name).take(rows))
                     t = from_arrow(at, self.sft)
                 else:
                     # filter needs more than attribute columns (fids) or an
