@@ -24,7 +24,7 @@ import io
 import json
 import struct
 import zlib
-from typing import BinaryIO, Dict, List, Tuple
+from typing import BinaryIO, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -115,8 +115,109 @@ def _read_value(buf: BinaryIO, schema):
     raise ValueError(f"Unsupported Avro schema {schema!r}")
 
 
-def read_avro_records(path_or_bytes) -> Tuple[List[dict], dict]:
-    """Container file → (records, schema dict)."""
+def resolve_schema(records: List[dict], writer: dict,
+                   reader: dict) -> List[dict]:
+    """Avro schema resolution (the reader-schema half of the spec's schema
+    evolution rules; ≙ geomesa-feature-avro's version-mismatch readers):
+    fields match by name or reader ``aliases``; reader-only fields take
+    their ``default`` (required by the spec — missing default raises);
+    writer-only fields drop; numeric promotions int→long→float→double and
+    string↔bytes apply."""
+    if reader.get("type") != "record":
+        raise ValueError("Reader schema must be a record")
+    wtypes = {fd["name"]: fd["type"] for fd in writer.get("fields", [])}
+    plan = []  # (out_name, source_name | None, promote, default)
+    for fd in reader["fields"]:
+        names = [fd["name"]] + list(fd.get("aliases", []))
+        src = next((nm for nm in names if nm in wtypes), None)
+        if src is None:
+            if "default" not in fd:
+                raise ValueError(
+                    f"Reader field {fd['name']!r} absent from writer "
+                    "schema and has no default")
+            plan.append((fd["name"], None, None, fd["default"]))
+            continue
+        plan.append((fd["name"], src,
+                     _promotion(wtypes[src], fd["type"]), None))
+    out = []
+    for rec in records:
+        out.append({name: (default if src is None
+                           else promote(rec[src]) if promote
+                           else rec[src])
+                    for name, src, promote, default in plan})
+    return out
+
+
+def _base(t):
+    if isinstance(t, dict):
+        t = t.get("type")
+    return t
+
+
+def _promotion(wt, rt):
+    """Value promotion fn for (writer type, reader type), or None.
+
+    Unions resolve per the spec: a writer union's datum resolves against
+    its matching branch (values here are already decoded, so the ubiquitous
+    nullable pattern ["null", T] maps null→null when the reader accepts
+    null, and promotes non-null data via the T branch)."""
+    w, r = _base(wt), _base(rt)
+    if isinstance(w, list):
+        wbranches = [_base(b) for b in w]
+        rbranches = [_base(b) for b in r] if isinstance(r, list) else [r]
+        if set(wbranches) <= set(rbranches):
+            return None  # every writer branch acceptable as-is
+        nonnull = [b for b in wbranches if b != "null"]
+        if len(nonnull) != 1:
+            raise ValueError(
+                f"Cannot resolve writer union {wbranches} to reader {r!r}")
+        null_ok = "null" in rbranches
+        target = next((b for b in rbranches if b != "null"), None)
+        inner = _promotion(nonnull[0], target)
+
+        def resolve(v, _inner=inner, _null_ok=null_ok):
+            if v is None:
+                if _null_ok:
+                    return None
+                raise ValueError(
+                    "null datum cannot resolve to a non-nullable reader type")
+            return _inner(v) if _inner else v
+
+        return resolve
+    if isinstance(r, list):
+        rbranches = [_base(b) for b in r]
+        if w in rbranches:
+            return None
+        for b in rbranches:  # first promotable branch wins (spec order)
+            if b == "null":
+                continue
+            try:
+                return _promotion(w, b)
+            except ValueError:
+                continue
+        raise ValueError(f"Cannot resolve writer {w!r} to reader union {r!r}")
+    if w == r or not isinstance(r, str):
+        return None
+    if w == "int" and r == "long":
+        return int
+    if w in ("int", "long") and r in ("float", "double"):
+        return float
+    if w == "float" and r == "double":
+        return float
+    if w == "string" and r == "bytes":
+        return lambda v: v.encode("utf-8")
+    if w == "bytes" and r == "string":
+        return lambda v: v.decode("utf-8")
+    raise ValueError(f"Cannot resolve writer type {w!r} to reader {r!r}")
+
+
+def read_avro_records(path_or_bytes,
+                      reader_schema: Optional[dict] = None
+                      ) -> Tuple[List[dict], dict]:
+    """Container file → (records, schema dict). With ``reader_schema``,
+    records project through Avro schema resolution (evolution: renamed/
+    added/removed fields, numeric promotions) and the reader schema is
+    returned."""
     if isinstance(path_or_bytes, (bytes, bytearray)):
         f = io.BytesIO(path_or_bytes)
     else:
@@ -160,6 +261,9 @@ def read_avro_records(path_or_bytes) -> Tuple[List[dict], dict]:
             for _ in range(count):
                 records.append({fd["name"]: _read_value(b, fd["type"])
                                 for fd in fields})
+        if reader_schema is not None:
+            return resolve_schema(records, schema, reader_schema), \
+                reader_schema
         return records, schema
     finally:
         f.close()
@@ -280,10 +384,13 @@ def write_avro(table, path: str, codec: str = "deflate") -> None:
         f.write(bytes(out))
 
 
-def read_avro_columns(path_or_bytes) -> Dict[str, np.ndarray]:
+def read_avro_columns(path_or_bytes,
+                      reader_schema: Optional[dict] = None
+                      ) -> Dict[str, np.ndarray]:
     """Container file → field columns (object arrays; timestamp-millis
-    logical values stay as int64 epoch millis — the Date convention)."""
-    records, schema = read_avro_records(path_or_bytes)
+    logical values stay as int64 epoch millis — the Date convention).
+    ``reader_schema`` engages schema resolution (see read_avro_records)."""
+    records, schema = read_avro_records(path_or_bytes, reader_schema)
     names = [fd["name"] for fd in schema["fields"]]
     return {name: np.asarray([r.get(name) for r in records], dtype=object)
             for name in names}

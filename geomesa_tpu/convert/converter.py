@@ -165,6 +165,28 @@ class SimpleFeatureConverter:
             return self._empty()
         return self._convert(cols, len(next(iter(cols.values()))))
 
+    def convert_osm(self, text_or_path: str,
+                    element: str = "node") -> FeatureTable:
+        """OpenStreetMap XML ingest (≙ geomesa-convert-osm): nodes as
+        points (id/lon/lat/user/timestamp/tags fields) or ways as resolved
+        LineString WKT in a ``geometry`` field; ``tags`` is JSON text for
+        the jsonPath expression function."""
+        from geomesa_tpu.convert.formats import read_osm
+        cols = read_osm(text_or_path, element)
+        if not cols or not len(next(iter(cols.values()))):
+            return self._empty()
+        return self._convert(cols, len(next(iter(cols.values()))))
+
+    def convert_jdbc(self, conn_or_path, sql: str) -> FeatureTable:
+        """SQL ingest (≙ geomesa-convert-jdbc): result-set columns become
+        field refs by name. ``conn_or_path``: sqlite3 path / jdbc:sqlite:
+        URL, or any DB-API connection."""
+        from geomesa_tpu.convert.formats import read_jdbc
+        cols = read_jdbc(conn_or_path, sql)
+        if not cols or not len(next(iter(cols.values()))):
+            return self._empty()
+        return self._convert(cols, len(next(iter(cols.values()))))
+
     def convert_fixed_width(self, text_or_path: str,
                             fields) -> FeatureTable:
         """Fixed-width text ingest (≙ geomesa-convert-fixedwidth).

@@ -204,3 +204,95 @@ def _read_dbf(path: str) -> Dict[str, np.ndarray]:
         else:
             out[name] = np.asarray(vals, dtype=object)
     return out
+
+
+def read_osm(text_or_path: str, element: str = "node") -> Dict[str, np.ndarray]:
+    """OpenStreetMap XML → columns (≙ geomesa-convert-osm's osm4j frontend).
+
+    element='node': one row per node — id, lon, lat, user, timestamp, and
+    ``tags`` as a JSON text column (individual keys reach transforms via
+    the jsonPath expression function).
+    element='way': one row per way — id, user, timestamp, tags, and
+    ``geometry`` as LineString WKT resolved from the way's node refs
+    (ways referencing unknown nodes are dropped, as the reference does
+    when its node cache misses).
+    """
+    import json as _json
+    import xml.etree.ElementTree as ET
+
+    if os.path.exists(text_or_path):
+        root = ET.parse(text_or_path).getroot()
+    else:
+        root = ET.fromstring(text_or_path)
+    if element not in ("node", "way"):
+        raise ValueError("element must be 'node' or 'way'")
+
+    def tags_of(el):
+        return _json.dumps({t.get("k"): t.get("v")
+                            for t in el.findall("tag")})
+
+    cols: Dict[str, list] = {k: [] for k in
+                             ("id", "user", "timestamp", "tags")}
+    if element == "node":
+        cols["lon"] = []
+        cols["lat"] = []
+        for nd in root.findall("node"):
+            cols["id"].append(nd.get("id", ""))
+            cols["lon"].append(float(nd.get("lon", "nan")))
+            cols["lat"].append(float(nd.get("lat", "nan")))
+            cols["user"].append(nd.get("user", ""))
+            cols["timestamp"].append(nd.get("timestamp", ""))
+            cols["tags"].append(tags_of(nd))
+    else:
+        nodes = {nd.get("id"): (nd.get("lon"), nd.get("lat"))
+                 for nd in root.findall("node")}
+        cols["geometry"] = []
+        for way in root.findall("way"):
+            refs = [nd.get("ref") for nd in way.findall("nd")]
+            pts = [nodes.get(r) for r in refs]
+            if len(pts) < 2 or any(p is None for p in pts):
+                continue  # unresolvable way: node cache miss
+            cols["id"].append(way.get("id", ""))
+            cols["user"].append(way.get("user", ""))
+            cols["timestamp"].append(way.get("timestamp", ""))
+            cols["tags"].append(tags_of(way))
+            cols["geometry"].append(
+                "LINESTRING (" + ", ".join(f"{x} {y}" for x, y in pts) + ")")
+    out: Dict[str, np.ndarray] = {}
+    for k, v in cols.items():
+        out[k] = np.asarray(v, dtype=np.float64 if k in ("lon", "lat")
+                            else object)
+    return out
+
+
+def read_jdbc(conn_or_path, sql: str) -> Dict[str, np.ndarray]:
+    """SQL query → columns (≙ geomesa-convert-jdbc, which executes a
+    statement per input and feeds rows through the converter; the bundled
+    driver here is the stdlib sqlite3 — pass a Connection for anything
+    DB-API compatible)."""
+    import sqlite3
+
+    close = False
+    if isinstance(conn_or_path, (str, os.PathLike)):
+        path = str(conn_or_path)
+        if path.startswith("jdbc:sqlite:"):
+            path = path[len("jdbc:sqlite:"):]
+        conn = sqlite3.connect(path)
+        close = True
+    else:
+        conn = conn_or_path
+    try:
+        cur = conn.cursor()  # DB-API form (Connection.execute is sqlite-only)
+        try:
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            cur.close()
+    finally:
+        if close:
+            conn.close()
+    out: Dict[str, np.ndarray] = {}
+    for i, name in enumerate(names):
+        out[name] = np.asarray([r[i] for r in rows], dtype=object)
+    return out

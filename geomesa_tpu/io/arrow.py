@@ -234,11 +234,24 @@ def orc_compatible(at: "pa.Table") -> "pa.Table":
     """Arrow table reshaped for the ORC writer: dictionary columns cast to
     their value type (ORC has no dictionary encoding; its RLE recovers the
     compression on disk). Timestamps write as real ORC timestamps so
-    external readers (Spark/Hive) see the proper type; from_arrow
-    normalizes whatever unit comes back to epoch ms."""
+    external readers (Spark/Hive) see the proper type — EXCEPT columns with
+    values outside the ns-representable range (1677..2262; far-future
+    sentinels like 9999-12-31 are common), which fall back to int64 ms;
+    from_arrow normalizes either representation back to epoch ms."""
+    import pyarrow.compute as pc
+
+    ns_lo = -9_223_372_036_854  # ms bounds of the int64-ns epoch range
+    ns_hi = 9_223_372_036_854
     for i, f in enumerate(at.schema):
         if pa.types.is_dictionary(f.type):
             at = at.set_column(
                 i, pa.field(f.name, f.type.value_type, metadata=f.metadata),
                 at.column(i).cast(f.type.value_type))
+        elif pa.types.is_timestamp(f.type):
+            ms = at.column(i).cast(pa.timestamp("ms")).cast(pa.int64())
+            lo = pc.min(ms).as_py()
+            hi = pc.max(ms).as_py()
+            if lo is not None and (lo < ns_lo or hi > ns_hi):
+                at = at.set_column(
+                    i, pa.field(f.name, pa.int64(), metadata=f.metadata), ms)
     return at

@@ -1,0 +1,129 @@
+"""MongoDB-style JSON query DSL → filter IR.
+
+≙ reference ``GeoJsonQuery`` (geomesa-geojson-api/.../query/GeoJsonQuery.
+scala:30-60), the JSON query language of the GeoJSON REST API:
+
+    {}                                        → INCLUDE
+    { "foo" : "bar" }                         → foo = 'bar'
+    { "foo" : { "$lt" : 10 } }                → foo < 10   ($lte/$gt/$gte/
+                                                 $ne/$in analogous)
+    { "geometry" : { "$bbox" : [x0,y0,x1,y1] } }
+    { "geometry" : { "$intersects" : { "$geometry" : <geojson> } } }
+    { "geometry" : { "$within" | "$contains" : { "$geometry" : ... } } }
+    { "geometry" : { "$dwithin" : { "$geometry" : ..., "$dist" : 100,
+                                    "$unit" : "meters" } } }
+    { "$or" : [ q1, q2 ] }                    → q1 OR q2
+    multiple keys in one object               → AND
+
+Property names starting with ``$.`` (JSON-path style) strip the prefix —
+attributes here are real SFT columns, not nested documents. ``geometry``
+maps to the type's default geometry attribute.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Union
+
+from geomesa_tpu.features import geometry as geo
+from geomesa_tpu.filter import ir
+
+# $dwithin unit → degrees at the equator (the exact-refine predicates work
+# in degree space, matching the ECQL DWITHIN path)
+_UNIT_TO_DEG = {
+    "degrees": 1.0,
+    "meters": 1.0 / 111_320.0,
+    "kilometers": 1.0 / 111.32,
+    "feet": 0.3048 / 111_320.0,
+    "miles": 1609.344 / 111_320.0,
+}
+
+_CMP_OPS = {"$lt": "<", "$lte": "<=", "$gt": ">", "$gte": ">=", "$ne": "<>"}
+
+
+def parse_json_query(q: Union[str, dict, None], sft) -> ir.Filter:
+    """JSON query (text or parsed) → filter IR against ``sft``."""
+    if q is None:
+        return ir.Include()
+    if isinstance(q, (str, bytes)):
+        q = json.loads(q or "{}")
+    if not isinstance(q, dict):
+        raise ValueError("JSON query must be an object")
+    return _evaluate(q, sft)
+
+
+def _evaluate(obj: dict, sft) -> ir.Filter:
+    if not obj:
+        return ir.Include()
+    preds = []
+    for prop, v in obj.items():
+        if prop == "$or":
+            if not isinstance(v, list):
+                raise ValueError("$or expects an array of query objects")
+            preds.append(ir.or_filters([_evaluate(o, sft) for o in v]))
+        elif prop == "$and":
+            if not isinstance(v, list):
+                raise ValueError("$and expects an array of query objects")
+            preds.append(ir.and_filters([_evaluate(o, sft) for o in v]))
+        elif isinstance(v, dict):
+            preds.append(_predicate(_attr(prop, sft), v))
+        else:
+            preds.append(ir.Cmp("=", _attr(prop, sft), v))
+    return ir.and_filters(preds)
+
+
+def _attr(prop: str, sft) -> str:
+    if prop.startswith("$."):
+        prop = prop[2:]
+    if prop == "geometry" and sft.geometry_attribute is not None:
+        return sft.geometry_attribute.name
+    return prop
+
+
+def _predicate(attr: str, obj: dict) -> ir.Filter:
+    """All operators on one field AND together ({"$gte": 1, "$lt": 10} is a
+    range, not just its first bound)."""
+    if not obj:
+        raise ValueError(f"Empty predicate for {attr!r}")
+    return ir.and_filters([_one_op(attr, op, v) for op, v in obj.items()])
+
+
+def _one_op(attr: str, op: str, v) -> ir.Filter:
+    if op in _CMP_OPS:
+        return ir.Cmp(_CMP_OPS[op], attr, v)
+    if op == "$in":
+        if not isinstance(v, list):
+            raise ValueError("$in expects an array")
+        return ir.In(attr, tuple(v))
+    if op == "$bbox":
+        if not (isinstance(v, list) and len(v) == 4):
+            raise ValueError("$bbox expects [xmin, ymin, xmax, ymax]")
+        return ir.BBox(attr, float(v[0]), float(v[1]), float(v[2]),
+                       float(v[3]))
+    if op in ("$intersects", "$within", "$contains"):
+        cls = {"$intersects": ir.Intersects, "$within": ir.Within,
+               "$contains": ir.Contains}[op]
+        return cls(attr, _geometry(v))
+    if op == "$dwithin":
+        dist = v.get("$dist") if isinstance(v, dict) else None
+        if dist is None:
+            raise ValueError("$dwithin needs a $dist")
+        unit = str(v.get("$unit", "degrees")).lower()
+        if unit not in _UNIT_TO_DEG:
+            raise ValueError(f"Unknown $unit {unit!r} "
+                             f"(have {sorted(_UNIT_TO_DEG)})")
+        return ir.Dwithin(attr, _geometry(v),
+                          float(dist) * _UNIT_TO_DEG[unit])
+    raise ValueError(f"Unknown operator {op!r} for {attr!r}")
+
+
+def _geometry(obj) -> tuple:
+    """``{"$geometry": {"type": ..., "coordinates": ...}}`` → IR geometry
+    tuple (type_code, nested coordinate lists)."""
+    if not isinstance(obj, dict) or "$geometry" not in obj:
+        raise ValueError("Expected an object with a $geometry key")
+    g = obj["$geometry"]
+    name = str(g.get("type", ""))
+    if name not in geo.NAME_TYPES:
+        raise ValueError(f"Unknown geometry type {name!r}")
+    return (geo.NAME_TYPES[name], g.get("coordinates"))

@@ -61,6 +61,11 @@ class GeoJsonApi:
                 return 404, {"error": f"no such type {t!r}"}
             rest = parts[2:]
             cql = query.get("cql", ["INCLUDE"])[0]
+            if "q" in query:
+                # MongoDB-style JSON query (≙ the geojson API's GeoJsonQuery
+                # language) — takes precedence over ?cql=
+                from geomesa_tpu.web.jsonquery import parse_json_query
+                cql = parse_json_query(query["q"][0], self.store.get_schema(t))
             auths = query["auths"][0].split(",") if "auths" in query else None
             if not rest:
                 sft = self.store.get_schema(t)

@@ -279,3 +279,130 @@ def test_json_path_in_converter_and_transform_hint():
     r = ds.query("jq", "INCLUDE",
                  hints={"transform": ["kk=jsonPath('$.k', $doc)"]})
     assert sorted(np.asarray(r.table.columns["kk"]).tolist()) == [1, 2]
+
+
+# -- OSM / JDBC converters + Avro schema evolution ---------------------------
+
+
+OSM_XML = """<?xml version="1.0" encoding="UTF-8"?>
+<osm version="0.6">
+ <node id="1" lat="48.1" lon="11.5" user="u1" timestamp="2024-01-01T00:00:00Z">
+  <tag k="amenity" v="cafe"/><tag k="name" v="A"/>
+ </node>
+ <node id="2" lat="48.2" lon="11.6"/>
+ <node id="3" lat="48.3" lon="11.7"/>
+ <way id="10" user="u2"><nd ref="1"/><nd ref="2"/><nd ref="3"/>
+  <tag k="highway" v="residential"/></way>
+ <way id="11"><nd ref="1"/><nd ref="99"/></way>
+</osm>"""
+
+
+def test_osm_nodes_to_points():
+    from geomesa_tpu.convert import SimpleFeatureConverter
+    sft = SimpleFeatureType.from_spec("osm", "name:String,*geom:Point")
+    conv = SimpleFeatureConverter({
+        "type": "osm", "id-field": "$id",
+        "fields": [
+            {"name": "name",
+             "transform": "withDefault(jsonPath('$.name', $tags), '')"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ]}, sft)
+    t = conv.convert_osm(OSM_XML, "node")
+    assert len(t) == 3
+    x, y = t.geometry().point_xy()
+    np.testing.assert_allclose(x, [11.5, 11.6, 11.7])
+    assert list(t.fids) == ["1", "2", "3"]
+    names = t.columns["name"]
+    assert names.vocab[names.codes[0]] == "A"  # tag extracted via jsonPath
+
+
+def test_osm_ways_to_linestrings():
+    from geomesa_tpu.convert import SimpleFeatureConverter
+    sft = SimpleFeatureType.from_spec("roads", "*geom:LineString")
+    conv = SimpleFeatureConverter({
+        "type": "osm", "id-field": "$id",
+        "fields": [{"name": "geom", "transform": "geometry($geometry)"}]},
+        sft)
+    t = conv.convert_osm(OSM_XML, "way")
+    # way 11 references a missing node: dropped like a node-cache miss
+    assert len(t) == 1 and list(t.fids) == ["10"]
+    bb = t.geometry().bboxes()[0]
+    np.testing.assert_allclose(bb, [11.5, 48.1, 11.7, 48.3])
+
+
+def test_jdbc_converter_sqlite():
+    import sqlite3
+
+    from geomesa_tpu.convert import SimpleFeatureConverter
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE pts (name TEXT, x REAL, y REAL, v INTEGER)")
+    conn.executemany("INSERT INTO pts VALUES (?,?,?,?)",
+                     [("a", 1.0, 2.0, 7), ("b", 3.0, 4.0, 9)])
+    sft = SimpleFeatureType.from_spec("db", "name:String,v:Int,*geom:Point")
+    conv = SimpleFeatureConverter({
+        "type": "jdbc",
+        "fields": [
+            {"name": "name", "transform": "$name"},
+            {"name": "v", "transform": "toInt($v)"},
+            {"name": "geom", "transform": "point($x, $y)"},
+        ]}, sft)
+    t = conv.convert_jdbc(conn, "SELECT name, x, y, v FROM pts ORDER BY name")
+    assert len(t) == 2
+    assert np.asarray(t.columns["v"]).tolist() == [7, 9]
+    x, y = t.geometry().point_xy()
+    np.testing.assert_allclose(x, [1.0, 3.0])
+
+
+def test_avro_schema_evolution():
+    from geomesa_tpu.convert.avro import (read_avro_columns,
+                                          read_avro_records, write_avro)
+    from geomesa_tpu.features.table import FeatureTable
+    sft = SimpleFeatureType.from_spec("ev", "name:String,v:Int,*geom:Point")
+    t = FeatureTable.build(sft, {
+        "name": ["a", "b"], "v": np.array([1, 2], np.int32),
+        "geom": ([1.0, 2.0], [3.0, 4.0])})
+    import tempfile, os
+    p = os.path.join(tempfile.mkdtemp(), "ev.avro")
+    write_avro(t, p)
+    # reader schema: v promoted to double, name renamed via alias,
+    # new field with default, writer-only geometry dropped
+    reader = {"type": "record", "name": "ev2", "fields": [
+        {"name": "label", "aliases": ["name"], "type": "string"},
+        {"name": "v", "type": "double"},
+        {"name": "source", "type": "string", "default": "legacy"},
+    ]}
+    recs, schema = read_avro_records(p, reader_schema=reader)
+    assert schema is reader
+    assert recs[0] == {"label": "a", "v": 1.0, "source": "legacy"}
+    assert isinstance(recs[1]["v"], float)
+    assert "geom" not in recs[0]
+    cols = read_avro_columns(p, reader_schema=reader)
+    assert set(cols) == {"label", "v", "source"}
+    # a reader field with no default and no writer match must raise
+    bad = {"type": "record", "name": "x", "fields": [
+        {"name": "nope", "type": "string"}]}
+    with pytest.raises(ValueError):
+        read_avro_records(p, reader_schema=bad)
+
+
+def test_avro_evolution_resolves_nullable_unions():
+    from geomesa_tpu.convert.avro import _promotion, resolve_schema
+    # nullable writer -> nullable reader with promotion
+    fn = _promotion(["null", "int"], ["null", "double"])
+    assert fn(3) == 3.0 and isinstance(fn(3), float) and fn(None) is None
+    # nullable writer -> non-nullable reader: nulls must raise at read
+    fn = _promotion(["null", "string"], "string")
+    assert fn("x") == "x"
+    with pytest.raises(ValueError):
+        fn(None)
+    # identical unions pass through untouched
+    assert _promotion(["null", "string"], ["null", "string"]) is None
+    # plain writer -> reader union picks the promotable branch
+    fn = _promotion("int", ["null", "long"])
+    assert fn is None or fn(1) == 1
+    writer = {"type": "record", "name": "w", "fields": [
+        {"name": "a", "type": ["null", "int"]}]}
+    reader = {"type": "record", "name": "r", "fields": [
+        {"name": "a", "type": ["null", "double"]}]}
+    out = resolve_schema([{"a": 5}, {"a": None}], writer, reader)
+    assert out == [{"a": 5.0}, {"a": None}]

@@ -171,3 +171,68 @@ def test_concurrent_ingest_and_query_stress():
     # the delta path (not a full rebuild per batch) absorbed the writes
     assert ds.count("c", f"BBOX(geom, -0.5, -0.5, {n_writers}.5, 10.5)") \
         >= n_writers * per_writer * batch
+
+
+# -- JSON query DSL (≙ GeoJsonQuery language) --------------------------------
+
+
+def test_json_query_parser_shapes():
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.filter import ir
+    from geomesa_tpu.web.jsonquery import parse_json_query
+
+    sft = SimpleFeatureType.from_spec("t", "name:String,v:Int,dtg:Date,"
+                                           "*geom:Point")
+    f = parse_json_query("{}", sft)
+    assert isinstance(f, ir.Include)
+    f = parse_json_query('{"name": "bar"}', sft)
+    assert f == ir.Cmp("=", "name", "bar")
+    f = parse_json_query('{"v": {"$lt": 10}, "name": "a"}', sft)
+    assert isinstance(f, ir.And) and len(f.children) == 2
+    f = parse_json_query('{"$or": [{"name": "a"}, {"v": 10}]}', sft)
+    assert isinstance(f, ir.Or)
+    f = parse_json_query('{"$.v": {"$in": [1, 2, 3]}}', sft)
+    assert f == ir.In("v", (1, 2, 3))
+    # "geometry" maps to the default geometry attribute
+    f = parse_json_query('{"geometry": {"$bbox": [-10, -5, 10, 5]}}', sft)
+    assert f == ir.BBox("geom", -10, -5, 10, 5)
+    f = parse_json_query(
+        '{"geometry": {"$intersects": {"$geometry": '
+        '{"type": "Point", "coordinates": [30, 10]}}}}', sft)
+    assert isinstance(f, ir.Intersects) and f.attr == "geom"
+    f = parse_json_query(
+        '{"geometry": {"$dwithin": {"$geometry": '
+        '{"type": "Point", "coordinates": [0, 0]}, '
+        '"$dist": 111320, "$unit": "meters"}}}', sft)
+    assert isinstance(f, ir.Dwithin)
+    assert f.distance == pytest.approx(1.0)  # 111.32 km ~ 1 degree
+    for bad in ('{"v": {"$frob": 3}}', '[1]',
+                '{"geometry": {"$bbox": [1, 2]}}',
+                '{"geometry": {"$intersects": {"nope": 1}}}'):
+        with pytest.raises(ValueError):
+            parse_json_query(bad, sft)
+
+
+def test_json_query_over_rest(server):
+    base, ds, x, y = server
+    q = urllib.parse.quote(
+        '{"geometry": {"$bbox": [-5, -5, 5, 5]}, "v": {"$lt": 50}}')
+    status, body = _get(f"{base}/types/w/count?q={q}")
+    assert status == 200
+    v = np.asarray(ds.tables["w"].columns["v"])
+    ref = int(np.sum((x >= -5) & (x <= 5) & (y >= -5) & (y <= 5) & (v < 50)))
+    assert body["count"] == ref
+    # features endpoint honors the same q
+    status, fc = _get(f"{base}/types/w/features?q={q}&limit=5")
+    assert status == 200 and len(fc["features"]) == min(5, ref)
+    # $or of two names
+    q2 = urllib.parse.quote('{"$or": [{"name": "a"}, {"name": "b"}]}')
+    status, body = _get(f"{base}/types/w/count?q={q2}")
+    assert body["count"] == 5000
+    # malformed query -> 400, not a server error
+    try:
+        status, body = _get(f"{base}/types/w/count?q=" + urllib.parse.quote(
+            '{"v": {"$nope": 1}}'))
+    except urllib.error.HTTPError as e:
+        status, body = e.code, json.loads(e.read())
+    assert status == 400 and "error" in body
