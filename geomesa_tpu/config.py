@@ -85,6 +85,12 @@ DEVICE_SORT_MIN = _register(
     "GEOMESA_TPU_DEVICE_SORT_MIN", 2_000_000, int,
     "Row count above which index sorts run on the accelerator.")
 
+BUILD_STREAM_CHUNK = _register(
+    "GEOMESA_TPU_BUILD_STREAM_CHUNK", 16_777_216, int,
+    "Rows per chunk for the streamed native build: the C++ encoder works "
+    "on chunk i+1 while chunk i uploads in a background thread (encode and "
+    "host->device transfer overlap instead of summing).")
+
 LSM_MAX_FRACTION = _register(
     "GEOMESA_TPU_LSM_MAX_FRAC", 0.02, float,
     "Delta-run flush threshold as a fraction of the main table.")

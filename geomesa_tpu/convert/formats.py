@@ -285,6 +285,10 @@ def read_jdbc(conn_or_path, sql: str) -> Dict[str, np.ndarray]:
         cur = conn.cursor()  # DB-API form (Connection.execute is sqlite-only)
         try:
             cur.execute(sql)
+            if cur.description is None:
+                raise ValueError(
+                    f"Statement returned no result set (ingest needs a "
+                    f"SELECT): {sql[:80]!r}")
             names = [d[0] for d in cur.description]
             rows = cur.fetchall()
         finally:

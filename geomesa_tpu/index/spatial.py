@@ -52,6 +52,77 @@ def __getattr__(name: str):
 _MASK21 = (1 << 21) - 1
 
 
+def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int):
+    """Chunked native encode overlapped with host→device upload.
+
+    ≙ the latency-hiding of the reference's ``AbstractBatchScan`` pipeline
+    (SURVEY §2.12 row 8), applied to the build path: a background thread
+    streams chunk i's planes to the device while the C++ encoder (which
+    releases the GIL) works on chunk i+1, so encode time and transfer time
+    overlap instead of summing. Per-plane chunks concatenate ON DEVICE
+    (transient ~2x HBM for the planes, freed before the sort gather).
+
+    ``encode_chunk(lo, hi)`` → plane dict or None (native decline).
+    Returns ({plane: device array}, [host-kept chunk dicts]) or None when
+    any chunk declines — the caller falls back to the single-shot path.
+    """
+    import queue
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+    uploaded: List[dict] = []
+    state = {"error": None}
+
+    def uploader():
+        # a device_put failure (e.g. HBM OOM) must record the error and KEEP
+        # DRAINING: exiting early leaves the producer blocked forever on the
+        # bounded queue (deadlocked build, exception swallowed)
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if state["error"] is not None:
+                continue
+            try:
+                uploaded.append({k: jax.device_put(v)
+                                 for k, v in item.items()})
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                state["error"] = e
+
+    th = threading.Thread(target=uploader, daemon=True)
+    th.start()
+    host_kept: List[dict] = []
+    failed = False
+    try:
+        for a in range(0, n, chunk_rows):
+            if state["error"] is not None:
+                break
+            enc = encode_chunk(a, min(n, a + chunk_rows))
+            if enc is None:
+                failed = True
+                break
+            # z (and bin16 where present) stay host-side for range pruning;
+            # keep refs BEFORE the device put consumes the dict
+            host_kept.append({k: enc[k] for k in ("z", "bin16")
+                              if k in enc})
+            enc.pop("z", None)
+            q.put(enc)
+    finally:
+        q.put(None)
+        th.join()
+    if state["error"] is not None:
+        raise state["error"]
+    if failed or not uploaded:
+        return None
+    dev = {k: (uploaded[0][k] if len(uploaded) == 1
+               else jnp.concatenate([u[k] for u in uploaded]))
+           for k in uploaded[0]}
+    return dev, host_kept
+
+
 def _split63(v: np.ndarray) -> List[np.ndarray]:
     """Split non-negative int64 keys (< 2^63) into three 21-bit int32 planes
     (major → minor) so the device sort never needs 64-bit lanes."""
@@ -353,6 +424,31 @@ class BaseSpatialIndex:
         when unsupported — the numpy path runs instead."""
         return False
 
+    def _stream_build(self, encode_chunk, key_names: List[str], n: int,
+                      extra: Dict[str, np.ndarray]):
+        """Streamed native build when ``n`` crosses the chunk and
+        device-sort thresholds. True = built, False = a chunk declined the
+        native path (caller falls back to numpy), None = below thresholds
+        (caller runs the single-shot native path)."""
+        from geomesa_tpu import config as _cfg
+        chunk = _cfg.BUILD_STREAM_CHUNK.get()
+        if not (n > chunk
+                and n >= sys.modules[__name__].DEVICE_SORT_MIN_ROWS):
+            return None
+        import time as _time
+        t0 = _time.perf_counter()
+        res = _stream_encode_upload(encode_chunk, n, chunk)
+        if res is None:
+            return False
+        dev, host_kept = res
+        self._z = np.concatenate([h["z"] for h in host_kept])
+        if "bin16" in host_kept[0]:
+            self._bins = np.concatenate([h["bin16"] for h in host_kept])
+        self.build_stages = {"encode_upload_overlap_s": round(
+            _time.perf_counter() - t0, 2)}
+        self._finish_native(dev, key_names, extra)
+        return True
+
     def _finish_native(self, enc: dict, key_names: List[str],
                        extra: Dict[str, np.ndarray]) -> None:
         """Upload native-encoded planes, sort on device, gather.
@@ -639,16 +735,23 @@ class Z3Index(BaseSpatialIndex):
         x, y = garr.point_xy()
         ms = np.asarray(self.table.columns[self.dtg], dtype=np.int64)
         import time as _time
+
+        self._sfc = Z3SFC.apply(self.period)
+        extra = host_planes(self.table, self.period,
+                            skip_geom=True, skip_dtg=True)
+        streamed = self._stream_build(
+            lambda a, b: native.z3_encode(x[a:b], y[a:b], ms[a:b],
+                                          self.period.value),
+            ["bin16", "zhi", "zlo"], len(x), extra)
+        if streamed is not None:
+            return streamed
         t0 = _time.perf_counter()
         enc = native.z3_encode(x, y, ms, self.period.value)
         if enc is None:  # calendar periods stay on the numpy path
             return False
         self.build_stages = {"encode_s": round(_time.perf_counter() - t0, 2)}
-        self._sfc = Z3SFC.apply(self.period)
         self._z = enc["z"]
         self._bins = enc["bin16"]
-        extra = host_planes(self.table, self.period,
-                            skip_geom=True, skip_dtg=True)
         self._finish_native(enc, ["bin16", "zhi", "zlo"], extra)
         return True
 
@@ -705,11 +808,16 @@ class Z2Index(BaseSpatialIndex):
         if not (garr.is_points and native.available()):
             return False
         x, y = garr.point_xy()
+        extra = host_planes(self.table, self.period, skip_geom=True)
+        streamed = self._stream_build(
+            lambda a, b: native.z2_encode(x[a:b], y[a:b]),
+            ["zhi", "zlo"], len(x), extra)
+        if streamed is not None:
+            return streamed
         enc = native.z2_encode(x, y)
         if enc is None:
             return False
         self._z = enc["z"]
-        extra = host_planes(self.table, self.period, skip_geom=True)
         self._finish_native(enc, ["zhi", "zlo"], extra)
         return True
 

@@ -1,9 +1,11 @@
 """Feature export formats.
 
 ≙ reference export surface (tools/export/formats/ExportFormat.scala: arrow/
-avro/bin/csv/geojson/gml/json/leaflet/orc/parquet/shp/tsv/wkt). The formats
-that matter for a columnar TPU store: csv/tsv, geojson, json-lines, wkt,
-arrow IPC, parquet, npz (the checkpoint codec), bin (aggregates.bin)."""
+avro/bin/csv/geojson/gml/json/leaflet/orc/parquet/shp/tsv/wkt) — every
+format the reference CLI exports is covered: csv/tsv, geojson, json-lines,
+wkt, arrow IPC, parquet, avro, orc, gml, shp (ESRI shapefile), a
+self-contained leaflet HTML map, npz (the checkpoint codec), and bin via
+aggregates.bin."""
 
 from __future__ import annotations
 
@@ -18,7 +20,7 @@ from geomesa_tpu.features.geometry import GeometryArray
 from geomesa_tpu.features.table import FeatureTable, StringColumn
 
 FORMATS = ("csv", "tsv", "geojson", "json", "wkt", "arrow", "parquet",
-           "avro", "orc", "gml", "shp")
+           "avro", "orc", "gml", "shp", "leaflet")
 
 
 def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
@@ -61,6 +63,8 @@ def export(table: FeatureTable, fmt: str, path: Optional[str] = None):
         return path
     if fmt == "gml":
         return _gml(table, path)
+    if fmt == "leaflet":
+        return _leaflet(table, path)
     if fmt == "shp":
         if path is None:
             raise ValueError("shp export requires a path (base name)")
@@ -383,3 +387,59 @@ def _shapefile(table: FeatureTable, path: str) -> str:
             f.write(row)
         f.write(b"\x1a")
     return base + ".shp"
+
+
+# -- Leaflet map (self-contained HTML; ≙ LeafletMapExporter) -----------------
+
+
+_LEAFLET_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>geomesa-tpu export</title>
+<meta name="viewport" content="width=device-width, initial-scale=1.0"/>
+<link rel="stylesheet"
+ href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>html, body, #map {{ height: 100%; margin: 0; }}</style>
+</head>
+<body>
+<div id="map"></div>
+<script>
+var features = {geojson};
+var map = L.map('map');
+L.tileLayer('https://{{s}}.tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+            {{attribution: '&copy; OpenStreetMap contributors'}}).addTo(map);
+var layer = L.geoJSON(features, {{
+  pointToLayer: function (f, latlng) {{
+    return L.circleMarker(latlng, {{radius: 4}});
+  }},
+  onEachFeature: function (f, l) {{
+    var esc = function (s) {{
+      return String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+                      .replace(/>/g, '&gt;').replace(/"/g, '&quot;');
+    }};
+    var rows = Object.entries(f.properties || {{}}).map(
+      function (kv) {{ return esc(kv[0]) + ': ' + esc(kv[1]); }});
+    if (rows.length) l.bindPopup(rows.join('<br/>'));
+  }}
+}}).addTo(map);
+var b = layer.getBounds();
+if (b.isValid()) {{ map.fitBounds(b); }} else {{ map.setView([0, 0], 2); }}
+</script>
+</body>
+</html>
+"""
+
+
+def _leaflet(table: FeatureTable, path):
+    """Self-contained HTML map with the features embedded as GeoJSON (the
+    tile layer loads from OSM in the viewer's browser, as the reference's
+    template does). The embedded JSON escapes '</' so a string value
+    containing '</script>' can neither break the document nor inject
+    script; popup values HTML-escape browser-side."""
+    geojson = _geojson(table, None).replace("</", "<\\/")
+    doc = _LEAFLET_HTML.format(geojson=geojson)
+    f = _out(path)
+    f.write(doc)
+    return _finish(f, path)

@@ -2,12 +2,14 @@
 
 ≙ the reference's geomesa-fs module (SURVEY.md §2.6): a partition-scheme
 directory layout (Z2Scheme / DateTimeScheme / AttributeScheme /
-CompositeScheme, fs-storage-common/.../partitions/) over Parquet files, with
-metadata in a sidecar file, query-time partition pruning from the filter,
-and per-partition compaction (AbstractFileSystemStorage.scala:395).
+CompositeScheme, fs-storage-common/.../partitions/) over Parquet or ORC
+files (fs-storage-parquet / fs-storage-orc), with metadata in a sidecar
+file, query-time partition pruning from the filter, projection push-down
+on reads, and per-partition compaction
+(AbstractFileSystemStorage.scala:395).
 
 Layout:  root/_metadata.json
-         root/<partition>/<uuid>.parquet      (one file per write batch)
+         root/<partition>/<uuid>.parquet|.orc  (one file per write batch)
 
 Queries read ONLY the partitions the filter can touch (z2 cells from the
 bbox extraction, date buckets from the interval extraction, attribute

@@ -406,3 +406,12 @@ def test_avro_evolution_resolves_nullable_unions():
         {"name": "a", "type": ["null", "double"]}]}
     out = resolve_schema([{"a": 5}, {"a": None}], writer, reader)
     assert out == [{"a": 5.0}, {"a": None}]
+
+
+def test_jdbc_non_select_statement_rejected():
+    import sqlite3
+    from geomesa_tpu.convert.formats import read_jdbc
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE t (a INT)")
+    with pytest.raises(ValueError, match="no result set"):
+        read_jdbc(conn, "INSERT INTO t VALUES (1)")

@@ -121,3 +121,58 @@ def test_prepared_query_empty_and_host_paths():
     pq2 = planner2.prepare(q)
     assert not pq2.device_exact
     assert pq2.count() == planner2.count(q)
+
+
+@pytest.mark.parametrize("cls", [Z3Index, Z2Index])
+def test_streamed_build_matches_single_shot(monkeypatch, cls):
+    """Chunked encode+upload overlap must produce the identical device
+    table and perm as the single-shot native build."""
+    from geomesa_tpu import config
+    sft, table, raw = _point_table()
+    monkeypatch.setattr(spatial, "DEVICE_SORT_MIN_ROWS", 1)
+    single = cls(sft, table)
+    config.BUILD_STREAM_CHUNK.set(1000)  # ~10 chunks over the fixture
+    try:
+        streamed = cls(sft, table)
+    finally:
+        config.BUILD_STREAM_CHUNK.unset()
+    assert "encode_upload_overlap_s" in getattr(streamed, "build_stages", {})
+    np.testing.assert_array_equal(streamed.perm, single.perm)
+    np.testing.assert_array_equal(np.asarray(streamed._z),
+                                  np.asarray(single._z))
+    for k in single.device.columns:
+        np.testing.assert_array_equal(
+            np.asarray(streamed.device.columns[k]),
+            np.asarray(single.device.columns[k]), err_msg=k)
+    planner = QueryPlanner(sft, table, [streamed])
+    assert planner.count(ECQL) == int(_brute(*raw).sum())
+
+
+def test_streamed_build_declines_cleanly(monkeypatch):
+    """A chunk that the native encoder declines (bin overflow) must fall
+    back to the numpy path, not produce a partial index."""
+    from geomesa_tpu import config
+    rng = np.random.default_rng(13)
+    n = 5000
+    sft = SimpleFeatureType.from_spec(
+        "far", "dtg:Date,*geom:Point;geomesa.z3.interval=day")
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + rng.integers(0, 86400000, n)
+    dtg[4000:] = np.datetime64("2090-01-01T00:00:00", "ms").astype(np.int64)
+    x = rng.uniform(-170, 170, n)
+    y = rng.uniform(-80, 80, n)
+    table = FeatureTable.build(sft, {"dtg": dtg, "geom": (x, y)})
+    monkeypatch.setattr(spatial, "DEVICE_SORT_MIN_ROWS", 1)
+    config.BUILD_STREAM_CHUNK.set(1000)
+    try:
+        idx = Z3Index(sft, table)  # falls back internally
+    finally:
+        config.BUILD_STREAM_CHUNK.unset()
+    planner = QueryPlanner(sft, table, [idx])
+    lo = np.datetime64("2020-01-01T06:00:00", "ms").astype(np.int64)
+    hi = np.datetime64("2020-01-01T18:00:00", "ms").astype(np.int64)
+    q = ("BBOX(geom, -50, -40, 50, 40) AND dtg DURING "
+         "2020-01-01T06:00:00Z/2020-01-01T18:00:00Z")
+    want = int(np.sum((x >= -50) & (x <= 50) & (y >= -40) & (y <= 40)
+                      & (dtg > lo) & (dtg < hi)))
+    assert planner.count(q) == want

@@ -301,3 +301,29 @@ def test_export_shapefile_polygons(tmp_path):
     bb = garr.bboxes()
     np.testing.assert_allclose(bb[0], [0, 0, 4, 4])
     np.testing.assert_allclose(bb[1], [10, 10, 12, 12])
+
+
+def test_export_leaflet(store):
+    res = store.query("chk", "val < 5")
+    out = export(res.table, "leaflet")
+    assert out.startswith("<!DOCTYPE html>")
+    assert "L.geoJSON" in out and "FeatureCollection" in out
+    # the embedded GeoJSON round-trips
+    start = out.index("var features = ") + len("var features = ")
+    end = out.index(";\nvar map")
+    fc = json.loads(out[start:end])
+    assert len(fc["features"]) == res.count
+
+
+def test_export_leaflet_script_injection_blocked():
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    sft = SimpleFeatureType.from_spec("m", "name:String,*geom:Point")
+    t = FeatureTable.build(sft, {
+        "name": ["</script><script>alert(1)</script>"],
+        "geom": ([1.0], [2.0])})
+    out = export(t, "leaflet")
+    # the raw close-tag must not appear inside the embedded JSON
+    body = out[out.index("var features = "):]
+    assert "</script><script>" not in body.split("</body>")[0].replace(
+        "<\\/script>", "")
+    assert "<\\/script>" in out  # escaped form present instead
