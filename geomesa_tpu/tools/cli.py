@@ -184,6 +184,16 @@ def cmd_delete(args):
     print(f"Deleted {n} features")
 
 
+def cmd_age_off(args):
+    """Run the TTL compaction (≙ the reference's age-off maintenance
+    command over DtgAgeOffIterator-configured tables)."""
+    store = _load(args.store, must_exist=True)
+    n = store.age_off(args.feature)
+    if n:
+        _save(store, args.store)
+    print(f"Aged off {n} features")
+
+
 def cmd_config(args):
     from geomesa_tpu import config as cfg
     for name, d in cfg.describe().items():
@@ -281,6 +291,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("remove-schema", help="drop a feature type")
     common(sp)
     sp.set_defaults(fn=cmd_remove_schema)
+
+    sp = sub.add_parser(
+        "age-off", help="drop features past their geomesa.feature.expiry TTL")
+    common(sp)
+    sp.set_defaults(fn=cmd_age_off)
 
     sp = sub.add_parser("config", help="list system properties")
     sp.set_defaults(fn=cmd_config)

@@ -415,3 +415,32 @@ def test_jdbc_non_select_statement_rejected():
     conn.execute("CREATE TABLE t (a INT)")
     with pytest.raises(ValueError, match="no result set"):
         read_jdbc(conn, "INSERT INTO t VALUES (1)")
+
+
+def test_cli_age_off(tmp_path):
+    import time as _time
+    store = str(tmp_path / "aostore")
+    r = _cli(tmp_path, "create-schema", "-s", store, "-f", "ev", "--spec",
+             "v:Int,dtg:Date,*geom:Point;geomesa.feature.expiry=dtg(1 days)")
+    assert r.returncode == 0, r.stderr
+    now_iso = np.datetime64(int(_time.time() * 1000) - 3_600_000,
+                            "ms").astype("datetime64[s]")
+    csv_file = tmp_path / "ev.csv"
+    csv_file.write_text("v,when,lon,lat\n"
+                        f"1,{now_iso}Z,1.0,2.0\n")
+    conv = tmp_path / "c.json"
+    conv.write_text(json.dumps({
+        "type": "delimited-text",
+        "fields": [
+            {"name": "v", "transform": "toInt($v)"},
+            {"name": "dtg", "transform": "isoDateTime($when)"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ]}))
+    r = _cli(tmp_path, "ingest", "-s", store, "-f", "ev", str(csv_file),
+             "--converter", str(conv))
+    assert "Ingested 1" in r.stdout, r.stderr
+    # the hour-old row is within the 1-day TTL: nothing to age off yet
+    r = _cli(tmp_path, "age-off", "-s", store, "-f", "ev")
+    assert r.returncode == 0 and "Aged off 0" in r.stdout, r.stderr
+    r = _cli(tmp_path, "count", "-s", store, "-f", "ev")
+    assert "1" in r.stdout
