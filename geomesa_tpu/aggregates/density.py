@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from geomesa_tpu import trace as _trace
+
 
 @dataclass
 class DensityGrid:
@@ -134,22 +136,33 @@ def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
 
         def run():
             for _ in range(6):
-                g, c = state["disp"]()
-                pack = state["pack"]
-                if pack is not None:
-                    pmode, pcap, fn = pack
-                    dec = grid_codec.decode(np.asarray(fn(g, c)), pmode,
-                                            pcap, height, width)
-                    if dec is None:
-                        # cap overflow / saturation / rounding drift: this
-                        # encoding can't carry the result — step down the
-                        # ladder (ultimately to raw f32)
-                        state["pack"] = _next_pack()
-                        weights, got = np.asarray(g), int(c)
+                with _trace.trace("density", type=planner.sft.name):
+                    with _trace.span("device_scan", kind="device_scan"):
+                        g, c = state["disp"]()
+                    pack = state["pack"]
+                    if pack is not None:
+                        pmode, pcap, fn = pack
+                        with _trace.span("device_scan", kind="device_scan"):
+                            packed = fn(g, c)
+                        with _trace.span("device_wait", kind="device_wait"):
+                            packed = np.asarray(
+                                jax.block_until_ready(packed))
+                        with _trace.span("aggregate", kind="aggregate"):
+                            dec = grid_codec.decode(packed, pmode,
+                                                    pcap, height, width)
+                        if dec is None:
+                            # cap overflow / saturation / rounding drift: this
+                            # encoding can't carry the result — step down the
+                            # ladder (ultimately to raw f32)
+                            state["pack"] = _next_pack()
+                            with _trace.span("device_wait",
+                                             kind="device_wait"):
+                                weights, got = np.asarray(g), int(c)
+                        else:
+                            weights, got, _mass = dec
                     else:
-                        weights, got, _mass = dec
-                else:
-                    weights, got = np.asarray(g), int(c)
+                        with _trace.span("device_wait", kind="device_wait"):
+                            weights, got = np.asarray(g), int(c)
                 if state["cap"] is not None and got > state["cap"]:
                     # the match count outgrew the compaction capacity (table
                     # mutated since prepare): the scatter dropped rows —
@@ -167,8 +180,9 @@ def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
         return run
 
     def run_host():
-        return _host_density(planner, f, plan, bbox, width, height,
-                             weight_attr, auths)
+        with _trace.trace("density", type=planner.sft.name, path="host"):
+            return _host_density(planner, f, plan, bbox, width, height,
+                                 weight_attr, auths)
     return run_host
 
 
@@ -206,7 +220,9 @@ def _host_density(planner, f, plan, bbox, width, height, weight_attr,
                   auths) -> DensityGrid:
     """Host fallback (≙ LocalQueryRunner.transform density path)."""
     rows = planner.select_indices(f, plan=plan, auths=auths)
-    weights = host_grid(planner.table, rows, bbox, width, height, weight_attr)
+    with _trace.span("aggregate", kind="aggregate", rows=len(rows)):
+        weights = host_grid(planner.table, rows, bbox, width, height,
+                            weight_attr)
     return DensityGrid(tuple(bbox), width, height, weights)
 
 

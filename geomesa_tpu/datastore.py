@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from geomesa_tpu import trace as _trace
 from geomesa_tpu.features.geometry import GeometryArray
 from geomesa_tpu.features.sft import SimpleFeatureType
 from geomesa_tpu.features.table import FeatureTable, StringColumn
@@ -103,6 +104,9 @@ class TpuDataStore:
 
     def __init__(self, params: Optional[dict] = None):
         import threading
+
+        from geomesa_tpu.metrics import register_device_gauges
+        register_device_gauges()
         self._lock = threading.RLock()
         self.params = params or {}
         self.schemas: Dict[str, SimpleFeatureType] = {}
@@ -121,7 +125,8 @@ class TpuDataStore:
         if audit_param:
             from geomesa_tpu.index.guards import AuditWriter
             self.audit = AuditWriter(
-                audit_param if isinstance(audit_param, str) else None)
+                audit_param if isinstance(audit_param, str) else None,
+                max_bytes=self.params.get("audit.max_bytes"))
         else:
             self.audit = None
 
@@ -196,7 +201,7 @@ class TpuDataStore:
         if current is None:
             self.tables[type_name] = batch
             self.deltas[type_name] = None
-            with _metrics.time("ingest.index_build"):
+            with _trace.span("ingest.index_build", kind="aggregate"):
                 self._rebuild_indexes(type_name, stats_cached)
             return
         delta = self.deltas.get(type_name)
@@ -216,7 +221,7 @@ class TpuDataStore:
                 # re-observe rather than restore an overcounting battery
                 stats_cached = None
             self.tables[type_name] = merged
-            with _metrics.time("ingest.index_build"):
+            with _trace.span("ingest.index_build", kind="aggregate"):
                 self._rebuild_indexes(type_name, stats_cached)
         else:
             _metrics.inc("ingest.delta_appends")
@@ -234,13 +239,15 @@ class TpuDataStore:
             delta = self.deltas.get(type_name)
             if delta is None:
                 return
-            self.deltas[type_name] = None
-            merged = FeatureTable.concat([self.tables[type_name], delta])
-            # dtg age-off rides the flush (≙ compaction-time age-off
-            # iterators): rows whose TTL lapsed since ingest drop here
-            merged, _ = self._apply_age_off(type_name, merged)
-            self.tables[type_name] = merged
-            self._rebuild_indexes(type_name)
+            with _trace.span("ingest.flush", kind="aggregate",
+                             type=type_name):
+                self.deltas[type_name] = None
+                merged = FeatureTable.concat([self.tables[type_name], delta])
+                # dtg age-off rides the flush (≙ compaction-time age-off
+                # iterators): rows whose TTL lapsed since ingest drop here
+                merged, _ = self._apply_age_off(type_name, merged)
+                self.tables[type_name] = merged
+                self._rebuild_indexes(type_name)
 
     def _apply_age_off(self, type_name: str, table: Optional[FeatureTable],
                        now_ms: Optional[int] = None):
@@ -270,7 +277,8 @@ class TpuDataStore:
         row whose ``geomesa.feature.expiry`` TTL has lapsed and rebuilds the
         device index if anything dropped. Returns the number removed.
         ``now_ms`` overrides the clock (maintenance jobs, tests)."""
-        with self._lock:
+        with self._lock, _trace.span("ingest.age_off", kind="aggregate",
+                                     type=type_name):
             table = self.tables.get(type_name)
             delta = self.deltas.get(type_name)
             # merge the delta WITHOUT flush(): its age-off pass runs on the
@@ -355,6 +363,11 @@ class TpuDataStore:
             stats.update(table)  # ≙ statUpdater flush on write
         self._stats[type_name] = stats
         self.planners[type_name] = planner
+        from geomesa_tpu.index import prune as _prune
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        _metrics.set_gauge(f"store.rows.{type_name}", len(table))
+        _metrics.set_gauge(f"store.index_blocks.{type_name}",
+                           -(-len(table) // _prune.BLOCK_SIZE))
 
     def _fid_counter(self, type_name: str) -> int:
         with self._lock:  # read-modify-write: two writers must never share a fid
@@ -400,6 +413,10 @@ class TpuDataStore:
           hints["transform"] = ["attr", "out=expr(...)"]  (projected type)
           hints["crs"]       = "EPSG:3857"                (output reprojection)
         """
+        with _trace.trace("query.features", type=type_name, filter=str(f)):
+            return self._query_impl(type_name, f, hints, auths)
+
+    def _query_impl(self, type_name, f, hints, auths):
         if not hints:
             planner, delta = self._snapshot(type_name)
             res = planner.query(f, auths=auths)
@@ -489,7 +506,7 @@ class TpuDataStore:
               auths: Optional[list] = None) -> int:
         from geomesa_tpu.metrics import REGISTRY as _metrics
         _metrics.inc("query.counts")
-        with _metrics.time("query.count"):
+        with _trace.trace("query.count", type=type_name, filter=str(f)):
             return self._count_impl(type_name, f, auths)
 
     def _count_impl(self, type_name, f, auths) -> int:

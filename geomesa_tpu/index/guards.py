@@ -143,20 +143,68 @@ class QueryEvent:
 
 class AuditWriter:
     """In-memory audit trail with optional JSONL sink (≙ AuditLogger /
-    the Accumulo ``_queries`` table)."""
+    the Accumulo ``_queries`` table).
 
-    def __init__(self, path: Optional[str] = None, keep: int = 1000):
+    The JSONL path is bounded against unbounded growth: with ``max_bytes``
+    set, the file rotates (keep-one-previous: ``path`` → ``path.1``) before
+    an append would cross the limit, and events lost when a rotation
+    discards the old ``.1`` file land on the ``audit.dropped`` counter —
+    total on-disk footprint stays <= ~2*max_bytes."""
+
+    def __init__(self, path: Optional[str] = None, keep: int = 1000,
+                 max_bytes: Optional[int] = None):
+        import os
+        import threading
         self.path = path
         self.keep = keep
+        self.max_bytes = int(max_bytes) if max_bytes else None
         self.events: List[QueryEvent] = []
+        self._lock = threading.Lock()
+        self._size = os.path.getsize(path) if path and os.path.exists(path) \
+            else 0
+        self._file_events: Optional[int] = 0 if self._size == 0 else None
+        self._prev_events: Optional[int] = None  # events in path.1
+
+    @staticmethod
+    def _count_lines(path: str) -> int:
+        try:
+            with open(path, "rb") as fh:
+                return sum(chunk.count(b"\n")
+                           for chunk in iter(lambda: fh.read(1 << 20), b""))
+        except OSError:
+            return 0
+
+    def _rotate(self) -> None:
+        import os
+        prev = self.path + ".1"
+        if os.path.exists(prev):
+            dropped = self._prev_events if self._prev_events is not None \
+                else self._count_lines(prev)
+            if dropped:
+                from geomesa_tpu.metrics import REGISTRY
+                REGISTRY.inc("audit.dropped", dropped)
+        os.replace(self.path, prev)
+        self._prev_events = self._file_events \
+            if self._file_events is not None else self._count_lines(prev)
+        self._size = 0
+        self._file_events = 0
 
     def write(self, event: QueryEvent) -> None:
-        self.events.append(event)
-        if len(self.events) > self.keep:
-            self.events = self.events[-self.keep:]
-        if self.path:
+        with self._lock:
+            self.events.append(event)
+            if len(self.events) > self.keep:
+                self.events = self.events[-self.keep:]
+            if not self.path:
+                return
+            line = json.dumps(event.to_dict()) + "\n"
+            if (self.max_bytes is not None and self._size > 0
+                    and self._size + len(line) > self.max_bytes):
+                self._rotate()
             with open(self.path, "a") as fh:
-                fh.write(json.dumps(event.to_dict()) + "\n")
+                fh.write(line)
+            self._size += len(line)
+            if self._file_events is not None:
+                self._file_events += 1
 
 
 # -- deadline ----------------------------------------------------------------

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Union
 
 import numpy as np
 
+from geomesa_tpu import trace as _trace
 from geomesa_tpu.features.table import FeatureTable
 from geomesa_tpu.filter.evaluate import evaluate as _evaluate
 from geomesa_tpu.filter.evaluate import evaluate_at as _evaluate_at
@@ -79,6 +80,15 @@ class QueryPlanner:
     # -- planning -----------------------------------------------------------
 
     def plan(self, f: Union[str, ir.Filter]) -> IndexScanPlan:
+        if not _trace.enabled():
+            return self._plan(f)
+        t0 = time.perf_counter()
+        try:
+            return self._plan(f)
+        finally:
+            _trace.record("plan", "plan", time.perf_counter() - t0)
+
+    def _plan(self, f: Union[str, ir.Filter]) -> IndexScanPlan:
         if isinstance(f, str):
             f = parse_ecql(f)
         for ic in self.interceptors:
@@ -166,10 +176,16 @@ class QueryPlanner:
                                     for _, p in branches]})
 
     def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:
-        """Hierarchical plan description (≙ Explainer / CLI explain)."""
-        plan = self.plan(f)
-        blocks = self._pruned_blocks(plan)  # surface the pruning decision
+        """Hierarchical plan description (≙ Explainer / CLI explain). The
+        ``trace`` key carries the span tree of the dry-run (plan + range
+        decomposition — no scan executes), so explain shows where planning
+        time goes, not just what the plan is."""
+        with _trace.trace("explain", type=self.sft.name) as t:
+            plan = self.plan(f)
+            blocks = self._pruned_blocks(plan)  # surface the pruning decision
         out = dict(plan.explain)
+        if t is not None:
+            out["trace"] = t.to_dict()
         out["scan"] = "range-pruned" if blocks is not None else "full-mask"
         out.update({
             "type": self.sft.name,
@@ -239,7 +255,13 @@ class QueryPlanner:
             if (not plan.empty and plan.index is not None
                     and plan.candidate_slices is None
                     and hasattr(plan.index, "candidate_blocks")):
-                blocks = plan.index.candidate_blocks(plan)
+                if _trace.enabled():
+                    t0 = time.perf_counter()
+                    blocks = plan.index.candidate_blocks(plan)
+                    _trace.record("range_decompose", "range_decompose",
+                                  time.perf_counter() - t0)
+                else:
+                    blocks = plan.index.candidate_blocks(plan)
             plan.blocks = blocks
         return plan.blocks
 
@@ -268,16 +290,18 @@ class QueryPlanner:
 
     def count(self, f: Union[str, ir.Filter], auths=None) -> int:
         from geomesa_tpu.index.guards import Deadline
-        dl = Deadline(self.timeout_ms)
-        t0 = time.perf_counter()
-        plan = self._apply_auths(self.plan(f), auths)
-        plan_ms = (time.perf_counter() - t0) * 1000
-        dl.check("plan")
-        t1 = time.perf_counter()
-        n = self._count(plan, f, auths)
-        dl.check("scan")
-        self._write_audit(plan, f, plan_ms, (time.perf_counter() - t1) * 1000, n)
-        return n
+        with _trace.trace("count", type=self.sft.name, filter=str(f)):
+            dl = Deadline(self.timeout_ms)
+            t0 = time.perf_counter()
+            plan = self._apply_auths(self.plan(f), auths)
+            plan_ms = (time.perf_counter() - t0) * 1000
+            dl.check("plan")
+            t1 = time.perf_counter()
+            n = self._count(plan, f, auths)
+            dl.check("scan")
+            self._write_audit(plan, f, plan_ms,
+                              (time.perf_counter() - t1) * 1000, n)
+            return n
 
     def _count(self, plan: IndexScanPlan, f, auths) -> int:
         if plan.empty:
@@ -354,9 +378,10 @@ class QueryPlanner:
         if len(unc) == 0:
             return certain
         from geomesa_tpu.filter.geom_batch import batch_intersects
-        rows = plan.index.map_rows(unc)
-        return certain + int(batch_intersects(
-            self.table.geometry(), rows, res.geometry).sum())
+        with _trace.span("refine", kind="refine", rows=len(unc)):
+            rows = plan.index.map_rows(unc)
+            return certain + int(batch_intersects(
+                self.table.geometry(), rows, res.geometry).sum())
 
     def select_indices(self, f: Union[str, ir.Filter],
                        plan: Optional[IndexScanPlan] = None,
@@ -367,34 +392,38 @@ class QueryPlanner:
         avoids the overflow-retry rescans (index/scan.py select)."""
         if plan is None:
             plan = self.plan(f)
-        plan = self._apply_auths(plan, auths)
-        if plan.empty:
-            return np.empty(0, dtype=np.int64)
-        if isinstance(plan, UnionScanPlan):
-            return self._union_select(plan, auths)
-        if plan.primary_kind == "fid":
-            return self._fid_vis_filter(self._fid_rows(plan.full_filter), auths)
-        if plan.candidate_slices is not None:
-            idx, _ = plan.index.kernels.select_at(
-                plan.primary_kind, plan.boxes_loose, plan.windows,
-                plan.residual_device, plan.candidate_positions())
-        else:
-            blocks = self._pruned_blocks(plan)
-            if blocks is not None:
-                if len(blocks) == 0:
-                    return np.empty(0, dtype=np.int64)
-                idx, _ = plan.index.kernels.select_blocks(
+        # "scan" umbrella: its SELF time is constant staging + host glue
+        # (pad/upload, map_rows, sort) around the nested device/refine spans
+        with _trace.span("scan", kind="scan"):
+            plan = self._apply_auths(plan, auths)
+            if plan.empty:
+                return np.empty(0, dtype=np.int64)
+            if isinstance(plan, UnionScanPlan):
+                return self._union_select(plan, auths)
+            if plan.primary_kind == "fid":
+                return self._fid_vis_filter(
+                    self._fid_rows(plan.full_filter), auths)
+            if plan.candidate_slices is not None:
+                idx, _ = plan.index.kernels.select_at(
                     plan.primary_kind, plan.boxes_loose, plan.windows,
-                    plan.residual_device, blocks, _prune.BLOCK_SIZE,
-                    _select_tier(capacity))
+                    plan.residual_device, plan.candidate_positions())
             else:
-                idx, _ = plan.index.kernels.select(
-                    plan.primary_kind, plan.boxes_loose, plan.windows,
-                    plan.residual_device, _select_tier(capacity))
-        rows = plan.index.map_rows(idx)
-        if plan.residual_host is None:
-            return np.sort(rows)
-        return np.sort(self._refine(plan, rows))
+                blocks = self._pruned_blocks(plan)
+                if blocks is not None:
+                    if len(blocks) == 0:
+                        return np.empty(0, dtype=np.int64)
+                    idx, _ = plan.index.kernels.select_blocks(
+                        plan.primary_kind, plan.boxes_loose, plan.windows,
+                        plan.residual_device, blocks, _prune.BLOCK_SIZE,
+                        _select_tier(capacity))
+                else:
+                    idx, _ = plan.index.kernels.select(
+                        plan.primary_kind, plan.boxes_loose, plan.windows,
+                        plan.residual_device, _select_tier(capacity))
+            rows = plan.index.map_rows(idx)
+            if plan.residual_host is None:
+                return np.sort(rows)
+            return np.sort(self._refine(plan, rows))
 
     def _union_select(self, plan: UnionScanPlan, auths) -> np.ndarray:
         """Union of per-branch row sets (sorted unique — OR-branch overlaps
@@ -429,17 +458,20 @@ class QueryPlanner:
 
     def query(self, f: Union[str, ir.Filter], auths=None) -> QueryResult:
         from geomesa_tpu.index.guards import Deadline
-        dl = Deadline(self.timeout_ms)
-        t0 = time.perf_counter()
-        plan = self.plan(f)
-        plan_ms = (time.perf_counter() - t0) * 1000
-        dl.check("plan")
-        t1 = time.perf_counter()
-        rows = self.select_indices(f, plan=plan, auths=auths)
-        dl.check("scan")
-        self._write_audit(plan, f, plan_ms, (time.perf_counter() - t1) * 1000,
-                          len(rows))
-        return QueryResult(rows, self.table.take(rows), plan)
+        with _trace.trace("query", type=self.sft.name, filter=str(f)):
+            dl = Deadline(self.timeout_ms)
+            t0 = time.perf_counter()
+            plan = self.plan(f)
+            plan_ms = (time.perf_counter() - t0) * 1000
+            dl.check("plan")
+            t1 = time.perf_counter()
+            rows = self.select_indices(f, plan=plan, auths=auths)
+            dl.check("scan")
+            self._write_audit(plan, f, plan_ms,
+                              (time.perf_counter() - t1) * 1000, len(rows))
+            with _trace.span("serialize", kind="serialize", rows=len(rows)):
+                table = self.table.take(rows)
+            return QueryResult(rows, table, plan)
 
     # -- helpers ------------------------------------------------------------
 
@@ -454,8 +486,9 @@ class QueryPlanner:
         predicates run batched (geom_batch) rather than per-feature."""
         if len(rows) == 0 or plan.residual_host is None:
             return rows
-        mask = _evaluate_at(plan.residual_host, self.table, rows)
-        return rows[mask]
+        with _trace.span("refine", kind="refine", rows=len(rows)):
+            mask = _evaluate_at(plan.residual_host, self.table, rows)
+            return rows[mask]
 
 
 class PreparedQuery:
@@ -499,24 +532,28 @@ class PreparedQuery:
             if self.plan.empty:
                 return None
             raise ValueError("plan needs host execution; use count()")
-        return self._count_disp()
+        with _trace.span("device_scan", kind="device_scan"):
+            return self._count_disp()
 
     def count(self) -> int:
         """Blocking count. Audited like planner.count (plan time 0) and
         subject to the planner's cooperative deadline."""
         from geomesa_tpu.index.guards import Deadline
-        dl = Deadline(self.planner.timeout_ms)
-        t0 = time.perf_counter()
-        if self.plan.empty:
-            n = 0
-        elif self._count_disp is not None:
-            n = int(self._count_disp())
-        else:
-            n = self.planner._count(self.plan, self.filter, self.auths)
-        dl.check("scan")
-        self.planner._write_audit(self.plan, self.filter, 0.0,
-                                  (time.perf_counter() - t0) * 1000, n)
-        return n
+        from geomesa_tpu.index.scan import _fetch
+        with _trace.trace("count", type=self.planner.sft.name,
+                          filter=str(self.filter), prepared=True):
+            dl = Deadline(self.planner.timeout_ms)
+            t0 = time.perf_counter()
+            if self.plan.empty:
+                n = 0
+            elif self._count_disp is not None:
+                n = int(_fetch(self._count_disp))
+            else:
+                n = self.planner._count(self.plan, self.filter, self.auths)
+            dl.check("scan")
+            self.planner._write_audit(self.plan, self.filter, 0.0,
+                                      (time.perf_counter() - t0) * 1000, n)
+            return n
 
     def select_indices(self) -> np.ndarray:
         return self.planner.select_indices(self.filter, plan=self.plan,

@@ -29,7 +29,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from geomesa_tpu import trace as _trace
 from geomesa_tpu.filter import ir
+
+
+def _fetch(dispatch, *args):
+    """Run a kernel dispatch under a ``device_scan`` span (host-side enqueue)
+    and block under a ``device_wait`` span — separating the dispatch floor
+    from true device time in every trace. Returns the ready device value.
+    Variadic so hot paths pass ``(fn, *args)`` without a closure alloc."""
+    return _trace.device_fetch(jax.block_until_ready, dispatch, *args)
 
 # -- primary spatial/temporal masks -----------------------------------------
 
@@ -678,8 +687,9 @@ class ScanKernels:
                        residual[2] if residual else None,
                        0 if boxes is None else boxes.shape[0],
                        0 if windows is None else windows.shape[0])
-        return int(fn(self.cols, _dev(boxes), _dev(windows),
-                      [jnp.asarray(p) for p in residual[1]] if residual else []))
+        return int(_fetch(
+            fn, self.cols, _dev(boxes), _dev(windows),
+            [jnp.asarray(p) for p in residual[1]] if residual else []))
 
     def mask(self, primary_kind, boxes, windows, residual) -> jnp.ndarray:
         fn = self._get("mask", primary_kind, windows is not None,
@@ -687,8 +697,9 @@ class ScanKernels:
                        residual[2] if residual else None,
                        0 if boxes is None else boxes.shape[0],
                        0 if windows is None else windows.shape[0])
-        return fn(self.cols, _dev(boxes), _dev(windows),
-                  [jnp.asarray(p) for p in residual[1]] if residual else [])
+        with _trace.span("device_scan"):  # async: consumers block later
+            return fn(self.cols, _dev(boxes), _dev(windows),
+                      [jnp.asarray(p) for p in residual[1]] if residual else [])
 
     def count_at(self, primary_kind, boxes, windows, residual,
                  positions: np.ndarray) -> int:
@@ -700,9 +711,10 @@ class ScanKernels:
                        0 if boxes is None else boxes.shape[0],
                        0 if windows is None else windows.shape[0],
                        idxs.shape[0])
-        return int(fn(self.cols, _dev(boxes), _dev(windows),
-                      [jnp.asarray(p) for p in residual[1]] if residual else [],
-                      jnp.asarray(idxs), nvalid))
+        return int(_fetch(
+            fn, self.cols, _dev(boxes), _dev(windows),
+            [jnp.asarray(p) for p in residual[1]] if residual else [],
+            jnp.asarray(idxs), nvalid))
 
     def select_at(self, primary_kind, boxes, windows, residual,
                   positions: np.ndarray):
@@ -714,9 +726,10 @@ class ScanKernels:
                        0 if boxes is None else boxes.shape[0],
                        0 if windows is None else windows.shape[0],
                        idxs.shape[0])
-        out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows),
-                            [jnp.asarray(p) for p in residual[1]] if residual else [],
-                            jnp.asarray(idxs), nvalid))
+        out = np.asarray(_fetch(
+            fn, self.cols, _dev(boxes), _dev(windows),
+            [jnp.asarray(p) for p in residual[1]] if residual else [],
+            jnp.asarray(idxs), nvalid))
         cnt = int(out[0])
         sel = out[1: 1 + cnt].astype(np.int64)
         return positions[sel], cnt
@@ -733,7 +746,7 @@ class ScanKernels:
                        b.shape[0],
                        0 if windows is None else windows.shape[0])
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        out = np.asarray(fn(self.cols, _dev(b), _dev(windows), rp))
+        out = np.asarray(_fetch(fn, self.cols, _dev(b), _dev(windows), rp))
         return out[: len(boxes)]
 
     def prepare_count(self, primary_kind, boxes, windows, residual):
@@ -773,8 +786,8 @@ class ScanKernels:
     def count_blocks(self, primary_kind, boxes, windows, residual,
                      blocks: np.ndarray, block_size: int) -> int:
         """Exact count scanning only the candidate blocks (range-pruned)."""
-        return int(self.prepare_count_blocks(
-            primary_kind, boxes, windows, residual, blocks, block_size)())
+        return int(_fetch(self.prepare_count_blocks(
+            primary_kind, boxes, windows, residual, blocks, block_size)))
 
     def prepare_count_blocks(self, primary_kind, boxes, windows, residual,
                              blocks: np.ndarray, block_size: int):
@@ -807,8 +820,8 @@ class ScanKernels:
                            0 if boxes is None else boxes.shape[0],
                            0 if windows is None else windows.shape[0],
                            (b.shape[0], block_size, capacity))
-            out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows), rp,
-                                jnp.asarray(b)))
+            out = np.asarray(_fetch(fn, self.cols, _dev(boxes),
+                                    _dev(windows), rp, jnp.asarray(b)))
             cnt = int(out[0])
             if cnt <= capacity:
                 return out[1: 1 + cnt].astype(np.int64), cnt
@@ -840,8 +853,8 @@ class ScanKernels:
                             residual, blocks: np.ndarray,
                             block_size: int) -> np.ndarray:
         """Blocking counterpart of ``prepare_counts_multi_blocks``."""
-        out = np.asarray(self.prepare_counts_multi_blocks(
-            primary_kind, boxes, windows, residual, blocks, block_size)())
+        out = np.asarray(_fetch(self.prepare_counts_multi_blocks(
+            primary_kind, boxes, windows, residual, blocks, block_size)))
         return out[: len(boxes)]
 
     def prepare_density_compact(self, primary_kind, boxes, windows, residual,
@@ -906,8 +919,8 @@ class ScanKernels:
                        0 if windows is None else windows.shape[0],
                        (b.shape[0], block_size, 0, unc_cap, ne))
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows), rp,
-                            jnp.asarray(ep), jnp.asarray(b)))
+        out = np.asarray(_fetch(fn, self.cols, _dev(boxes), _dev(windows),
+                                rp, jnp.asarray(ep), jnp.asarray(b)))
         certain = int(out[0])
         n_unc = int(out[1])
         if n_unc > unc_cap:
@@ -929,8 +942,8 @@ class ScanKernels:
                        (b.shape[0], block_size, 0, m))
         q = jnp.asarray(np.array([qx, qy], dtype=np.float32))
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        vals, idxs = fn(self.cols, _dev(boxes), _dev(windows), rp, q,
-                        jnp.asarray(b))
+        vals, idxs = _fetch(fn, self.cols, _dev(boxes), _dev(windows),
+                            rp, q, jnp.asarray(b))
         return np.asarray(vals), np.asarray(idxs)
 
     def topk_nearest(self, primary_kind, boxes, windows, residual,
@@ -945,7 +958,8 @@ class ScanKernels:
                        0 if windows is None else windows.shape[0], m)
         q = jnp.asarray(np.array([qx, qy], dtype=np.float32))
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        vals, idxs = fn(self.cols, _dev(boxes), _dev(windows), rp, q)
+        vals, idxs = _fetch(fn, self.cols, _dev(boxes), _dev(windows),
+                            rp, q)
         return np.asarray(vals), np.asarray(idxs)
 
     def select(self, primary_kind, boxes, windows, residual, capacity: int):
@@ -960,7 +974,8 @@ class ScanKernels:
                            0 if boxes is None else boxes.shape[0],
                            0 if windows is None else windows.shape[0],
                            capacity)
-            out = np.asarray(fn(self.cols, _dev(boxes), _dev(windows), rp))
+            out = np.asarray(_fetch(fn, self.cols, _dev(boxes),
+                                    _dev(windows), rp))
             cnt = int(out[0])
             if cnt <= capacity:
                 return out[1: 1 + cnt].astype(np.int64), cnt

@@ -1,31 +1,113 @@
-"""Metrics/observability: counters, timers, and a pluggable reporter.
+"""Metrics/observability: counters, histogram timers, gauges, reporters.
 
 ≙ the reference's converter ingest metrics + audit surface (SURVEY.md §5:
 dropwizard metrics with graphite/cloudwatch/ganglia reporters in
 geomesa-convert-metrics-*; QueryEvent audit records in index/audit/
 QueryEvent.scala:13). Here a process-local registry collects ingest and
 query counters/timers; ``snapshot()`` serializes for the CLI/REST surface,
-and ``add_reporter`` hooks a callable for external sinks (the
-graphite-reporter slot)."""
+``to_prometheus()`` emits the text exposition format, and ``add_reporter``
+hooks a callable for external sinks (the graphite-reporter slot).
+
+Timers are fixed-bucket log-scale histograms (dropwizard's reservoir slot):
+bucket upper bounds grow geometrically by 2^0.25 from 1µs, so percentiles
+carry ≤ ~19% relative error at O(bytes) cost and zero allocation per
+observation. ``percentile()`` returns the UPPER BOUND of the bucket holding
+the rank-th observation (deterministic, never an interpolated value that no
+observation produced).
+
+Reset semantics (the snapshot/reset race): ``reset()`` bumps a generation
+counter; a ``time()`` block that STRADDLES a reset is discarded at exit
+rather than resurrecting its name with a lost count — post-reset snapshots
+only ever contain observations that started after the reset.
+"""
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
+
+# -- histogram geometry ------------------------------------------------------
+
+_BUCKET_MIN_S = 1e-6          # first bucket: everything <= 1µs
+_BUCKET_FACTOR = 2.0 ** 0.25  # ~19% resolution per bucket
+_N_BUCKETS = 128              # reaches 1e-6 * 2^(127/4) ≈ 3.3e3 s
+
+# upper (inclusive) bound of each bucket; the last is +inf-in-spirit
+BUCKET_BOUNDS: tuple = tuple(
+    _BUCKET_MIN_S * _BUCKET_FACTOR ** i for i in range(_N_BUCKETS))
+
+
+def bucket_index(seconds: float) -> int:
+    """First bucket whose upper bound >= seconds (exact via bisect — no
+    float-log boundary jitter)."""
+    i = bisect.bisect_left(BUCKET_BOUNDS, seconds)
+    return min(i, _N_BUCKETS - 1)
+
+
+class Histogram:
+    """Log-scale fixed-bucket duration histogram (count/total/max +
+    percentiles). Not internally locked — the registry lock covers it."""
+
+    __slots__ = ("count", "total_s", "max_s", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.buckets = [0] * _N_BUCKETS
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+        self.buckets[bucket_index(seconds)] += 1
+
+    def percentile(self, q: float) -> float:
+        """Upper bound (seconds) of the bucket holding the ceil(q*count)-th
+        observation; 0.0 on an empty histogram."""
+        if self.count == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * self.count))
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            cum += c
+            if cum >= rank:
+                return BUCKET_BOUNDS[i]
+        return BUCKET_BOUNDS[-1]
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_s": round(self.total_s, 6),
+            "mean_ms": round(self.total_s / self.count * 1000, 3)
+            if self.count else 0.0,
+            "max_ms": round(self.max_s * 1000, 3),
+            "p50_ms": round(self.percentile(0.50) * 1000, 3),
+            "p90_ms": round(self.percentile(0.90) * 1000, 3),
+            "p99_ms": round(self.percentile(0.99) * 1000, 3),
+        }
 
 
 class MetricsRegistry:
-    """Thread-safe counters + duration histograms (count/total/max)."""
+    """Thread-safe counters + histogram timers + gauges."""
 
     def __init__(self):
         self._lock = threading.Lock()
+        self._gen = 0
         self._counters: Dict[str, int] = defaultdict(int)
-        self._timers: Dict[str, List[float]] = defaultdict(
-            lambda: [0, 0.0, 0.0])  # [count, total_s, max_s]
+        self._timers: Dict[str, Histogram] = defaultdict(Histogram)
+        self._gauges: Dict[str, object] = {}  # value or zero-arg callable
         self._reporters: List[Callable[[str, str, float], None]] = []
+        # span trees awaiting histogram feed (GIL-atomic appends from trace
+        # close; drained under the lock at snapshot time) — keeps the
+        # per-query trace-close cost to one list append
+        self._pending: List[object] = []
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -33,20 +115,69 @@ class MetricsRegistry:
             reporters = list(self._reporters)
         self._report(reporters, "counter", name, n)
 
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one duration into the name's histogram (the span feed —
+        the µs-scale hot path; skip the reporter copy when there are none)."""
+        with self._lock:
+            self._timers[name].observe(seconds)
+            reporters = list(self._reporters) if self._reporters else None
+        if reporters:
+            self._report(reporters, "timer", name, seconds)
+
+    def observe_batch(self, pairs) -> None:
+        """Record many (name, seconds) at once under ONE lock acquisition."""
+        with self._lock:
+            for name, seconds in pairs:
+                self._timers[name].observe(seconds)
+            reporters = list(self._reporters) if self._reporters else None
+        if reporters:
+            for name, seconds in pairs:
+                self._report(reporters, "timer", name, seconds)
+
+    def feed_tree(self, root) -> None:
+        """Defer a whole span tree (an object with ``walk()`` yielding nodes
+        with ``name``/``duration_ms``) to the next drain — the trace-close
+        hot-path feed: ONE locked list append now, histogram math at
+        snapshot time. Reporters consequently see trace-span timer events at
+        drain time (they poll snapshots anyway, the dropwizard model)."""
+        with self._lock:
+            self._pending.append(root)
+
+    def _drain_locked(self) -> Optional[list]:
+        """Fold pending span trees into the histograms (lock held). Returns
+        (name, seconds) pairs for the reporter fan-out, or None."""
+        if not self._pending:
+            return None
+        pending, self._pending = self._pending, []
+        pairs = [(s.name, s.duration_ms / 1000.0)
+                 for root in pending for s in root.walk()]
+        for name, seconds in pairs:
+            self._timers[name].observe(seconds)
+        return pairs if self._reporters else None
+
     @contextmanager
     def time(self, name: str):
         t0 = time.perf_counter()
+        gen = self._gen  # racy read is fine: reset() bumps under the lock,
+        # and the exit-side compare re-reads under the lock
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            reporters = None
             with self._lock:
-                t = self._timers[name]
-                t[0] += 1
-                t[1] += dt
-                t[2] = max(t[2], dt)
-                reporters = list(self._reporters)
-            self._report(reporters, "timer", name, dt)
+                if self._gen == gen:
+                    self._timers[name].observe(dt)
+                    reporters = list(self._reporters)
+                # else: straddled a reset() — discard, never resurrect
+            if reporters is not None:
+                self._report(reporters, "timer", name, dt)
+
+    def set_gauge(self, name: str, value) -> None:
+        """Set a gauge to a value OR a zero-arg callable evaluated lazily at
+        snapshot time (resident rows, device memory, …)."""
+        with self._lock:
+            self._gauges[name] = value
 
     @staticmethod
     def _report(reporters, kind: str, name: str, value: float) -> None:
@@ -61,23 +192,108 @@ class MetricsRegistry:
         with self._lock:
             self._reporters.append(fn)
 
-    def snapshot(self) -> dict:
+    def _gauge_values(self) -> Dict[str, float]:
         with self._lock:
-            return {
+            items = list(self._gauges.items())
+        out = {}
+        for k, v in items:
+            if callable(v):
+                try:
+                    v = v()
+                except Exception:
+                    continue  # a failing probe must never fail the surface
+            if v is not None:
+                out[k] = v
+        return out
+
+    def snapshot(self) -> dict:
+        gauges = self._gauge_values()  # probes run OUTSIDE the lock
+        with self._lock:
+            pairs = self._drain_locked()
+            reporters = list(self._reporters) if pairs else None
+            out = {
                 "counters": dict(self._counters),
-                "timers": {
-                    k: {"count": int(v[0]), "total_s": round(v[1], 6),
-                        "mean_ms": round(v[1] / v[0] * 1000, 3) if v[0] else 0.0,
-                        "max_ms": round(v[2] * 1000, 3)}
-                    for k, v in self._timers.items()
-                },
+                "timers": {k: h.to_dict() for k, h in self._timers.items()},
+                "gauges": gauges,
             }
+        if pairs:
+            for name, seconds in pairs:
+                self._report(reporters, "timer", name, seconds)
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition: counters as *_total, timers as
+        summaries with p50/p90/p99 quantiles, gauges as gauges. Never emits
+        NaN (empty timers emit count/sum only)."""
+        def sane(name: str) -> str:
+            return "geomesa_tpu_" + "".join(
+                c if c.isalnum() or c == "_" else "_" for c in name)
+
+        snap = self.snapshot()
+        lines: List[str] = []
+        for name, v in sorted(snap["counters"].items()):
+            m = sane(name) + "_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {v}")
+        for name, g in sorted(snap["gauges"].items()):
+            m = sane(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {float(g):g}")
+        for name, h in sorted(snap["timers"].items()):
+            m = sane(name) + "_seconds"
+            lines.append(f"# TYPE {m} summary")
+            if h["count"]:
+                for q, key in ((0.5, "p50_ms"), (0.9, "p90_ms"),
+                               (0.99, "p99_ms")):
+                    lines.append(
+                        f'{m}{{quantile="{q}"}} {h[key] / 1000:.9g}')
+            lines.append(f"{m}_count {h['count']}")
+            lines.append(f"{m}_sum {h['total_s']:.9g}")
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
+        """Clear counters and timers (gauges persist — they describe current
+        state, not accumulation). In-flight ``time()`` blocks that entered
+        before this reset are discarded at their exit (generation check)."""
         with self._lock:
+            self._gen += 1
             self._counters.clear()
             self._timers.clear()
+            self._pending.clear()  # same straddling-discard semantics
 
 
 # process-global default registry (≙ the shared MetricRegistry)
 REGISTRY = MetricsRegistry()
+
+_DEVICE_GAUGES_REGISTERED = False
+
+
+def register_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
+    """Install lazy device gauges: ``device.count`` and
+    ``device.bytes_in_use`` (summed ``memory_stats()`` over
+    ``jax.local_devices()`` where the backend reports them). Idempotent;
+    probes evaluate at snapshot time and never raise through the surface."""
+    global _DEVICE_GAUGES_REGISTERED
+    reg = registry or REGISTRY
+    if reg is REGISTRY and _DEVICE_GAUGES_REGISTERED:
+        return
+    if reg is REGISTRY:
+        _DEVICE_GAUGES_REGISTERED = True
+
+    def _count():
+        import jax
+        return len(jax.local_devices())
+
+    def _mem():
+        import jax
+        total, seen = 0, False
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", None)
+            s = stats() if stats is not None else None
+            if s and "bytes_in_use" in s:
+                total += int(s["bytes_in_use"])
+                seen = True
+        return total if seen else None
+
+    reg.set_gauge("device.count", _count)
+    reg.set_gauge("device.bytes_in_use", _mem)

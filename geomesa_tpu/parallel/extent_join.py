@@ -33,6 +33,7 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
 from geomesa_tpu.features import geometry as geo
 from geomesa_tpu.filter import geom_batch
 
@@ -176,15 +177,18 @@ def _refine_chunk(left: geo.GeometryArray, right: geo.GeometryArray,
                        or len(li) >= config.JOIN_DEVICE_MIN_PAIRS.get()))
     if use_device:
         from geomesa_tpu.parallel.pair_kernel import device_refine
-        out = device_refine(left, right, li, rj)
+        with _trace.span("device_scan", kind="device_scan", pairs=len(li)):
+            out = device_refine(left, right, li, rj)
         if out is not None:
             hit, unc = out
             if unc.any():
                 u = np.flatnonzero(unc)
                 hit = hit.copy()
-                hit[u] = _host_refine_mask(left, right, li[u], rj[u], fn)
+                with _trace.span("refine", kind="refine", pairs=len(u)):
+                    hit[u] = _host_refine_mask(left, right, li[u], rj[u], fn)
             return hit
-    return _host_refine_mask(left, right, li, rj, fn)
+    with _trace.span("refine", kind="refine", pairs=len(li)):
+        return _host_refine_mask(left, right, li, rj, fn)
 
 
 def extent_join(left: geo.GeometryArray, right: geo.GeometryArray,
@@ -201,18 +205,29 @@ def extent_join(left: geo.GeometryArray, right: geo.GeometryArray,
     """
     if predicate not in ("intersects", "within"):
         raise ValueError(f"Unsupported join predicate {predicate!r}")
-    out_l: List[np.ndarray] = []
-    out_r: List[np.ndarray] = []
-    for li, rj in candidate_pair_chunks(left.bboxes(), right.bboxes(), grid):
-        hit = _refine_chunk(left, right, li, rj, predicate, device)
-        out_l.append(li[hit])
-        out_r.append(rj[hit])
-    if not out_l:
-        return np.empty(0, np.int64), np.empty(0, np.int64)
-    la = np.concatenate(out_l)
-    ra = np.concatenate(out_r)
-    order = np.lexsort((ra, la))
-    return la[order], ra[order]
+    with _trace.trace("extent_join", predicate=predicate,
+                      left=len(left), right=len(right)):
+        out_l: List[np.ndarray] = []
+        out_r: List[np.ndarray] = []
+        it = candidate_pair_chunks(left.bboxes(), right.bboxes(), grid)
+        while True:
+            # pull each candidate chunk under range_decompose — the grid
+            # partitioner's work happens lazily inside the generator
+            with _trace.span("range_decompose", kind="range_decompose"):
+                chunk = next(it, None)
+            if chunk is None:
+                break
+            li, rj = chunk
+            hit = _refine_chunk(left, right, li, rj, predicate, device)
+            out_l.append(li[hit])
+            out_r.append(rj[hit])
+        if not out_l:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        with _trace.span("aggregate", kind="aggregate"):
+            la = np.concatenate(out_l)
+            ra = np.concatenate(out_r)
+            order = np.lexsort((ra, la))
+            return la[order], ra[order]
 
 
 def extent_join_partitioned(left: geo.GeometryArray,

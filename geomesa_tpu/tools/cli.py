@@ -12,6 +12,7 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
+    geomesa-tpu debug         metrics|traces [--format prometheus] [-s STORE -f NAME -q ECQL]
     geomesa-tpu describe / list / remove-schema
 """
 
@@ -194,6 +195,28 @@ def cmd_age_off(args):
     print(f"Aged off {n} features")
 
 
+def cmd_debug(args):
+    """Observability surface: dump the process metrics registry or the
+    recent-trace ring (≙ the reference's stats/audit debug commands). With
+    a store + feature + CQL, runs the query first so the dump reflects a
+    real execution — the offline way to read a trace tree."""
+    from geomesa_tpu.metrics import REGISTRY
+    from geomesa_tpu.trace import RING
+    if args.store:
+        store = _load(args.store, must_exist=True)
+        if args.feature and args.cql:
+            n = store.count(args.feature, args.cql)
+            print(f"# ran count({args.feature!r}, {args.cql!r}) -> {n}",
+                  file=sys.stderr)
+    if args.what == "metrics":
+        if args.format == "prometheus":
+            sys.stdout.write(REGISTRY.to_prometheus())
+        else:
+            print(json.dumps(REGISTRY.snapshot(), indent=2, default=str))
+    else:  # traces
+        print(json.dumps(RING.recent(args.limit), indent=2))
+
+
 def cmd_config(args):
     from geomesa_tpu import config as cfg
     for name, d in cfg.describe().items():
@@ -299,6 +322,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("config", help="list system properties")
     sp.set_defaults(fn=cmd_config)
+
+    sp = sub.add_parser(
+        "debug", help="dump metrics or recent query traces")
+    sp.add_argument("what", choices=("metrics", "traces"))
+    sp.add_argument("-s", "--store", help="store to exercise first (optional)")
+    sp.add_argument("-f", "--feature", help="feature type for the warm query")
+    sp.add_argument("-q", "--cql", help="ECQL filter for the warm query")
+    sp.add_argument("--format", default="json",
+                    choices=("json", "prometheus"))
+    sp.add_argument("--limit", type=int, default=20,
+                    help="max traces to print")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("serve", help="REST/GeoJSON API over a store")
     sp.add_argument("-s", "--store", required=True)

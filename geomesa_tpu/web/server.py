@@ -12,10 +12,13 @@ Routes:
   GET  /types/{t}                      → schema + row count
   GET  /types/{t}/features?cql=&limit=&sort=&crs=   → GeoJSON FeatureCollection
   GET  /types/{t}/count?cql=           → {"count": n}
-  GET  /types/{t}/explain?cql=         → query plan JSON
+  GET  /types/{t}/explain?cql=         → query plan JSON (+ dry-run trace tree)
   GET  /types/{t}/stats?stat=<dsl>     → stat sketch JSON
   POST /types/{t}/features             → ingest a GeoJSON FeatureCollection
-  GET  /metrics                        → metrics snapshot
+  GET  /metrics                        → metrics snapshot (JSON)
+  GET  /metrics?format=prometheus      → Prometheus text exposition
+  GET  /traces?limit=N                 → recent query traces, newest first
+  GET  /healthz                        → liveness + device count
   GET  /config                         → system-property listing
 """
 
@@ -37,9 +40,9 @@ class GeoJsonApi:
     def __init__(self, store):
         self.store = store
 
-    # returns (status, payload dict)
+    # returns (status, payload) — dict for JSON, str for raw text bodies
     def handle(self, method: str, path: str, query: dict,
-               body: Optional[bytes] = None) -> Tuple[int, dict]:
+               body: Optional[bytes] = None) -> Tuple[int, object]:
         try:
             return self._route(method, path, query, body)
         except Exception as e:  # surface planner/parser/data errors as 400s
@@ -51,7 +54,19 @@ class GeoJsonApi:
             return 200, {"types": self.store.get_type_names()}
         if parts == ["metrics"]:
             from geomesa_tpu.metrics import REGISTRY
+            if query.get("format", [None])[0] == "prometheus":
+                # str payload → text/plain exposition body
+                return 200, REGISTRY.to_prometheus()
             return 200, REGISTRY.snapshot()
+        if parts == ["traces"]:
+            from geomesa_tpu.trace import RING
+            limit = int(query.get("limit", [50])[0])
+            return 200, {"traces": RING.recent(limit)}
+        if parts == ["healthz"]:
+            import jax
+            return 200, {"status": "ok",
+                         "devices": len(jax.local_devices()),
+                         "types": len(self.store.get_type_names())}
         if parts == ["config"]:
             from geomesa_tpu import config
             return 200, config.describe()
@@ -142,10 +157,17 @@ class GeoJsonApi:
 class _Handler(BaseHTTPRequestHandler):
     api: GeoJsonApi = None  # set by serve()
 
-    def _respond(self, status: int, payload: dict) -> None:
-        data = json.dumps(payload).encode()
+    def _respond(self, status: int, payload) -> None:
+        # str payloads are raw text bodies (the Prometheus exposition);
+        # everything else serializes as JSON
+        if isinstance(payload, str):
+            data = payload.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            data = json.dumps(payload).encode()
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

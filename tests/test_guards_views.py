@@ -104,6 +104,48 @@ def test_audit_trail(tmp_path):
     assert len(lines) == 2 and lines[0]["filter"]
 
 
+def test_audit_rotation_bounds_growth(tmp_path):
+    import json
+    import os
+
+    from geomesa_tpu.index.guards import AuditWriter, QueryEvent
+    from geomesa_tpu.metrics import REGISTRY
+
+    path = str(tmp_path / "audit.jsonl")
+    w = AuditWriter(path, max_bytes=600)
+    before = REGISTRY.snapshot()["counters"].get("audit.dropped", 0)
+    for i in range(40):
+        w.write(QueryEvent(type_name="t", filter=f"v = {i}"))
+    # the active file stays bounded and the keep-one-previous file exists
+    assert os.path.getsize(path) <= 600
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path + ".1") <= 600
+    # events discarded by rotation landed on the audit.dropped counter,
+    # and surviving-on-disk + dropped account for every event written
+    dropped = REGISTRY.snapshot()["counters"].get("audit.dropped", 0) - before
+    on_disk = sum(1 for _ in open(path)) + sum(1 for _ in open(path + ".1"))
+    assert dropped > 0
+    assert on_disk + dropped == 40
+    # surviving lines are the MOST RECENT events, intact JSONL
+    last = json.loads(open(path).readlines()[-1])
+    assert last["filter"] == "v = 39"
+    # the in-memory trail is independent of rotation
+    assert len(w.events) == 40
+
+
+def test_audit_rotation_resumes_preexisting_file(tmp_path):
+    from geomesa_tpu.index.guards import AuditWriter, QueryEvent
+    path = str(tmp_path / "audit.jsonl")
+    w1 = AuditWriter(path, max_bytes=10_000)
+    for i in range(5):
+        w1.write(QueryEvent(type_name="t", filter=f"v = {i}"))
+    # a new writer over the same path (process restart) sizes from disk
+    w2 = AuditWriter(path, max_bytes=10_000)
+    assert w2._size == __import__("os").path.getsize(path)
+    w2.write(QueryEvent(type_name="t", filter="v = 99"))
+    assert sum(1 for _ in open(path)) == 6
+
+
 # -- timeout -----------------------------------------------------------------
 
 

@@ -1,0 +1,229 @@
+"""MetricsRegistry: histogram percentile math at bucket boundaries,
+thread-safety under concurrent inc/time/snapshot/reset, reset-generation
+semantics, gauges, and the Prometheus exposition."""
+
+import re
+import threading
+
+import pytest
+
+from geomesa_tpu.metrics import (BUCKET_BOUNDS, Histogram, MetricsRegistry,
+                                 bucket_index)
+
+# -- histogram bucket / percentile math --------------------------------------
+
+
+def test_bucket_boundaries_are_inclusive_upper():
+    # an observation exactly AT a bucket's upper bound lands in that bucket
+    for i in (0, 1, 17, 63, len(BUCKET_BOUNDS) - 1):
+        assert bucket_index(BUCKET_BOUNDS[i]) == i
+    # just above a bound spills into the next bucket
+    assert bucket_index(BUCKET_BOUNDS[10] * 1.000001) == 11
+    # below the first bound clamps to bucket 0; above the last clamps to last
+    assert bucket_index(0.0) == 0
+    assert bucket_index(1e9) == len(BUCKET_BOUNDS) - 1
+
+
+def test_percentile_returns_bucket_upper_bound():
+    h = Histogram()
+    # 9 obs in bucket 20, 1 obs in bucket 40 → p50/p90 from bucket 20,
+    # p99 from bucket 40 (documented: upper bound of the rank-th bucket)
+    for _ in range(9):
+        h.observe(BUCKET_BOUNDS[20])
+    h.observe(BUCKET_BOUNDS[40])
+    assert h.percentile(0.50) == BUCKET_BOUNDS[20]
+    assert h.percentile(0.90) == BUCKET_BOUNDS[20]
+    assert h.percentile(0.99) == BUCKET_BOUNDS[40]
+    assert h.count == 10
+    assert h.max_s == BUCKET_BOUNDS[40]
+
+
+def test_percentile_single_observation_and_empty():
+    h = Histogram()
+    assert h.percentile(0.5) == 0.0  # empty: defined, never NaN
+    h.observe(0.001)
+    b = BUCKET_BOUNDS[bucket_index(0.001)]
+    assert h.percentile(0.5) == b
+    assert h.percentile(0.99) == b
+
+
+def test_percentile_brackets_actual_value():
+    # p(q) is the upper bound of the bucket holding the ceil(q*n)-th obs:
+    # never below that observation, never more than one bucket factor above
+    import math
+    h = Histogram()
+    vals = [1e-5 * (1 + i / 7) for i in range(100)]
+    for v in vals:
+        h.observe(v)
+    vals.sort()
+    for q in (0.5, 0.9, 0.99):
+        actual = vals[math.ceil(q * len(vals)) - 1]
+        assert h.percentile(q) >= actual * (1 - 1e-12)
+        assert h.percentile(q) <= actual * (2 ** 0.25) * (1 + 1e-12)
+
+
+def test_snapshot_shape():
+    m = MetricsRegistry()
+    with m.time("op"):
+        pass
+    t = m.snapshot()["timers"]["op"]
+    for k in ("count", "total_s", "mean_ms", "max_ms",
+              "p50_ms", "p90_ms", "p99_ms"):
+        assert k in t
+    assert t["count"] == 1
+
+
+# -- concurrency -------------------------------------------------------------
+
+
+def test_thread_safety_no_lost_counts():
+    m = MetricsRegistry()
+    n_threads, iters = 8, 300
+    errors = []
+
+    def work():
+        try:
+            for _ in range(iters):
+                m.inc("c")
+                with m.time("t"):
+                    pass
+                m.snapshot()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    snap = m.snapshot()
+    assert snap["counters"]["c"] == n_threads * iters
+    assert snap["timers"]["t"]["count"] == n_threads * iters
+
+
+def test_concurrent_reset_never_resurrects():
+    """A time() block straddling a reset() is discarded at exit: post-reset
+    snapshots only contain observations that started after the reset."""
+    m = MetricsRegistry()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def straddler():
+        with m.time("stale"):
+            entered.set()
+            release.wait(5)
+
+    th = threading.Thread(target=straddler)
+    th.start()
+    assert entered.wait(5)
+    m.reset()          # while the timer is in flight
+    release.set()
+    th.join()
+    assert "stale" not in m.snapshot()["timers"]
+    # a fresh observation after the reset records normally
+    with m.time("stale"):
+        pass
+    assert m.snapshot()["timers"]["stale"]["count"] == 1
+
+
+def test_reset_under_concurrent_hammer():
+    m = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                m.inc("x")
+                with m.time("y"):
+                    pass
+                m.snapshot()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(50):
+        m.reset()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    snap = m.snapshot()  # whatever remains is internally consistent
+    for h in snap["timers"].values():
+        assert h["count"] >= 0 and h["p99_ms"] >= h["p50_ms"] >= 0
+
+
+# -- gauges ------------------------------------------------------------------
+
+
+def test_gauges_value_and_callable():
+    m = MetricsRegistry()
+    m.set_gauge("rows", 42)
+    m.set_gauge("lazy", lambda: 7)
+    m.set_gauge("broken", lambda: 1 / 0)  # must never surface
+    g = m.snapshot()["gauges"]
+    assert g["rows"] == 42 and g["lazy"] == 7
+    assert "broken" not in g
+
+
+def test_gauges_survive_reset():
+    m = MetricsRegistry()
+    m.set_gauge("rows", 1)
+    m.inc("c")
+    m.reset()
+    snap = m.snapshot()
+    assert snap["counters"] == {} and snap["gauges"]["rows"] == 1
+
+
+def test_register_device_gauges():
+    from geomesa_tpu.metrics import register_device_gauges
+    m = MetricsRegistry()
+    register_device_gauges(m)
+    g = m.snapshot()["gauges"]
+    assert g["device.count"] >= 1
+
+
+# -- prometheus exposition ---------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$")
+
+
+def test_prometheus_exposition_parses():
+    m = MetricsRegistry()
+    m.inc("ingest.features", 5)
+    m.set_gauge("store.rows.t", 100)
+    for _ in range(3):
+        with m.time("query.count"):
+            pass
+    text = m.to_prometheus()
+    assert "NaN" not in text
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), line
+    assert "geomesa_tpu_ingest_features_total 5" in text
+    assert "geomesa_tpu_store_rows_t 100" in text
+    for q in ("0.5", "0.9", "0.99"):
+        assert f'geomesa_tpu_query_count_seconds{{quantile="{q}"}}' in text
+    assert "geomesa_tpu_query_count_seconds_count 3" in text
+
+
+def test_prometheus_empty_timer_no_nan():
+    m = MetricsRegistry()
+    m._timers["never"]  # defaultdict: an empty histogram
+    text = m.to_prometheus()
+    assert "NaN" not in text
+    assert "geomesa_tpu_never_seconds_count 0" in text
+    assert 'quantile' not in text  # no quantiles for empty summaries
+
+
+def test_reporter_fires_on_observe():
+    m = MetricsRegistry()
+    seen = []
+    m.add_reporter(lambda kind, name, v: seen.append((kind, name, v)))
+    m.observe("op", 0.5)
+    assert ("timer", "op", 0.5) in seen

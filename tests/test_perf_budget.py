@@ -67,3 +67,49 @@ def test_pruned_count_budget(world):
     pq = world.prepare("BBOX(geom, -10, 5, 10, 25) AND "
                        "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
     assert _p50(pq.count) < 500, "pruned count p50 budget"
+
+
+def test_tracing_overhead_under_5pct():
+    """The observability layer must never silently regress the hot path:
+    span/trace overhead on a 10k-feature count query stays <5% vs
+    ``trace.disabled()``. Estimator: INTERLEAVED minima — each rep times one
+    disabled and one traced call back to back, so host-frequency drift hits
+    both arms equally, and the min-of-each isolates the intrinsic machinery
+    cost from scheduler noise."""
+    from geomesa_tpu import trace
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.features.table import FeatureTable
+
+    rng = np.random.default_rng(5)
+    n = 10_000
+    ds = TpuDataStore()
+    ds.create_schema("ov", "v:Int,*geom:Point")
+    ds.load("ov", FeatureTable.build(ds.get_schema("ov"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "geom": (rng.uniform(-20, 20, n), rng.uniform(-20, 20, n))}))
+    planner = ds.planner("ov")
+    q = "BBOX(geom, -5, -5, 5, 5)"
+
+    def run():
+        planner.count(q)
+
+    def timed():
+        t0 = time.perf_counter()
+        run()
+        return time.perf_counter() - t0
+
+    def measure():
+        base = traced = float("inf")
+        for _ in range(400):
+            with trace.disabled():
+                base = min(base, timed())
+            traced = min(traced, timed())
+        return traced / base - 1.0, base, traced
+
+    run()  # warm: compiles + transfer shapes excluded
+    # noise only ever INFLATES the estimate, so the best of a few rounds is
+    # the intrinsic machinery cost; one clean round proves the bar
+    overhead, base, traced = min(measure() for _ in range(3))
+    assert overhead < 0.05, (
+        f"tracing overhead {overhead:.1%} (traced {traced * 1e6:.0f}us vs "
+        f"disabled {base * 1e6:.0f}us)")

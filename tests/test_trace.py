@@ -1,0 +1,146 @@
+"""Span/trace layer: trace trees on the query path, ring buffer semantics,
+disabled-mode no-ops, explain() dry-run trees (≙ Explainer + QueryEvent)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import trace
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.metrics import REGISTRY
+
+# non-rectangular polygon: its bbox over-approximates, forcing the host
+# f64 refine stage (the square-polygon case resolves device-exact)
+TRIANGLE = "INTERSECTS(geom, POLYGON((-5 -5, 5 -5, 0 6, -5 -5)))"
+
+
+@pytest.fixture(scope="module")
+def planner():
+    rng = np.random.default_rng(7)
+    n = 20000
+    base = np.datetime64("2024-05-01T00:00:00", "ms").astype(np.int64)
+    ds = TpuDataStore()
+    ds.create_schema("tr", "v:Int,dtg:Date,*geom:Point")
+    ds.load("tr", FeatureTable.build(ds.get_schema("tr"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 86400000, n),
+        "geom": (rng.uniform(-20, 20, n), rng.uniform(-20, 20, n))}))
+    return ds.planner("tr")
+
+
+def test_query_trace_tree_and_coverage(planner):
+    """The acceptance bar: a traced query's span tree carries plan /
+    device_scan / device_wait / refine, and span self-times account for
+    >= 90% of the enclosing wall time."""
+    planner.query(TRIANGLE)  # warm: exclude XLA compile from the bar
+    trace.RING.clear()
+    res = planner.query(TRIANGLE)
+    assert len(res.indices) > 0
+    recent = trace.RING.recent()
+    assert len(recent) == 1
+    t = recent[0]
+    assert t["name"] == "query"
+    assert {"plan", "device_scan", "device_wait", "refine"} <= set(
+        t["stages_ms"])
+    coverage = sum(t["stages_ms"].values()) / t["duration_ms"]
+    assert coverage >= 0.9, f"span self-times cover {coverage:.1%} of wall"
+
+
+def test_spans_feed_registry_histograms(planner):
+    REGISTRY.reset()
+    planner.query(TRIANGLE)
+    snap = REGISTRY.snapshot()
+    for name in ("query", "plan", "device_scan", "device_wait", "refine"):
+        assert snap["timers"][name]["count"] >= 1, name
+        assert snap["timers"][name]["p50_ms"] >= 0
+
+
+def test_ring_most_recent_first_and_bounded(planner):
+    trace.RING.clear()
+    for _ in range(5):
+        planner.count("BBOX(geom, -5, -5, 5, 5)")
+    recent = trace.RING.recent()
+    ids = [t["id"] for t in recent]
+    assert ids == sorted(ids, reverse=True)  # newest first
+    assert len(trace.RING.recent(limit=2)) == 2
+    assert len(trace.RING.recent(limit=0)) == 0
+
+
+def test_ring_capacity_bounded():
+    ring = trace.TraceRing(keep=3)
+    for i in range(10):
+        t = trace.QueryTrace(f"q{i}", None)
+        ring.append(t)
+    assert len(ring) == 3
+    names = [t["name"] for t in ring.recent()]
+    assert names == ["q9", "q8", "q7"]
+
+
+def test_disabled_mode_is_a_noop(planner):
+    trace.RING.clear()
+    before = REGISTRY.snapshot()["timers"].get("query", {}).get("count", 0)
+    with trace.disabled():
+        res = planner.query(TRIANGLE)
+        assert trace.current_trace() is None
+    assert len(res.indices) > 0  # results unchanged
+    assert len(trace.RING.recent()) == 0
+    after = REGISTRY.snapshot()["timers"].get("query", {}).get("count", 0)
+    assert after == before  # no registry feed either
+
+
+def test_nested_trace_degrades_to_span():
+    trace.RING.clear()
+    with trace.trace("outer") as t:
+        with trace.trace("inner"):
+            with trace.span("leaf", kind="aggregate"):
+                pass
+    assert t is not None and len(trace.RING.recent()) == 1
+    root = trace.RING.recent()[0]["root"]
+    assert root["name"] == "outer"
+    (inner,) = root["children"]
+    assert inner["name"] == "inner" and inner["children"][0]["name"] == "leaf"
+
+
+def test_self_time_subtracts_children():
+    import time as _time
+    with trace.trace("parent") as t:
+        with trace.span("child", kind="aggregate"):
+            _time.sleep(0.01)
+    child = t.root.children[0]
+    assert child.duration_ms >= 10
+    assert t.root.self_ms == pytest.approx(
+        t.root.duration_ms - child.duration_ms)
+
+
+def test_explain_carries_dry_run_trace(planner):
+    out = planner.explain(TRIANGLE)
+    assert "trace" in out
+    names = {c["name"] for c in out["trace"]["root"].get("children", [])}
+    assert "plan" in names  # plan stage always present on a dry run
+    # no scan executed: a dry run never dispatches a device kernel
+    kinds = set(out["trace"].get("stages_ms", {}))
+    assert "device_scan" not in kinds
+
+
+def test_prepared_count_traced(planner):
+    pq = planner.prepare("BBOX(geom, -5, -5, 5, 5)")
+    pq.count()  # warm
+    trace.RING.clear()
+    n = pq.count()
+    assert n > 0
+    t = trace.RING.recent()[0]
+    assert t["name"] == "count"
+    assert {"device_scan", "device_wait"} <= set(t["stages_ms"])
+
+
+def test_datastore_count_trace_name(planner):
+    # datastore-level root composes: planner.count nests inside query.count
+    ds = TpuDataStore()
+    ds.create_schema("dc", "*geom:Point")
+    ds.load("dc", FeatureTable.build(ds.get_schema("dc"),
+                                     {"geom": ([0.0, 1.0], [0.0, 1.0])}))
+    trace.RING.clear()
+    ds.count("dc", "BBOX(geom, -1, -1, 2, 2)")
+    t = trace.RING.recent()[0]
+    assert t["name"] == "query.count"
+    assert REGISTRY.snapshot()["timers"]["query.count"]["count"] >= 1

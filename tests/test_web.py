@@ -87,8 +87,54 @@ def test_metrics_and_config(server):
     base, ds, x, y = server
     status, m = _get(f"{base}/metrics")
     assert status == 200 and "counters" in m
+    assert "gauges" in m and "timers" in m
     status, c = _get(f"{base}/config")
     assert "GEOMESA_TPU_PRUNE" in c
+
+
+def test_metrics_prometheus_exposition(server):
+    import re
+    base, ds, x, y = server
+    # exercise the traced count path so query.count has a histogram
+    for _ in range(3):
+        _get(f"{base}/types/w/count?cql=" +
+             urllib.parse.quote("BBOX(geom, -5, -5, 5, 5)"))
+    with urllib.request.urlopen(f"{base}/metrics?format=prometheus") as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "NaN" not in text
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$")
+    for line in text.strip().split("\n"):
+        if not line.startswith("#"):
+            assert line_re.match(line), line
+    for q in ("0.5", "0.9", "0.99"):
+        assert f'geomesa_tpu_query_count_seconds{{quantile="{q}"}}' in text
+
+
+def test_traces_endpoint_recent_first_bounded(server):
+    base, ds, x, y = server
+    from geomesa_tpu.trace import RING
+    RING.clear()
+    for i in range(4):
+        _get(f"{base}/types/w/count?cql=" +
+             urllib.parse.quote(f"BBOX(geom, -{i + 1}, -5, 5, 5)"))
+    status, body = _get(f"{base}/traces")
+    assert status == 200
+    ids = [t["id"] for t in body["traces"]]
+    assert len(ids) == 4 and ids == sorted(ids, reverse=True)
+    status, body = _get(f"{base}/traces?limit=2")
+    assert len(body["traces"]) == 2
+    assert body["traces"][0]["id"] == ids[0]  # still newest first
+
+
+def test_healthz(server):
+    base, ds, x, y = server
+    status, body = _get(f"{base}/healthz")
+    assert status == 200
+    assert body["status"] == "ok" and body["devices"] >= 1
+    assert body["types"] == 1
 
 
 def test_bad_cql_is_400(server):
