@@ -10,9 +10,12 @@ Covers the five BASELINE.md configs:
      device gather) and the full-mask scan; blocking p50 (includes one
      device->host round trip — the RTT is MEASURED and reported separately,
      cfg1_rtt_p50_ms), pipelined per-query latency (async dispatches, one
-     readback — the sustained-throughput number), index build time, and the
-     same query on two CPU comparators: single-core numpy full scan and the
-     CpuGridIndex indexed store at full scale.
+     readback — the sustained-throughput number), index build time, the
+     micro-batching scheduler under 64 concurrent client threads
+     (cfg1_scheduler_qps / cfg1_scheduler_p50_ms vs cfg1_unbatched_qps —
+     the end-to-end serving numbers the batch64 kernel figure feeds), and
+     the same query on two CPU comparators: single-core numpy full scan and
+     the CpuGridIndex indexed store at full scale.
   2. XZ2 index: st_intersects polygon query over small linestring extents
      (device envelope prefilter + exact host refine), p50.
   3. Spatial join: point-in-polygon counts, points/sec/chip.
@@ -302,12 +305,13 @@ def main() -> None:
         # union of their candidate blocks — the per-dispatch RPC overhead
         # amortizes across the batch, exposing the true per-query device cost
         t0 = time.perf_counter()
-        bplans, bblocks = [], []
+        bplans, bblocks, bqueries = [], [], []
         for i in range(64):
             ddx, ddy = (i % 8) * 0.4, (i // 8) * 0.3
             qb = (f"BBOX(geom, {qx0 + ddx}, {qy0 + ddy}, {qx1 + ddx}, "
                   f"{qy1 + ddy}) AND dtg DURING "
                   "2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+            bqueries.append(qb)
             pl = planner.plan(qb)
             bl = planner._pruned_blocks(pl)
             if bl is None:
@@ -335,6 +339,69 @@ def main() -> None:
             per_q = (time.perf_counter() - t0) * 1000 / (nb_batches * 64)
             detail["cfg1_batch64_per_query_ms"] = round(per_q, 4)
             detail["cfg1_batch64_qps"] = round(1000 / per_q, 0)
+
+        # scheduler serving: 64 concurrent client threads against the
+        # micro-batching scheduler (serve/scheduler.py — requests coalesce
+        # into fused dispatches, plans/covers cache) vs the same threads on
+        # the unbatched per-request path (every call plans + dispatches
+        # alone). This is the end-to-end serving number the batch64 kernel
+        # figure feeds.
+        if len(bplans) == 64:
+            import threading
+
+            from geomesa_tpu.serve.scheduler import (PlannerBinding,
+                                                     QueryScheduler)
+            # window sized for the client population: 64 synchronous
+            # clients resubmit within a few ms of a batch resolving, so an
+            # 8ms cap lets batches refill instead of fragmenting
+            sched = QueryScheduler(PlannerBinding({"gdelt": planner}),
+                                   flush_size=64, window_us=8000)
+            n_threads = 64
+
+            def run_clients(fn, reps_c):
+                lats: list = []
+                llock = threading.Lock()
+                barrier = threading.Barrier(n_threads + 1)
+
+                def client(i):
+                    q = bqueries[i % len(bqueries)]
+                    mine = []
+                    barrier.wait()
+                    for _ in range(reps_c):
+                        tq = time.perf_counter()
+                        fn(q)
+                        mine.append(time.perf_counter() - tq)
+                    with llock:
+                        lats.extend(mine)
+
+                ths = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_threads)]
+                for th in ths:
+                    th.start()
+                barrier.wait()
+                tw = time.perf_counter()
+                for th in ths:
+                    th.join()
+                return lats, time.perf_counter() - tw
+
+            sched.count_many("gdelt", bqueries)  # warm: plans+covers cache
+            lat_s, wall_s = run_clients(
+                lambda q: sched.count("gdelt", q), 8)
+            detail["cfg1_scheduler_qps"] = round(len(lat_s) / wall_s, 1)
+            detail["cfg1_scheduler_p50_ms"] = round(_p50(lat_s), 3)
+            st = sched.stats()
+            detail["cfg1_scheduler_plan_hit_rate"] = \
+                st["plan_cache"]["hit_rate"]
+            detail["cfg1_scheduler_flush_reasons"] = st["flush_reasons"]
+            sched.shutdown()
+            for q in bqueries[:4]:
+                planner.count(q)  # warm the unbatched comparator path
+            lat_u, wall_u = run_clients(lambda q: planner.count(q), 2)
+            detail["cfg1_unbatched_qps"] = round(len(lat_u) / wall_u, 1)
+            detail["cfg1_unbatched_p50_ms"] = round(_p50(lat_u), 3)
+            detail["cfg1_scheduler_vs_unbatched"] = round(
+                detail["cfg1_scheduler_qps"]
+                / max(detail["cfg1_unbatched_qps"], 1e-9), 2)
 
         # full-mask scan for comparison (same query, pruning disabled)
         os.environ["GEOMESA_TPU_PRUNE"] = "0"

@@ -118,6 +118,43 @@ BENCH_N = _register(
     "GEOMESA_TPU_BENCH_N", 100_000_000, int,
     "bench.py corpus size.")
 
+SCHED_ENABLED = _register(
+    "GEOMESA_TPU_SCHEDULER", True, _parse_bool,
+    "Master switch for the micro-batching query scheduler on the serving "
+    "path (web /count coalescing). Off: every request plans and dispatches "
+    "individually.")
+
+SCHED_FLUSH_SIZE = _register(
+    "GEOMESA_TPU_SCHED_FLUSH_SIZE", 64, int,
+    "Max queries fused into one batched device dispatch (flush-at-B). "
+    "Matches the batched scan kernel's sweet spot (BENCH cfg1 batch64).")
+
+SCHED_WINDOW_US = _register(
+    "GEOMESA_TPU_SCHED_WINDOW_US", 1500, int,
+    "Max micro-batch collection window in microseconds (flush-at-T). The "
+    "scheduler adapts the live window between SCHED_MIN_WINDOW_US and this "
+    "cap from observed batch sizes; lone queries never wait the full cap.")
+
+SCHED_MIN_WINDOW_US = _register(
+    "GEOMESA_TPU_SCHED_MIN_WINDOW_US", 100, int,
+    "Floor of the adaptive collection window (latency bound at low traffic).")
+
+SCHED_PLAN_CACHE = _register(
+    "GEOMESA_TPU_SCHED_PLAN_CACHE", 512, int,
+    "Plan-cache capacity (normalized filter + generation + auths -> plan). "
+    "0 disables plan caching.")
+
+SCHED_COVER_CACHE = _register(
+    "GEOMESA_TPU_SCHED_COVER_CACHE", 256, int,
+    "Cover-cache capacity (boxes/windows -> candidate gather blocks). "
+    "0 disables cover caching.")
+
+KERNEL_CACHE = _register(
+    "GEOMESA_TPU_KERNEL_CACHE", 128, int,
+    "Max compiled scan kernels retained per index (LRU). Long-lived servers "
+    "with many residual structures stay bounded; evicted signatures "
+    "recompile on next use.")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

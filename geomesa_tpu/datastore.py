@@ -120,6 +120,13 @@ class TpuDataStore:
         self._stats: Dict[str, object] = {}
         self._counters: Dict[str, int] = {}
         self._interceptors: Dict[str, list] = {}
+        # per-type mutation generation (serve-path cache invalidation): every
+        # ingest/flush/age-off/update/delete/schema-change bumps it, so a
+        # plan or cover cached against generation g is unreachable once the
+        # data it described has changed. Monotonic per NAME — it survives
+        # remove_schema so a re-created type can't resurrect stale plans.
+        self._generations: Dict[str, int] = {}
+        self._scheduler = None  # lazy QueryScheduler (serve/scheduler.py)
         # audit trail (≙ AuditWriter): params {"audit": True | "path.jsonl"}
         audit_param = self.params.get("audit")
         if audit_param:
@@ -163,7 +170,10 @@ class TpuDataStore:
     def remove_schema(self, type_name: str) -> None:
         with self._lock:
             # _interceptors/_counters included: a re-created type of the same
-            # name must not inherit the old type's guards or fid sequence
+            # name must not inherit the old type's guards or fid sequence.
+            # _generations deliberately excluded (bumped instead): cached
+            # plans must not survive a drop/re-create of the same name.
+            self._bump_generation(type_name)
             for d in (self.schemas, self.tables, self.planners, self._stats,
                       self.deltas, self._counters, self._interceptors):
                 d.pop(type_name, None)
@@ -194,6 +204,9 @@ class TpuDataStore:
     def _append_locked(self, type_name, batch, stats_cached=None) -> None:
         from geomesa_tpu.metrics import REGISTRY as _metrics
         _metrics.inc("ingest.features", len(batch))
+        # every append changes query results (even a delta-tier landing), so
+        # the serving caches must miss from here on
+        self._bump_generation(type_name)
         # already-expired incoming rows never land (O(batch) mask; the
         # reference's write-path expiry check)
         batch, _ = self._apply_age_off(type_name, batch)
@@ -241,6 +254,7 @@ class TpuDataStore:
                 return
             with _trace.span("ingest.flush", kind="aggregate",
                              type=type_name):
+                self._bump_generation(type_name)
                 self.deltas[type_name] = None
                 merged = FeatureTable.concat([self.tables[type_name], delta])
                 # dtg age-off rides the flush (≙ compaction-time age-off
@@ -288,6 +302,7 @@ class TpuDataStore:
                 table = FeatureTable.concat([table, delta])
             table2, n = self._apply_age_off(type_name, table, now_ms)
             if n or delta is not None:
+                self._bump_generation(type_name)
                 self.deltas[type_name] = None
                 self.tables[type_name] = table2
                 self._rebuild_indexes(type_name)
@@ -374,6 +389,64 @@ class TpuDataStore:
             c = self._counters.get(type_name, 0)
             self._counters[type_name] = c + 1
             return c
+
+    # -- serve-path cache generation ----------------------------------------
+
+    def _bump_generation(self, type_name: str) -> None:
+        """Advance the type's mutation generation (callers hold the lock)."""
+        self._generations[type_name] = self._generations.get(type_name, 0) + 1
+
+    def generation(self, type_name: str) -> int:
+        """Current mutation generation — the serving caches' invalidation
+        token (≙ the reference's metadata/stats cache expiry, made exact)."""
+        with self._lock:
+            return self._generations.get(type_name, 0)
+
+    def _sched_snapshot(self, type_name: str):
+        """(planner, delta, generation) captured atomically for the query
+        scheduler — the scheduler-side twin of ``_snapshot``."""
+        with self._lock:
+            return (self._main_planner(type_name),
+                    self.deltas.get(type_name),
+                    self._generations.get(type_name, 0))
+
+    def scheduler(self):
+        """The store's micro-batching query scheduler (lazily started; one
+        per store). Concurrent counts submitted here coalesce into fused
+        batched device dispatches — see serve/scheduler.py."""
+        with self._lock:
+            if self._scheduler is None:
+                from geomesa_tpu.serve.scheduler import (QueryScheduler,
+                                                         StoreBinding)
+                self._scheduler = QueryScheduler(StoreBinding(self))
+            return self._scheduler
+
+    def count_many(self, type_name: str, filters,
+                   auths: Optional[list] = None) -> List[int]:
+        """Counts for many filters through the scheduler: compatible queries
+        fuse into single batched device dispatches; repeated/parameterized
+        filters hit the plan/cover caches. Order-preserving."""
+        return self.scheduler().count_many(type_name, filters, auths=auths)
+
+    def count_future(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
+                     auths: Optional[list] = None):
+        """Async count: submit to the scheduler and return the Request
+        handle (``.result()`` blocks; ``.future`` is a concurrent.futures
+        Future) — the serving-path analogue of PreparedQuery.count_async."""
+        return self.scheduler().submit(type_name, f, auths=auths)
+
+    def count_coalesced(self, type_name: str,
+                        f: Union[str, ir.Filter] = "INCLUDE",
+                        auths: Optional[list] = None) -> int:
+        """Count via the scheduler when serving coalescing is enabled
+        (GEOMESA_TPU_SCHEDULER / params {'scheduler': False}); otherwise the
+        direct per-request path. The web /count route calls this, so
+        concurrent HTTP requests share device dispatches."""
+        from geomesa_tpu import config
+        if not config.SCHED_ENABLED.get() \
+                or self.params.get("scheduler") is False:
+            return self.count(type_name, f, auths=auths)
+        return self.scheduler().count(type_name, f, auths=auths)
 
     # -- queries ------------------------------------------------------------
 
@@ -593,6 +666,7 @@ class TpuDataStore:
                             val = v.astype("datetime64[ms]").astype(np.int64)
                     arr[rows] = val
                     cols[name] = arr
+            self._bump_generation(type_name)
             self.tables[type_name] = FeatureTable(
                 table.sft, table._fids, cols, table.visibility,
                 _n=len(table))
@@ -641,6 +715,7 @@ class TpuDataStore:
             if new_name in self.schemas:
                 raise ValueError(f"Schema {new_name} already exists")
             self.remove_schema(type_name)
+        self._bump_generation(final)
         self.schemas[final] = out
         # the stat battery is built against the OLD attribute set — drop it
         # so the rebuild re-observes with the evolved schema
@@ -664,6 +739,7 @@ class TpuDataStore:
                 return 0
             keep = np.ones(len(planner.table), dtype=bool)
             keep[rows] = False
+            self._bump_generation(type_name)
             self.tables[type_name] = planner.table.take(np.nonzero(keep)[0])
             self._rebuild_indexes(type_name)
             return int(len(rows))

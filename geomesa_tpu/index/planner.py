@@ -209,19 +209,27 @@ class QueryPlanner:
         if isinstance(plan, UnionScanPlan):
             # branches fold the auths mask individually at execution time
             return plan
-        plan.explain["__vis_applied__"] = True
         import dataclasses
 
         import jax.numpy as jnp
 
         from geomesa_tpu.security.visibility import allowed_codes
 
+        # the __vis_applied__ marker lands in a COPIED explain dict on the
+        # replaced plan only: dataclasses.replace shares the explain dict, so
+        # marking the original would make a reused plan (prepared query,
+        # plan cache, union branch) silently skip the auths fold on its next
+        # execution — exactly the privileged-plan leak the marker guards
+        # against double-folding, inverted
+        marked = dict(plan.explain, __vis_applied__=True)
         vocab = self.table.visibility.vocab
         allowed = allowed_codes(vocab, auths)
         if len(allowed) == len(vocab):
-            return plan  # every expression visible — no mask needed
+            # every expression visible — no mask needed, but still mark the
+            # handed-back plan so a re-apply is a no-op
+            return dataclasses.replace(plan, explain=marked)
         if len(allowed) == 0:
-            return dataclasses.replace(plan, empty=True)
+            return dataclasses.replace(plan, empty=True, explain=marked)
         padded = _pad_pow2(allowed, fill=-1)
         key, params, fn = plan.residual_device or ("none", [], None)
         i = len(params)
@@ -231,8 +239,9 @@ class QueryPlanner:
             return m if fn is None else (m & fn(cols, p))
 
         return dataclasses.replace(
-            plan, residual_device=(f"vis{len(padded)}&({key})",
-                                   list(params) + [padded], fn2))
+            plan, explain=marked,
+            residual_device=(f"vis{len(padded)}&({key})",
+                             list(params) + [padded], fn2))
 
     def _fid_vis_filter(self, rows: np.ndarray, auths) -> np.ndarray:
         if auths is None or self.table.visibility is None or len(rows) == 0:

@@ -52,34 +52,40 @@ def _le62(hi, lo, qhi, qlo):
     return (hi < qhi) | ((hi == qhi) & (lo <= qlo))
 
 
-def _point_box_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
-    """Any-box containment for point layers — EXACT (fp62 planes).
-
-    boxes (B, 8) int32: [qxlo_hi, qxlo_lo, qxhi_hi, qxhi_lo,
-                         qylo_hi, qylo_lo, qyhi_hi, qyhi_lo].
-    Empty boxes use qlo=max/qhi=0 so nothing matches.
-    """
+def _point_box_pairwise(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """(N, B) per-box containment matrix for point layers — EXACT (fp62
+    planes). boxes (B, 8) int32: [qxlo_hi, qxlo_lo, qxhi_hi, qxhi_lo,
+    qylo_hi, qylo_lo, qyhi_hi, qyhi_lo]. Empty boxes use qlo=max/qhi=0 so
+    nothing matches."""
     xi, xl = cols["xi"][:, None], cols["xl"][:, None]
     yi, yl = cols["yi"][:, None], cols["yl"][:, None]
     b = boxes[None, :, :]
-    m = (
+    return (
         _ge62(xi, xl, b[..., 0], b[..., 1]) & _le62(xi, xl, b[..., 2], b[..., 3])
         & _ge62(yi, yl, b[..., 4], b[..., 5]) & _le62(yi, yl, b[..., 6], b[..., 7])
     )
-    return jnp.any(m, axis=1)
 
 
-def _bbox_overlap_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
-    """Any-box envelope-overlap for extent layers — EXACT on envelopes
-    (geometry-level refinement is the spatial residual's job)."""
+def _point_box_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """Any-box containment for point layers — EXACT (fp62 planes)."""
+    return jnp.any(_point_box_pairwise(cols, boxes), axis=1)
+
+
+def _bbox_overlap_pairwise(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """(N, B) per-box envelope-overlap matrix for extent layers — EXACT on
+    envelopes (geometry-level refinement is the spatial residual's job)."""
     b = boxes[None, :, :]
-    m = (
+    return (
         _le62(cols["bxmin_i"][:, None], cols["bxmin_l"][:, None], b[..., 2], b[..., 3])
         & _ge62(cols["bxmax_i"][:, None], cols["bxmax_l"][:, None], b[..., 0], b[..., 1])
         & _le62(cols["bymin_i"][:, None], cols["bymin_l"][:, None], b[..., 6], b[..., 7])
         & _ge62(cols["bymax_i"][:, None], cols["bymax_l"][:, None], b[..., 4], b[..., 5])
     )
-    return jnp.any(m, axis=1)
+
+
+def _bbox_overlap_mask(cols, boxes: jnp.ndarray) -> jnp.ndarray:
+    """Any-box envelope-overlap for extent layers."""
+    return jnp.any(_bbox_overlap_pairwise(cols, boxes), axis=1)
 
 
 def _time_mask(cols, windows: jnp.ndarray) -> jnp.ndarray:
@@ -98,6 +104,14 @@ def _time_mask(cols, windows: jnp.ndarray) -> jnp.ndarray:
 PRIMARY_FNS: Dict[str, Callable] = {
     "point_boxes": _point_box_mask,
     "bbox_overlap": _bbox_overlap_mask,
+}
+
+# device columns each primary mask reads (batch kernels pre-touch these
+# before entering a mapped body — see count_multi_blocks)
+_PRIMARY_COLS: Dict[str, tuple] = {
+    "point_boxes": ("xi", "xl", "yi", "yl"),
+    "bbox_overlap": ("bxmin_i", "bxmin_l", "bxmax_i", "bxmax_l",
+                     "bymin_i", "bymin_l", "bymax_i", "bymax_l"),
 }
 
 
@@ -396,53 +410,104 @@ class _LazyBlockGather:
 
 
 _TRANSFER_SHAPES_WARMED = False
+# batch tiers already pre-touched — warm_transfer_shapes(batch_sizes=...)
+# extends this set for the scheduler's flush sizes
+_WARMED_BATCH_SIZES: set = set()
 
 
-def warm_transfer_shapes() -> None:
+def warm_transfer_shapes(batch_sizes=()) -> None:
     """Pre-touch the small host→device transfer shapes queries use.
 
     Through the axon RPC tunnel the FIRST device_put of each new array shape
     blocks ~140ms (per-shape channel setup); afterwards the same shape
     transfers in sub-ms. Warming the power-of-two box/window/param shapes at
     index-build time moves that cost out of the cold-query path (the r2 bench
-    showed plan+stage at 265ms — all of it was two cold transfer shapes)."""
+    showed plan+stage at 265ms — all of it was two cold transfer shapes).
+
+    ``batch_sizes``: extra coalesced-batch tiers to warm (boxes/windows/
+    params at each size) — the micro-batching scheduler passes its flush
+    tiers at construction so the FIRST fused dispatch doesn't eat the
+    per-shape transfer cliff. Each size rounds up to the next power of two
+    (the pad the dispatch path actually ships) and warms at most once."""
     global _TRANSFER_SHAPES_WARMED
-    if _TRANSFER_SHAPES_WARMED:
-        return
-    _TRANSFER_SHAPES_WARMED = True
     import jax
     puts = []
-    for b in (1, 2, 4, 8, 16):
-        puts.append(jax.device_put(np.zeros((b, 8), np.int32)))   # boxes
-        puts.append(jax.device_put(np.zeros((b, 4), np.int32)))   # windows
-        puts.append(jax.device_put(np.zeros((b,), np.int32)))     # params
-    for b in (32, 64):
-        puts.append(jax.device_put(np.zeros((b, 8), np.int32)))   # batch boxes
-    # padded block-id vectors (_pad_blocks pow2 tiers): a cold query's
-    # candidate-block upload was the r4 plan-stage cost (131ms measured —
-    # one per-shape channel setup through the tunnel)
-    for nb in (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
-               16384, 32768, 65536):
-        puts.append(jax.device_put(np.zeros((nb,), np.int32)))
-    puts.append(jax.device_put(np.zeros((), np.int32)))
-    puts.append(jax.device_put(np.zeros((), np.float32)))
-    jax.block_until_ready(puts)
+    if not _TRANSFER_SHAPES_WARMED:
+        _TRANSFER_SHAPES_WARMED = True
+        for b in (1, 2, 4, 8, 16):
+            puts.append(jax.device_put(np.zeros((b, 8), np.int32)))  # boxes
+            puts.append(jax.device_put(np.zeros((b, 4), np.int32)))  # windows
+            puts.append(jax.device_put(np.zeros((b,), np.int32)))    # params
+            _WARMED_BATCH_SIZES.add(b)
+        for b in (32, 64):
+            puts.append(jax.device_put(np.zeros((b, 8), np.int32)))  # batch boxes
+        # padded block-id vectors (_pad_blocks pow2 tiers): a cold query's
+        # candidate-block upload was the r4 plan-stage cost (131ms measured —
+        # one per-shape channel setup through the tunnel)
+        for nb in (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                   16384, 32768, 65536):
+            puts.append(jax.device_put(np.zeros((nb,), np.int32)))
+        puts.append(jax.device_put(np.zeros((), np.int32)))
+        puts.append(jax.device_put(np.zeros((), np.float32)))
+    for b in batch_sizes:
+        b = max(1, 1 << max(0, (int(b) - 1)).bit_length())
+        if b in _WARMED_BATCH_SIZES:
+            continue
+        _WARMED_BATCH_SIZES.add(b)
+        puts.append(jax.device_put(np.zeros((b, 8), np.int32)))      # boxes
+        puts.append(jax.device_put(np.zeros((b, 4), np.int32)))      # windows
+        puts.append(jax.device_put(np.zeros((b,), np.int32)))        # params
+    if puts:
+        jax.block_until_ready(puts)
+
+
+import weakref
+
+# live ScanKernels instances (weak: a dropped index frees its kernels)
+_KERNEL_INSTANCES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _register_kernel_gauge() -> None:
+    """`kernels.compiled` gauge: compiled scan kernels resident across every
+    live ScanKernels instance (the quantity the per-instance LRU bounds)."""
+    global _KERNEL_GAUGE_REGISTERED
+    if _KERNEL_GAUGE_REGISTERED:
+        return
+    _KERNEL_GAUGE_REGISTERED = True
+    from geomesa_tpu.metrics import REGISTRY
+    REGISTRY.set_gauge(
+        "kernels.compiled",
+        lambda: sum(len(k._jitted) for k in list(_KERNEL_INSTANCES)))
+
+
+_KERNEL_GAUGE_REGISTERED = False
 
 
 class ScanKernels:
-    """Compiled-scan cache for one DeviceTable (one index)."""
+    """Compiled-scan cache for one DeviceTable (one index).
+
+    ``_jitted`` is a small LRU (``GEOMESA_TPU_KERNEL_CACHE`` signatures):
+    long-lived servers seeing many residual structures stay bounded instead
+    of accumulating compiled kernels forever; an evicted signature simply
+    recompiles on next use (prepared dispatchers hold their own reference,
+    so in-flight handles never lose their kernel)."""
 
     def __init__(self, device_cols: Dict[str, jnp.ndarray]):
         self.cols = device_cols
-        self._jitted: Dict[tuple, Callable] = {}
+        from collections import OrderedDict
+        self._jitted: "OrderedDict[tuple, Callable]" = OrderedDict()
+        _KERNEL_INSTANCES.add(self)
+        _register_kernel_gauge()
         warm_transfer_shapes()
 
     def _get(self, mode: str, primary_kind: str, has_time: bool,
              residual_key: str, residual_fn, n_boxes: int, n_windows: int,
              capacity: int = 0):
         key = (mode, primary_kind, has_time, residual_key, n_boxes, n_windows, capacity)
-        if key in self._jitted:
-            return self._jitted[key]
+        hit = self._jitted.get(key)
+        if hit is not None:
+            self._jitted.move_to_end(key)
+            return hit
         mask_fn = _mask_kernel(primary_kind, has_time, residual_key, n_boxes, n_windows)
 
         if mode == "count":
@@ -555,7 +620,14 @@ class ScanKernels:
                 # gather happens once, then each box is a cheap mask over
                 # the resident candidates. Per-query cost collapses to
                 # microseconds (the per-dispatch RPC overhead amortizes
-                # across the whole batch).
+                # across the whole batch). The per-box scans run through
+                # lax.map with a small vmapped batch_size: loop machinery
+                # costs ~0.4ms/iteration on the CPU backend (a fixed ~28ms
+                # floor for a 64-query batch regardless of scan size), so
+                # chunking 8 boxes per iteration cuts that 8x while keeping
+                # the materialized pairwise mask bounded to 8 columns (the
+                # full (rows, B) matrix measured SLOWER — broadcast
+                # intermediates blow the cache).
                 def run(cols, boxes, windows, rparams, block_ids):
                     valid, _, g = expand_blocks(cols, block_ids)
                     base = valid
@@ -565,13 +637,19 @@ class ScanKernels:
                         base = base & residual_fn(g, rparams)
                     if "__valid__" in g:
                         base = base & g["__valid__"]
+                    # materialize the primary's columns OUTSIDE the mapped
+                    # body: the lazy gather caches per column, and a first
+                    # touch inside the scan would leak a traced value
+                    for k in _PRIMARY_COLS[primary_kind]:
+                        g[k]
 
                     def one(b):
                         return jnp.sum(
                             PRIMARY_FNS[primary_kind](g, b[None, :]) & base)
 
                     from jax import lax
-                    return lax.map(one, boxes)
+                    return lax.map(one, boxes,
+                                   batch_size=min(8, boxes.shape[0]))
             elif mode == "topk_blocks":
                 # pruned KNN: top_k over gathered candidate blocks only.
                 # lax.top_k lowers to a full sort of its operand on TPU, so
@@ -677,6 +755,12 @@ class ScanKernels:
 
         jitted = jax.jit(run)
         self._jitted[key] = jitted
+        from geomesa_tpu import config
+        # NB fresh name: the mode closures above capture _get locals (cap,
+        # width, …) late — rebinding them here would rewrite the kernel
+        lru_cap = max(1, config.KERNEL_CACHE.get())
+        while len(self._jitted) > lru_cap:
+            self._jitted.popitem(last=False)
         return jitted
 
     # public API ------------------------------------------------------------
@@ -734,19 +818,29 @@ class ScanKernels:
         sel = out[1: 1 + cnt].astype(np.int64)
         return positions[sel], cnt
 
-    def counts_multi(self, primary_kind, boxes: np.ndarray, windows,
-                     residual) -> np.ndarray:
-        """Per-box counts for a (B, 8) box array: one upload, one kernel,
-        one readback — B counts for the price of one round trip. B pads to a
-        power of two (EMPTY_BOX rows count zero) to share compilations."""
+    def prepare_counts_multi(self, primary_kind, boxes: np.ndarray, windows,
+                             residual):
+        """Zero-arg async dispatcher → per-box count device array over the
+        FULL table (the batched serving path when range pruning declined).
+        B pads to a power of two (EMPTY_BOX rows count zero) to share
+        compilations; callers slice the readback to len(boxes)."""
         b = pad_boxes(boxes)
         fn = self._get("count_multi", primary_kind, windows is not None,
                        residual[0] if residual else "none",
                        residual[2] if residual else None,
                        b.shape[0],
                        0 if windows is None else windows.shape[0])
+        cols = self.cols
+        db, w = _dev(b), _dev(windows)
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        out = np.asarray(_fetch(fn, self.cols, _dev(b), _dev(windows), rp))
+        return lambda: fn(cols, db, w, rp)
+
+    def counts_multi(self, primary_kind, boxes: np.ndarray, windows,
+                     residual) -> np.ndarray:
+        """Per-box counts for a (B, 8) box array: one upload, one kernel,
+        one readback — B counts for the price of one round trip."""
+        out = np.asarray(_fetch(self.prepare_counts_multi(
+            primary_kind, boxes, windows, residual)))
         return out[: len(boxes)]
 
     def prepare_count(self, primary_kind, boxes, windows, residual):

@@ -93,6 +93,19 @@ class Histogram:
             "p99_ms": round(self.percentile(0.99) * 1000, 3),
         }
 
+    def to_value_dict(self) -> dict:
+        """Raw-unit summary for value histograms (batch sizes, queue depths —
+        anything that isn't a duration; no ms conversion)."""
+        return {
+            "count": self.count,
+            "total": round(self.total_s, 6),
+            "mean": round(self.total_s / self.count, 3) if self.count else 0.0,
+            "max": round(self.max_s, 3),
+            "p50": round(self.percentile(0.50), 3),
+            "p90": round(self.percentile(0.90), 3),
+            "p99": round(self.percentile(0.99), 3),
+        }
+
 
 class MetricsRegistry:
     """Thread-safe counters + histogram timers + gauges."""
@@ -102,6 +115,10 @@ class MetricsRegistry:
         self._gen = 0
         self._counters: Dict[str, int] = defaultdict(int)
         self._timers: Dict[str, Histogram] = defaultdict(Histogram)
+        # value histograms: same log-bucket geometry, raw units (batch
+        # sizes, flush waits in queries, …) — the scheduler's distribution
+        # surface. Buckets start at 1e-6 so any positive value lands exactly.
+        self._values: Dict[str, Histogram] = defaultdict(Histogram)
         self._gauges: Dict[str, object] = {}  # value or zero-arg callable
         self._reporters: List[Callable[[str, str, float], None]] = []
         # span trees awaiting histogram feed (GIL-atomic appends from trace
@@ -133,6 +150,12 @@ class MetricsRegistry:
         if reporters:
             for name, seconds in pairs:
                 self._report(reporters, "timer", name, seconds)
+
+    def observe_value(self, name: str, value: float) -> None:
+        """Record one raw-unit observation (NOT a duration) into the name's
+        value histogram — batch sizes, cover cardinalities, queue depths."""
+        with self._lock:
+            self._values[name].observe(value)
 
     def feed_tree(self, root) -> None:
         """Defer a whole span tree (an object with ``walk()`` yielding nodes
@@ -214,6 +237,8 @@ class MetricsRegistry:
             out = {
                 "counters": dict(self._counters),
                 "timers": {k: h.to_dict() for k, h in self._timers.items()},
+                "histograms": {k: h.to_value_dict()
+                               for k, h in self._values.items()},
                 "gauges": gauges,
             }
         if pairs:
@@ -249,6 +274,14 @@ class MetricsRegistry:
                         f'{m}{{quantile="{q}"}} {h[key] / 1000:.9g}')
             lines.append(f"{m}_count {h['count']}")
             lines.append(f"{m}_sum {h['total_s']:.9g}")
+        for name, h in sorted(snap["histograms"].items()):
+            m = sane(name)  # raw units: no _seconds suffix
+            lines.append(f"# TYPE {m} summary")
+            if h["count"]:
+                for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                    lines.append(f'{m}{{quantile="{q}"}} {h[key]:.9g}')
+            lines.append(f"{m}_count {h['count']}")
+            lines.append(f"{m}_sum {h['total']:.9g}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -259,6 +292,7 @@ class MetricsRegistry:
             self._gen += 1
             self._counters.clear()
             self._timers.clear()
+            self._values.clear()
             self._pending.clear()  # same straddling-discard semantics
 
 

@@ -1,0 +1,6 @@
+"""Serving-path runtime: the adaptive micro-batching query scheduler and its
+plan/cover caches (≙ the amortize-per-query-cost discipline of the reference's
+server-side scans, applied to concurrent request traffic)."""
+
+from geomesa_tpu.serve.scheduler import (PlannerBinding,  # noqa: F401
+                                         QueryScheduler, StoreBinding)

@@ -1,0 +1,542 @@
+"""Adaptive micro-batching query scheduler + plan/cover caching (serving path).
+
+The whole GeoMesa design amortizes per-query cost by pushing work close to
+the data; the TPU build's batched scan kernel proves the same point for
+dispatch cost — BENCH cfg1 measures ~0.19ms/query at batch 64 against a
+~4.9ms pipelined / ~107ms blocking single-query floor that is dispatch/RTT
+bound, not device bound. This module closes that gap for concurrent traffic:
+
+  submit → [plan cache] → micro-batch window → group by kernel key →
+  ONE fused device dispatch per group → double-buffered completion
+
+Concurrent count requests are grouped by compatible kernel signature (same
+index kernels, primary kind, time windows, device residual) and fused into a
+single ``counts_multi[_blocks]`` dispatch over the union of their candidate
+blocks. An adaptive window flushes at B queries or T µs, whichever first;
+the collector thread plans/dispatches batch N+1 while the completer thread
+waits on batch N's in-flight device round trip, so host planning overlaps
+the RTT instead of summing with it.
+
+Caching in front of the batcher:
+
+  plan cache   (type, generation, normalized filter, auths) → folded plan.
+               A hit skips parse + strategy selection + auths fold entirely
+               (the trace tree shows no ``plan`` span). Keyed by auths so a
+               privileged query's visibility-folded plan can never serve an
+               unprivileged caller (tests/test_security.py).
+  cover cache  (type, generation, index, boxes, windows) → candidate gather
+               blocks. Parameterized queries that share a spatial/temporal
+               region but differ in residual or auths skip the host range
+               decomposition.
+
+Both invalidate through the datastore's per-type generation counter: every
+mutation (ingest append, LSM flush, age-off, update, delete, schema change)
+bumps the generation, so a stale cached plan is unreachable by construction.
+
+Thread model: callers submit from any thread and block on a per-request
+future; one collector thread owns batching/planning/dispatch, one completer
+thread owns device readbacks + host fallbacks. Requests capture a consistent
+(planner, delta, generation) snapshot at submit time, so a mid-flush mutation
+never pairs a pre-flush plan with post-flush state.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+_pc = time.perf_counter
+_MISS = object()
+_STOP = object()
+
+
+# -- caches -------------------------------------------------------------------
+
+
+class LruCache:
+    """Small thread-safe LRU with hit/miss counters fed to the metrics
+    registry under ``<prefix>.hits`` / ``<prefix>.misses``. ``capacity <= 0``
+    disables the cache (every get misses, puts drop)."""
+
+    def __init__(self, capacity: int, metric_prefix: str):
+        self._d: "OrderedDict" = OrderedDict()
+        self._cap = int(capacity)
+        self._prefix = metric_prefix
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        """Cached value or the module ``_MISS`` sentinel (values may
+        legitimately be None — a declined cover)."""
+        with self._lock:
+            if self._cap > 0 and key in self._d:
+                self._d.move_to_end(key)
+                self.hits += 1
+                hit = True
+                out = self._d[key]
+            else:
+                self.misses += 1
+                hit = False
+                out = _MISS
+        _metrics.inc(f"{self._prefix}.hits" if hit else f"{self._prefix}.misses")
+        return out
+
+    def put(self, key, value) -> None:
+        if self._cap <= 0:
+            return
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self._cap:
+                self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"size": len(self._d), "capacity": self._cap,
+                    "hits": self.hits, "misses": self.misses,
+                    "hit_rate": round(self.hits / total, 4) if total else 0.0}
+
+
+# -- bindings -----------------------------------------------------------------
+
+
+class StoreBinding:
+    """Bind a scheduler to a TpuDataStore: snapshots are (planner, delta,
+    generation) captured atomically w.r.t. mutations; delta rows evaluate
+    host-side exactly like the store's own count path."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def snapshot(self, type_name: str):
+        return self.store._sched_snapshot(type_name)
+
+    def delta_rows(self, delta, f, auths):
+        return self.store._delta_rows(delta, f, auths)
+
+
+class PlannerBinding:
+    """Bind a scheduler to bare QueryPlanners (bench / tests — no store, no
+    delta tier, one immutable generation)."""
+
+    def __init__(self, planners: Dict[str, object]):
+        self._planners = dict(planners)
+
+    def snapshot(self, type_name: str):
+        return self._planners[type_name], None, 0
+
+    def delta_rows(self, delta, f, auths):
+        return ()
+
+
+# -- requests -----------------------------------------------------------------
+
+
+class Request:
+    """One in-flight scheduled query. ``result()`` blocks for the count;
+    the timing fields feed the caller's trace after resolution."""
+
+    __slots__ = ("type_name", "f_ir", "f_key", "auths", "auths_key",
+                 "planner", "delta", "generation", "future", "t_submit",
+                 "plan", "queue_wait_s", "plan_s", "scan_s", "batched",
+                 "batch_size")
+
+    def __init__(self, type_name, f_ir, f_key, auths, auths_key,
+                 planner, delta, generation):
+        self.type_name = type_name
+        self.f_ir = f_ir
+        self.f_key = f_key
+        self.auths = auths
+        self.auths_key = auths_key
+        self.planner = planner
+        self.delta = delta
+        self.generation = generation
+        self.future: Future = Future()
+        self.t_submit = _pc()
+        self.plan = None
+        self.queue_wait_s: Optional[float] = None
+        self.plan_s: Optional[float] = None
+        self.scan_s: Optional[float] = None
+        self.batched = False
+        self.batch_size = 1
+
+    def result(self, timeout: Optional[float] = None) -> int:
+        return self.future.result(timeout=timeout)
+
+
+# -- the scheduler ------------------------------------------------------------
+
+
+class QueryScheduler:
+    """Micro-batching count scheduler over one store/planner binding.
+
+    Knobs (config.py system properties; constructor args override):
+      flush_size     max queries fused per dispatch (flush-at-B)
+      window_us      max collection window (flush-at-T µs, adaptive cap)
+      min_window_us  adaptive window floor
+
+    The window adapts from observed batch sizes: sustained single-query
+    traffic shrinks it toward the floor (don't tax lone queries with the
+    full window), mid-size batches that flush on the window grow it toward
+    the cap (coalesce more per round trip), and size-capped flushes leave it
+    alone (arrivals already outpace the window).
+    """
+
+    def __init__(self, binding, flush_size: Optional[int] = None,
+                 window_us: Optional[float] = None,
+                 min_window_us: Optional[float] = None,
+                 plan_cache: Optional[int] = None,
+                 cover_cache: Optional[int] = None):
+        self.binding = binding
+        self._flush_size = int(flush_size or config.SCHED_FLUSH_SIZE.get())
+        self._max_window_us = float(window_us or config.SCHED_WINDOW_US.get())
+        self._min_window_us = float(
+            min_window_us or config.SCHED_MIN_WINDOW_US.get())
+        self._window_us = self._max_window_us
+        self._ema_batch = 1.0
+        cap_p = config.SCHED_PLAN_CACHE.get() if plan_cache is None else plan_cache
+        cap_c = config.SCHED_COVER_CACHE.get() if cover_cache is None else cover_cache
+        self.plans = LruCache(cap_p, "scheduler.plan_cache")
+        self.covers = LruCache(cap_c, "scheduler.cover_cache")
+        self._queue: "queue.Queue" = queue.Queue()
+        self._done: "queue.Queue" = queue.Queue()
+        # collector-thread-only tallies (read-only elsewhere)
+        self._batch_hist: Dict[int, int] = {}
+        self._flush_reasons: Dict[str, int] = {"size": 0, "window": 0}
+        self._n_queries = 0
+        self._n_batches = 0
+        self._n_fused = 0
+        self._n_single = 0
+        self._running = True
+        _metrics.set_gauge("scheduler.queue_depth", self._queue.qsize)
+        # pre-warm the fused-batch transfer shapes (boxes/windows/params at
+        # every pow2 flush tier) so the first coalesced dispatch doesn't eat
+        # the per-shape transfer cliff
+        from geomesa_tpu.index.scan import warm_transfer_shapes
+        tiers, b = [], 1
+        while b < self._flush_size:
+            b <<= 1
+            tiers.append(b)
+        warm_transfer_shapes(batch_sizes=tiers or [1])
+        self._collector = threading.Thread(
+            target=self._collect_loop, name="geomesa-sched-collect", daemon=True)
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="geomesa-sched-complete", daemon=True)
+        self._collector.start()
+        self._completer.start()
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
+               auths: Optional[list] = None) -> Request:
+        """Enqueue one count; returns a Request whose ``result()`` blocks.
+        Parse errors raise here (before anything queues)."""
+        if not self._running:
+            raise RuntimeError("scheduler is shut down")
+        f_ir = parse_ecql(f) if isinstance(f, str) else f
+        auths_key = None if auths is None \
+            else tuple(sorted(str(a) for a in auths))
+        planner, delta, gen = self.binding.snapshot(type_name)
+        req = Request(type_name, f_ir, repr(f_ir), auths, auths_key,
+                      planner, delta, gen)
+        _metrics.inc("scheduler.queries")
+        self._queue.put(req)
+        return req
+
+    def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
+              auths: Optional[list] = None,
+              timeout: Optional[float] = None) -> int:
+        """Blocking scheduled count. The caller's trace receives queue_wait
+        / plan / scan leaves — a plan-cache hit shows NO plan span."""
+        with _trace.trace("query.count", type=type_name, filter=str(f),
+                          scheduled=True):
+            req = self.submit(type_name, f, auths)
+            return self._finish(req, timeout)
+
+    def count_many(self, type_name: str, filters, auths: Optional[list] = None,
+                   timeout: Optional[float] = None) -> List[int]:
+        """Counts for many filters, submitted together so they coalesce into
+        fused dispatches. Order-preserving."""
+        with _trace.trace("query.count_many", type=type_name,
+                          n=len(filters), scheduled=True):
+            reqs = [self.submit(type_name, f, auths) for f in filters]
+            return [self._finish(r, timeout) for r in reqs]
+
+    def _finish(self, req: Request, timeout: Optional[float]) -> int:
+        n = req.future.result(timeout=timeout)
+        if _trace.enabled():
+            if req.queue_wait_s is not None:
+                _trace.record("queue_wait", "queue_wait", req.queue_wait_s)
+            if req.plan_s is not None:
+                _trace.record("plan", "plan", req.plan_s)
+            if req.scan_s is not None:
+                _trace.record("scan", "scan", req.scan_s)
+        return n
+
+    def stats(self) -> dict:
+        """Live scheduler state for the debug surfaces (CLI / web)."""
+        return {
+            "queue_depth": self._queue.qsize(),
+            "flush_size": self._flush_size,
+            "window_us": round(self._window_us, 1),
+            "window_us_max": self._max_window_us,
+            "ema_batch": round(self._ema_batch, 2),
+            "queries": self._n_queries,
+            "batches": self._n_batches,
+            "fused": self._n_fused,
+            "singles": self._n_single,
+            "flush_reasons": dict(self._flush_reasons),
+            "batch_size_hist": {str(k): v for k, v in
+                                sorted(self._batch_hist.items())},
+            "plan_cache": self.plans.stats(),
+            "cover_cache": self.covers.stats(),
+        }
+
+    def shutdown(self) -> None:
+        """Stop both threads (outstanding requests complete first)."""
+        if not self._running:
+            return
+        self._running = False
+        self._queue.put(_STOP)
+        self._collector.join(timeout=5)
+        self._completer.join(timeout=5)
+
+    # -- collector thread ---------------------------------------------------
+
+    def _collect_loop(self) -> None:
+        while True:
+            req = self._queue.get()
+            if req is _STOP:
+                self._done.put(_STOP)
+                return
+            batch = [req]
+            t0 = _pc()
+            reason = "window"
+            stop = False
+            while len(batch) < self._flush_size:
+                remaining = self._window_us / 1e6 - (_pc() - t0)
+                if remaining <= 0:
+                    # window expired: drain whatever is ALREADY queued
+                    # (no extra wait) — a backlog that arrived during this
+                    # window must not fragment into the next one
+                    try:
+                        while len(batch) < self._flush_size:
+                            nxt = self._queue.get_nowait()
+                            if nxt is _STOP:
+                                stop = True
+                                break
+                            batch.append(nxt)
+                    except queue.Empty:
+                        pass
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
+            else:
+                reason = "size"
+            self._account(len(batch), reason)
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # never kill the loop: fail the batch
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            if stop:
+                self._done.put(_STOP)
+                return
+
+    def _account(self, n: int, reason: str) -> None:
+        self._n_queries += n
+        self._n_batches += 1
+        self._flush_reasons[reason] += 1
+        self._batch_hist[n] = self._batch_hist.get(n, 0) + 1
+        _metrics.observe_value("scheduler.batch_size", n)
+        _metrics.inc(f"scheduler.flush.{reason}")
+        # adaptive window: see class docstring
+        self._ema_batch = 0.8 * self._ema_batch + 0.2 * n
+        if self._ema_batch <= 1.5:
+            self._window_us = max(self._min_window_us, self._window_us * 0.5)
+        elif reason == "window" and self._ema_batch < self._flush_size / 2:
+            self._window_us = min(self._max_window_us, self._window_us * 1.5)
+
+    def _plan_request(self, req: Request) -> None:
+        """Fill ``req.plan`` via the plan cache (auths-folded; cover cached
+        on the plan). A cache hit leaves ``req.plan_s`` None — the trace
+        shows no plan stage at all."""
+        pkey = (req.type_name, req.generation, req.f_key, req.auths_key)
+        plan = self.plans.get(pkey)
+        if plan is not _MISS:
+            req.plan = plan
+            return
+        t0 = _pc()
+        planner = req.planner
+        plan = planner._apply_auths(planner.plan(req.f_ir), req.auths)
+        self._fill_cover(req, plan, planner)
+        req.plan_s = _pc() - t0
+        req.plan = plan
+        self.plans.put(pkey, plan)
+
+    def _fill_cover(self, req: Request, plan, planner) -> None:
+        """Resolve the plan's candidate-block cover through the cover cache
+        (keyed purely by the device constraint arrays, so filters differing
+        only in residual or auths share one range decomposition)."""
+        if getattr(plan, "blocks", None) is not False:
+            return  # union plans / already resolved
+        if plan.empty or plan.candidate_slices is not None \
+                or plan.index is None or plan.boxes_loose is None:
+            return  # cover never applies; leave lazy
+        ckey = (req.type_name, req.generation, type(plan.index).__name__,
+                plan.boxes_loose.tobytes(),
+                None if plan.windows is None else plan.windows.tobytes())
+        cached = self.covers.get(ckey)
+        if cached is not _MISS:
+            plan.blocks = cached
+            return
+        blocks = planner._pruned_blocks(plan)
+        self.covers.put(ckey, blocks)
+
+    def _dispatch(self, batch: List[Request]) -> None:
+        """Group a collected batch by fused-kernel compatibility and launch
+        one async device dispatch per group; everything else falls back to
+        per-query execution on the completer thread."""
+        from geomesa_tpu.index.scan import PRIMARY_FNS
+
+        groups: Dict[tuple, List[Request]] = {}
+        for r in batch:
+            r.queue_wait_s = _pc() - r.t_submit
+            try:
+                self._plan_request(r)
+            except Exception as e:  # parse/guard/plan errors fail one query
+                r.future.set_exception(e)
+                continue
+            plan = r.plan
+            if (plan.device_exact and plan.primary_kind in PRIMARY_FNS
+                    and plan.boxes_loose is not None
+                    and plan.boxes_loose.shape == (1, 8)):
+                pruned = plan.blocks is not None
+                rd = plan.residual_device
+                wkey = None if plan.windows is None \
+                    else (plan.windows.shape[0], plan.windows.tobytes())
+                rkey = (rd[0], tuple(
+                    (np.asarray(p).dtype.str, np.asarray(p).shape,
+                     np.asarray(p).tobytes()) for p in rd[1])) \
+                    if rd else None
+                gkey = (id(plan.index.kernels), plan.primary_kind,
+                        wkey, rkey, pruned)
+                groups.setdefault(gkey, []).append(r)
+            else:
+                self._n_single += 1
+                _metrics.inc("scheduler.singles")
+                self._done.put(("single", r))
+        for gkey, grp in groups.items():
+            if len(grp) == 1 and grp[0].plan.blocks is not None \
+                    and len(grp[0].plan.blocks) == 0:
+                # provably-empty candidate set, nothing to dispatch
+                self._done.put(("single", grp[0]))
+                continue
+            try:
+                self._dispatch_group(grp, pruned=gkey[-1])
+            except Exception as e:
+                for r in grp:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _dispatch_group(self, grp: List[Request], pruned: bool) -> None:
+        """ONE async fused dispatch for a compatible group: per-query boxes
+        stack into a (B, 8) array; pruned groups scan the union of their
+        candidate blocks (the kernel re-applies the full exact mask, so the
+        union cover stays a harmless superset)."""
+        from geomesa_tpu.index import prune as _prune
+
+        self._n_fused += len(grp)
+        _metrics.inc("scheduler.fused", len(grp))
+        _metrics.observe_value("scheduler.fused_size", len(grp))
+        lead = grp[0].plan
+        kern = lead.index.kernels
+        boxes = np.concatenate([r.plan.boxes_loose for r in grp], axis=0)
+        if pruned:
+            nonempty = [r.plan.blocks for r in grp if len(r.plan.blocks)]
+            union = np.unique(np.concatenate(nonempty)).astype(np.int32) \
+                if nonempty else np.empty(0, dtype=np.int32)
+            disp = kern.prepare_counts_multi_blocks(
+                lead.primary_kind, boxes, lead.windows, lead.residual_device,
+                union, _prune.BLOCK_SIZE)
+        else:
+            disp = kern.prepare_counts_multi(
+                lead.primary_kind, boxes, lead.windows, lead.residual_device)
+        t0 = _pc()
+        out = disp()  # async: enqueue only; the completer blocks for it
+        self._done.put(("batch", out, grp, t0))
+
+    # -- completer thread ---------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._done.get()
+            if item is _STOP:
+                return
+            try:
+                if item[0] == "batch":
+                    self._complete_batch(item[1], item[2], item[3])
+                else:
+                    self._complete_single(item[1])
+            except Exception as e:
+                reqs = item[2] if item[0] == "batch" else [item[1]]
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _complete_batch(self, out, grp: List[Request], t0: float) -> None:
+        # host-side LSM-delta counts first: they overlap the in-flight
+        # device round trip instead of adding to it
+        extras = [len(self.binding.delta_rows(r.delta, r.f_ir, r.auths))
+                  if r.delta is not None else 0 for r in grp]
+        counts = np.asarray(out)  # blocks until the device batch is ready
+        scan_s = _pc() - t0
+        for i, r in enumerate(grp):
+            r.batched = True
+            r.batch_size = len(grp)
+            r.scan_s = scan_s
+            r.future.set_result(int(counts[i]) + extras[i])
+
+    def _complete_single(self, r: Request) -> None:
+        """Fallback execution for plans the fused kernel can't serve (host
+        residuals, unions, fid lookups, multi-box primaries, attribute
+        slices, empty plans). Runs planner._count with the cached plan — the
+        plan/auths work is still amortized even off the fused path."""
+        t0 = _pc()
+        try:
+            if r.plan.empty:
+                n = 0
+            else:  # _count handles empty covers, unions, fids, residuals
+                n = r.planner._count(r.plan, r.f_ir, r.auths)
+            if r.delta is not None:
+                n += len(self.binding.delta_rows(r.delta, r.f_ir, r.auths))
+        except Exception as e:
+            r.future.set_exception(e)
+            return
+        r.scan_s = _pc() - t0
+        r.future.set_result(int(n))

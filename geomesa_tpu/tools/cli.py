@@ -12,7 +12,7 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces [--format prometheus] [-s STORE -f NAME -q ECQL]
+    geomesa-tpu debug         metrics|traces|scheduler [--format prometheus] [-s STORE -f NAME -q ECQL]
     geomesa-tpu describe / list / remove-schema
 """
 
@@ -196,23 +196,48 @@ def cmd_age_off(args):
 
 
 def cmd_debug(args):
-    """Observability surface: dump the process metrics registry or the
-    recent-trace ring (≙ the reference's stats/audit debug commands). With
-    a store + feature + CQL, runs the query first so the dump reflects a
-    real execution — the offline way to read a trace tree."""
+    """Observability surface: dump the process metrics registry, the
+    recent-trace ring, or the query-scheduler state (≙ the reference's
+    stats/audit debug commands). With a store + feature + CQL, runs the
+    query first so the dump reflects a real execution — the offline way to
+    read a trace tree. ``debug scheduler`` drives the warm query THROUGH the
+    scheduler (a concurrent burst, so the dump shows real coalescing:
+    queue depth, batch-size histogram, flush reasons, cache hit rates)."""
     from geomesa_tpu.metrics import REGISTRY
     from geomesa_tpu.trace import RING
+    store = None
     if args.store:
         store = _load(args.store, must_exist=True)
         if args.feature and args.cql:
-            n = store.count(args.feature, args.cql)
-            print(f"# ran count({args.feature!r}, {args.cql!r}) -> {n}",
-                  file=sys.stderr)
+            if args.what == "scheduler":
+                ns = store.count_many(args.feature, [args.cql] * 8)
+                print(f"# ran 8x count({args.feature!r}, {args.cql!r}) "
+                      f"through the scheduler -> {ns[0]}", file=sys.stderr)
+            else:
+                n = store.count(args.feature, args.cql)
+                print(f"# ran count({args.feature!r}, {args.cql!r}) -> {n}",
+                      file=sys.stderr)
     if args.what == "metrics":
         if args.format == "prometheus":
             sys.stdout.write(REGISTRY.to_prometheus())
         else:
             print(json.dumps(REGISTRY.snapshot(), indent=2, default=str))
+    elif args.what == "scheduler":
+        out = {}
+        if store is not None:
+            out = store.scheduler().stats()
+        snap = REGISTRY.snapshot()
+        # process-wide serving metrics ride along (a store-less dump still
+        # shows whatever this process observed)
+        out["metrics"] = {
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("scheduler.")},
+            "histograms": {k: v for k, v in snap["histograms"].items()
+                           if k.startswith("scheduler.")},
+            "gauges": {k: v for k, v in snap["gauges"].items()
+                       if k.startswith(("scheduler.", "kernels."))},
+        }
+        print(json.dumps(out, indent=2, default=str))
     else:  # traces
         print(json.dumps(RING.recent(args.limit), indent=2))
 
@@ -324,8 +349,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_config)
 
     sp = sub.add_parser(
-        "debug", help="dump metrics or recent query traces")
-    sp.add_argument("what", choices=("metrics", "traces"))
+        "debug", help="dump metrics, recent query traces, or scheduler state")
+    sp.add_argument("what", choices=("metrics", "traces", "scheduler"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query")
     sp.add_argument("-q", "--cql", help="ECQL filter for the warm query")

@@ -14,6 +14,8 @@ Span kinds (the fixed vocabulary hot paths use):
 
   plan             filter parse + strategy selection
   range_decompose  key-range → candidate-block cover computation
+  queue_wait       time spent queued in the micro-batching scheduler before
+                   its batch dispatched (serve/scheduler.py)
   scan             umbrella execution stage (staging + kernel + readback);
                    its SELF time is constant staging / host glue
   device_scan      kernel dispatch (host-side enqueue, async)
@@ -51,7 +53,7 @@ from typing import Dict, Iterator, List, Optional
 
 from geomesa_tpu.metrics import REGISTRY as _REGISTRY
 
-SPAN_KINDS = ("plan", "range_decompose", "scan", "device_scan",
+SPAN_KINDS = ("plan", "range_decompose", "queue_wait", "scan", "device_scan",
               "device_wait", "refine", "aggregate", "serialize")
 
 _pc = time.perf_counter  # cached: spans sit on µs-scale hot paths

@@ -11,13 +11,17 @@ Routes:
   GET  /types                          → type names
   GET  /types/{t}                      → schema + row count
   GET  /types/{t}/features?cql=&limit=&sort=&crs=   → GeoJSON FeatureCollection
-  GET  /types/{t}/count?cql=           → {"count": n}
+  GET  /types/{t}/count?cql=           → {"count": n}  (concurrent requests
+                                         coalesce through the micro-batching
+                                         scheduler, serve/scheduler.py)
   GET  /types/{t}/explain?cql=         → query plan JSON (+ dry-run trace tree)
   GET  /types/{t}/stats?stat=<dsl>     → stat sketch JSON
   POST /types/{t}/features             → ingest a GeoJSON FeatureCollection
   GET  /metrics                        → metrics snapshot (JSON)
   GET  /metrics?format=prometheus      → Prometheus text exposition
   GET  /traces?limit=N                 → recent query traces, newest first
+  GET  /scheduler                      → scheduler state (queue depth, batch
+                                         histogram, cache hit rates)
   GET  /healthz                        → liveness + device count
   GET  /config                         → system-property listing
 """
@@ -62,6 +66,8 @@ class GeoJsonApi:
             from geomesa_tpu.trace import RING
             limit = int(query.get("limit", [50])[0])
             return 200, {"traces": RING.recent(limit)}
+        if parts == ["scheduler"]:
+            return 200, self.store.scheduler().stats()
         if parts == ["healthz"]:
             import jax
             return 200, {"status": "ok",
@@ -98,7 +104,10 @@ class GeoJsonApi:
                                  for a in sft.attributes],
                              "count": count}
             if rest == ["count"]:
-                return 200, {"count": self.store.count(t, cql, auths=auths)}
+                # coalesced: concurrent counts micro-batch into shared
+                # fused device dispatches (serve/scheduler.py)
+                return 200, {"count": self.store.count_coalesced(
+                    t, cql, auths=auths)}
             if rest == ["explain"]:
                 out = self.store.explain(t, cql)
                 return 200, json.loads(json.dumps(out, default=str))

@@ -1,0 +1,328 @@
+"""Micro-batching query scheduler (serve/scheduler.py): coalescing
+correctness, plan/cover caching, generation invalidation, trace integration,
+kernel-cache bounding, and the web serving path."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.filter import ir
+
+
+def _mk_store(n=50_000, seed=3, expiry=None):
+    rng = np.random.default_rng(seed)
+    ds = TpuDataStore()
+    spec = "v:Int,name:String,dtg:Date,*geom:Point;geomesa.z3.interval=week"
+    if expiry:  # user-data entries are comma-separated after the ';'
+        spec += f",geomesa.feature.expiry={expiry}"
+    ds.create_schema("t", spec)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    ds.load("t", FeatureTable.build(ds.get_schema("t"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "name": rng.choice(["a", "b", "c"], n).astype(object),
+        "dtg": base + rng.integers(0, 30 * 86400000, n),
+        "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n))}))
+    return ds
+
+
+DURING = "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z"
+
+
+def _queries(k=16):
+    return [f"BBOX(geom, {-10 + i}, {5 + 0.5 * i}, {10 + i}, "
+            f"{25 + 0.5 * i}) AND {DURING}" for i in range(k)]
+
+
+@pytest.fixture(scope="module")
+def store():
+    ds = _mk_store()
+    yield ds
+    if ds._scheduler is not None:
+        ds._scheduler.shutdown()
+
+
+# -- coalescing correctness ---------------------------------------------------
+
+
+def test_count_many_matches_individual_counts(store):
+    qs = _queries(16)
+    ref = [store.count("t", q) for q in qs]
+    got = store.count_many("t", qs)
+    assert got == ref
+    st = store.scheduler().stats()
+    assert st["fused"] > 0  # the batch really fused, not 16 singles
+
+
+def test_submitted_together_actually_batch(store):
+    sched = store.scheduler()
+    before = sched._n_batches
+    reqs = [sched.submit("t", q) for q in _queries(12)]
+    got = [r.result(timeout=30) for r in reqs]
+    assert all(isinstance(n, int) for n in got)
+    # 12 compatible queries submitted back-to-back take far fewer batches
+    assert sched._n_batches - before <= 4
+    assert any(r.batched and r.batch_size > 1 for r in reqs)
+
+
+def test_mixed_batchable_and_fallback(store):
+    """Non-fusable shapes (OR→union plans, fid lookups, INCLUDE) ride the
+    same submission and still answer exactly."""
+    t = store.tables["t"]
+    fid = str(t.fids[5])
+    qs = [_queries(4)[0],
+          f"BBOX(geom, -10, 5, 10, 25) OR BBOX(geom, 30, 5, 50, 25)",
+          "INCLUDE",
+          "v < 50"]
+    ref = [store.count("t", q) for q in qs]
+    assert store.count_many("t", qs) == ref
+    assert store.scheduler().count("t", ir.FidFilter((fid,))) == 1
+
+
+def test_concurrent_clients_coalesce_and_agree(store):
+    sched = store.scheduler()
+    q = _queries(1)[0]
+    ref = store.count("t", q)
+    outs, errs = [], []
+
+    def client():
+        try:
+            for _ in range(4):
+                outs.append(sched.count("t", q))
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    ts = [threading.Thread(target=client) for _ in range(16)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    assert outs and all(o == ref for o in outs)
+
+
+def test_count_future_async_api(store):
+    q = _queries(2)[1]
+    req = store.count_future("t", q)
+    assert req.result(timeout=30) == store.count("t", q)
+    assert req.future.done()
+
+
+# -- plan/cover caches --------------------------------------------------------
+
+
+def test_plan_cache_hit_skips_plan_stage_in_trace(store):
+    from geomesa_tpu.trace import RING
+    sched = store.scheduler()
+    q = "BBOX(geom, -3, -3, 17, 17) AND " + DURING
+    RING.clear()
+    n1 = sched.count("t", q)
+    n2 = sched.count("t", q)
+    assert n1 == n2
+    traces = RING.recent(2)  # newest first
+    first, second = traces[1], traces[0]
+    assert "plan" in first["stages_ms"], "cold query must show a plan stage"
+    assert "plan" not in second["stages_ms"], \
+        "plan-cache hit must skip the plan stage entirely"
+    assert "queue_wait" in second["stages_ms"]
+    assert "scan" in second["stages_ms"]
+
+
+def test_cover_cache_shared_across_residuals(store):
+    """Same boxes/windows under different residuals share one host range
+    decomposition through the cover cache."""
+    sched = store.scheduler()
+    box = "BBOX(geom, -8, -1, 12, 19) AND " + DURING
+    hits0 = sched.covers.hits
+    n_all = sched.count("t", box)
+    n_v = sched.count("t", f"{box} AND v < 50")
+    assert n_v <= n_all
+    assert sched.covers.hits > hits0
+
+
+def test_generation_invalidates_on_ingest(store):
+    sched = store.scheduler()
+    q = "BBOX(geom, 1, 1, 2, 2) AND " + DURING
+    gen0 = store.generation("t")
+    n0 = sched.count("t", q)
+    base = np.datetime64("2020-01-06T00:00:00", "ms").astype(np.int64)
+    with store.get_writer("t") as w:
+        w.write(v=1, name="a", dtg=int(base), geom=(1.5, 1.5))
+    assert store.generation("t") > gen0
+    assert sched.count("t", q) == n0 + 1, \
+        "stale cached plan served after an ingest"
+    # and the flush (delta → main index merge) bumps again
+    gen1 = store.generation("t")
+    store.flush("t")
+    assert store.generation("t") > gen1
+    assert sched.count("t", q) == n0 + 1
+
+
+def test_generation_invalidates_on_remove_and_update(store):
+    sched = store.scheduler()
+    q = "v = 7"
+    n0 = sched.count("t", q)
+    removed = store.remove_features("t", "v = 7")
+    assert removed == n0
+    assert sched.count("t", q) == 0
+    changed = store.update_features("t", "v = 8", {"v": 7})
+    assert sched.count("t", q) == changed
+
+
+def test_generation_invalidates_on_age_off():
+    import time as _time
+    rng = np.random.default_rng(11)
+    n = 5000
+    ds = TpuDataStore()
+    ds.create_schema("t", "v:Int,dtg:Date,*geom:Point;"
+                          "geomesa.feature.expiry=dtg(30 days)")
+    now = int(_time.time() * 1000)
+    # recent rows: inside TTL at write time, so they land
+    ds.load("t", FeatureTable.build(ds.get_schema("t"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": now - rng.integers(0, 10 * 86400000, n),
+        "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n))}))
+    try:
+        sched = ds.scheduler()
+        q = "BBOX(geom, -60, -40, 60, 40)"
+        n0 = sched.count("t", q)
+        assert n0 == n
+        # advance the clock far enough that every row's TTL lapsed
+        dropped = ds.age_off("t", now_ms=now + 40 * 86400000)
+        assert dropped == n
+        assert sched.count("t", q) == 0, \
+            "stale cached plan served after age-off"
+    finally:
+        if ds._scheduler is not None:
+            ds._scheduler.shutdown()
+
+
+def test_plan_cache_bounded():
+    from geomesa_tpu.serve.scheduler import LruCache
+    c = LruCache(4, "test.cache")
+    for i in range(10):
+        c.put(("k", i), i)
+    assert c.stats()["size"] == 4
+    from geomesa_tpu.serve.scheduler import _MISS
+    assert c.get(("k", 0)) is _MISS
+    assert c.get(("k", 9)) == 9
+
+
+# -- adaptive window / instrumentation ---------------------------------------
+
+
+def test_adaptive_window_stays_bounded_and_stats_populate(store):
+    sched = store.scheduler()
+    for q in _queries(6):
+        sched.count("t", q)  # serial singles: window should shrink
+    st = sched.stats()
+    assert sched._min_window_us <= st["window_us"] <= st["window_us_max"]
+    assert st["queries"] >= 6 and st["batches"] >= 1
+    assert sum(st["flush_reasons"].values()) == st["batches"]
+    assert sum(st["batch_size_hist"].values()) == st["batches"]
+    from geomesa_tpu.metrics import REGISTRY
+    snap = REGISTRY.snapshot()
+    assert snap["histograms"]["scheduler.batch_size"]["count"] >= 1
+    assert "scheduler.queue_depth" in snap["gauges"]
+    prom = REGISTRY.to_prometheus()
+    assert "geomesa_tpu_scheduler_batch_size" in prom
+
+
+def test_parse_and_guard_errors_surface(store):
+    sched = store.scheduler()
+    with pytest.raises(Exception):
+        sched.count("t", "THIS IS NOT CQL (")
+    with pytest.raises(ValueError):
+        sched.submit("no_such_type", "INCLUDE")
+
+
+# -- kernel LRU bound ---------------------------------------------------------
+
+
+def test_scan_kernel_cache_bounded_and_correct(store):
+    planner = store.planner("t")
+    idx = next(i for i in planner.indexes if hasattr(i, "kernels"))
+    kern = idx.kernels
+    q = "BBOX(geom, -10, 5, 10, 25) AND " + DURING
+    ref = planner.count(q)
+    config.KERNEL_CACHE.set(2)
+    try:
+        # many distinct residual structures cycle through a 2-entry cache
+        for v in range(6):
+            planner.count(f"BBOX(geom, -10, 5, 10, 25) AND v < {v} AND "
+                          f"v <> {v + 40 + v}" if v % 2 else
+                          f"BBOX(geom, -10, 5, 10, 25) AND v >= {v}")
+            assert len(kern._jitted) <= 2
+        # an evicted signature recompiles and still answers exactly
+        assert planner.count(q) == ref
+    finally:
+        config.KERNEL_CACHE.unset()
+    from geomesa_tpu.metrics import REGISTRY
+    assert REGISTRY.snapshot()["gauges"].get("kernels.compiled", 0) >= 1
+
+
+def test_warm_transfer_shapes_accepts_batch_tiers():
+    from geomesa_tpu.index import scan as scan_mod
+    scan_mod.warm_transfer_shapes(batch_sizes=(3, 64, 100))
+    # rounds up to pow2 and records the warmed tiers
+    assert {4, 64, 128} <= scan_mod._WARMED_BATCH_SIZES
+
+
+# -- the web serving path -----------------------------------------------------
+
+
+def test_web_count_coalesces(store):
+    from geomesa_tpu.web import serve
+    httpd = serve(store, port=0, background=True)
+    try:
+        port = httpd.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return json.loads(r.read())
+
+        q = "BBOX(geom,%20-10,%205,%2010,%2025)"
+        ref = store.count("t", "BBOX(geom, -10, 5, 10, 25)")
+        outs = []
+
+        def client():
+            outs.append(get(f"/types/t/count?cql={q}")["count"])
+
+        ts = [threading.Thread(target=client) for _ in range(12)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert all(o == ref for o in outs)
+        st = get("/scheduler")
+        assert st["queries"] >= 12
+        assert "batch_size_hist" in st and "plan_cache" in st
+    finally:
+        httpd.shutdown()
+
+
+def test_web_count_scheduler_disabled_param():
+    ds = _mk_store(n=2000, seed=9)
+    ds.params["scheduler"] = False
+    try:
+        assert ds.count_coalesced("t", "INCLUDE") == 2000
+        assert ds._scheduler is None  # direct path: no scheduler spun up
+    finally:
+        if ds._scheduler is not None:
+            ds._scheduler.shutdown()
+
+
+# -- bare-planner binding (the bench harness shape) ---------------------------
+
+
+def test_planner_binding(store):
+    from geomesa_tpu.serve.scheduler import PlannerBinding, QueryScheduler
+    planner = store.planner("t")
+    sched = QueryScheduler(PlannerBinding({"t": planner}), flush_size=8)
+    try:
+        qs = _queries(8)
+        assert sched.count_many("t", qs) == [planner.count(q) for q in qs]
+    finally:
+        sched.shutdown()

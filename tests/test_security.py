@@ -185,6 +185,54 @@ def test_sample_respects_auths(store):
     assert set(res2.indices) <= visible_rows
 
 
+# -- serving-path plan cache x auths (serve/scheduler.py) --------------------
+
+
+def test_plan_cache_keyed_by_auths(store):
+    """The scheduler's plan cache MUST include the auths context in its key:
+    a privileged query's visibility-folded cached plan can never serve rows
+    to an unprivileged caller (and vice versa), in any order, warm or cold."""
+    ds, vis = store
+    sched = ds.scheduler()
+    q = "BBOX(geom, -50, -50, 50, 50)"
+    expect = {tuple(a): int(_visible(vis, list(a)).sum())
+              for a in ((), ("admin",), ("admin", "ops"))}
+    try:
+        # cold pass (fills the cache per auths), then two warm passes that
+        # must hit the cache and still answer per-context
+        for _ in range(3):
+            for auths, want in expect.items():
+                got = sched.count("sec", q, auths=list(auths))
+                assert got == want, (auths, got, want)
+        assert sched.count("sec", q) == len(vis)  # auths=None: security off
+        # the cache really was exercised (same filter, distinct entries)
+        st = sched.plans.stats()
+        assert st["hits"] >= 4
+        cached_auth_keys = {k[-1] for k in sched.plans._d}
+        assert {(), ("admin",), ("admin", "ops"), None} <= cached_auth_keys
+    finally:
+        sched.shutdown()
+        ds._scheduler = None
+
+
+def test_prepared_union_plan_refolds_auths(store):
+    """A reused union plan must fold auths on EVERY execution — the
+    __vis_applied__ marker lives on the folded copy, never the shared
+    original (a marked shared plan would leak unauthorized rows on its
+    second run)."""
+    from geomesa_tpu.index.api import UnionScanPlan
+    ds, vis = store
+    planner = ds.planner("sec")
+    q = "BBOX(geom, -50, -50, 0, 50) OR BBOX(geom, 0, -50, 50, 50)"
+    t = ds.tables["sec"]
+    x, _y = t.geometry().point_xy()
+    want = int((_visible(vis, ["admin"]) & (x >= -50) & (x <= 50)).sum())
+    plan = planner.plan(q)
+    assert isinstance(plan, UnionScanPlan)
+    for _ in range(3):  # same plan object, repeated execution
+        assert planner._count(plan, None, ["admin"]) == want
+
+
 def test_density_auths_equal_posthoc(store):
     """Auth-restricted density == density over the post-hoc-filtered rows
     (the VERDICT r2 'done' criterion for auths x aggregation)."""
