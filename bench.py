@@ -24,6 +24,9 @@ Covers the five BASELINE.md configs:
   5. S2 vs Z2 cover calibration (host-only): scanned-rows slop of each
      curve's cover over random boxes, pinning the cost model's S2
      cover_slop (curves/s2.py) against measurement.
+  6. WAL ingest overhead: sustained bulk-ingest rows/s through the
+     datastore with durability off vs WAL fsync=off/batch/always
+     (durability subsystem acceptance: batch within 15% of no-WAL).
 
 Headline metric = config 1 blocking p50 (RTT included; see rtt field).
 ``vs_baseline`` = indexed-CPU comparator p50 / batch64 per-query (sustained
@@ -145,7 +148,7 @@ def main() -> None:
     n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
     configs = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                                 "0,1,2,3,4,5").split(","))
+                                 "0,1,2,3,4,5,6").split(","))
     rng = np.random.default_rng(1234)
     detail: dict = {"n_points": n, "device": str(jax.devices()[0]),
                     "host_cores": os.cpu_count()}
@@ -691,6 +694,65 @@ def main() -> None:
         detail["cfg5_s2_cover_slop"] = round(tots["s2"] / true_rows, 3)
         detail["cfg5_s2_scanned_fraction"] = round(tots["s2"] / (24 * m), 5)
         detail["cfg5_s"] = round(time.perf_counter() - t0, 2)
+
+    # ---- config 6: WAL ingest overhead (off/batch/always vs no-WAL) -------
+    if "6" in configs:
+        import shutil
+        import tempfile
+
+        from geomesa_tpu.datastore import TpuDataStore
+
+        n6 = min(n, 1_000_000)
+        batch_rows = 100_000
+        sft6 = SimpleFeatureType.from_spec("ing", "dtg:Date,*geom:Point")
+        # pre-built batches: table construction is excluded so the measured
+        # cost is the store's ingest path (WAL encode+append+fsync included)
+        batches = []
+        for b0 in range(0, n6, batch_rows):
+            sl = slice(b0, min(b0 + batch_rows, n6))
+            batches.append(FeatureTable.build(
+                sft6, {"dtg": dtg[sl], "geom": (x[sl], y[sl])},
+                fids=[f"i{j}" for j in range(sl.start, sl.stop)]))
+
+        def ingest_qps(policy):
+            tmp = tempfile.mkdtemp(prefix="gt-walbench-")
+            try:
+                if policy is None:
+                    st = TpuDataStore()
+                else:
+                    # snapshot thresholds lifted: this measures the WAL
+                    # tax alone (snapshots amortize on their own schedule)
+                    st = TpuDataStore.open(tmp, params={
+                        "wal.fsync": policy,
+                        "snapshot.rows": n6 * 10,
+                        "snapshot.wal_bytes": 1 << 40})
+                st.create_schema(sft6)
+                t0 = time.perf_counter()
+                for b in batches:
+                    st.load("ing", b)
+                if st.durability is not None:
+                    st.durability.wal.sync()  # durable before the clock stops
+                dt = time.perf_counter() - t0
+                st.close()
+                return n6 / dt
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        detail["cfg6_n"] = n6
+        # one throwaway run per variant (compile/import/page-cache warmup),
+        # then best-of-3: run-level noise (device-upload variance through
+        # the tunnel, single-core scheduling) swings individual runs far
+        # more than the WAL tax — the per-policy BEST isolates the
+        # systematic cost
+        ingest_qps(None)
+        ingest_qps("off")
+        base = max(ingest_qps(None) for _ in range(3))
+        detail["cfg6_ingest_qps_nowal"] = round(base, 0)
+        for pol in ("off", "batch", "always"):
+            q = max(ingest_qps(pol) for _ in range(3))
+            detail[f"cfg6_ingest_qps_wal_{pol}"] = round(q, 0)
+            detail[f"cfg6_wal_{pol}_overhead_pct"] = round(
+                100.0 * (1.0 - q / base), 1)
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

@@ -149,6 +149,38 @@ SCHED_COVER_CACHE = _register(
     "Cover-cache capacity (boxes/windows -> candidate gather blocks). "
     "0 disables cover caching.")
 
+WAL_FSYNC = _register(
+    "GEOMESA_TPU_WAL_FSYNC", "batch", str,
+    "Write-ahead-log fsync policy: off (OS page cache only — survives "
+    "process death, not power loss), batch (group commit: one fsync per "
+    "commit window, bounded data-at-risk; default), always (every append "
+    "durable before it returns; concurrent appenders share one fsync).")
+
+WAL_SEGMENT_BYTES = _register(
+    "GEOMESA_TPU_WAL_SEGMENT_BYTES", 64 * 1024 * 1024, int,
+    "WAL segment size before rotation; old segments garbage-collect once a "
+    "snapshot covers them.")
+
+WAL_INTERVAL_MS = _register(
+    "GEOMESA_TPU_WAL_INTERVAL_MS", 20.0, float,
+    "Group-commit window for WAL fsync policy 'batch': the background "
+    "syncer fsyncs at most once per window (the max unsynced-data age).")
+
+SNAPSHOT_ROWS = _register(
+    "GEOMESA_TPU_SNAPSHOT_ROWS", 500_000, int,
+    "Rows logged since the last snapshot that trigger a new incremental "
+    "snapshot (which rotates the WAL and GCs covered segments).")
+
+SNAPSHOT_WAL_BYTES = _register(
+    "GEOMESA_TPU_SNAPSHOT_WAL_BYTES", 256 * 1024 * 1024, int,
+    "WAL payload bytes since the last snapshot that trigger a new one "
+    "(bounds replay time after a crash).")
+
+SNAPSHOT_KEEP = _register(
+    "GEOMESA_TPU_SNAPSHOT_KEEP", 2, int,
+    "Installed snapshots retained; older ones are pruned after each "
+    "successful install (keep >= 2 tolerates one corrupt newest snapshot).")
+
 KERNEL_CACHE = _register(
     "GEOMESA_TPU_KERNEL_CACHE", 128, int,
     "Max compiled scan kernels retained per index (LRU). Long-lived servers "

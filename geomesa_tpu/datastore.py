@@ -33,6 +33,19 @@ from geomesa_tpu.index.spatial import INDEX_CLASSES, FullScanIndex
 
 _INDEX_BY_NAME = {c.name: c for c in INDEX_CLASSES}
 
+# per-process store-incarnation counter: every TpuDataStore instance gets a
+# unique epoch (pid + counter) that salts the serving-scheduler cache keys,
+# so plans cached for one incarnation are unreachable from any other — even
+# one restored with identical generation counters
+import itertools as _itertools
+import os as _os
+
+_EPOCHS = _itertools.count(1)
+
+
+def _next_epoch() -> str:
+    return f"{_os.getpid():x}d{next(_EPOCHS)}"
+
 
 class FeatureWriter:
     """Batch appender (≙ GeoMesaFeatureWriter append mode). Collects rows
@@ -126,6 +139,8 @@ class TpuDataStore:
         # data it described has changed. Monotonic per NAME — it survives
         # remove_schema so a re-created type can't resurrect stale plans.
         self._generations: Dict[str, int] = {}
+        # incarnation epoch: salts scheduler cache keys (see _next_epoch)
+        self.epoch = _next_epoch()
         self._scheduler = None  # lazy QueryScheduler (serve/scheduler.py)
         # audit trail (≙ AuditWriter): params {"audit": True | "path.jsonl"}
         audit_param = self.params.get("audit")
@@ -136,6 +151,15 @@ class TpuDataStore:
                 max_bytes=self.params.get("audit.max_bytes"))
         else:
             self.audit = None
+        # durability (WAL + snapshots + recovery): params
+        # {"durability": "<dir>"} or TpuDataStore.open(dir). Attaching to a
+        # dir with an existing layout recovers into this store first.
+        self.durability = None
+        self.recovery_report = None
+        dur_dir = self.params.get("durability")
+        if dur_dir:
+            from geomesa_tpu.durability.manager import attach as _attach
+            _attach(self, dur_dir, params=self.params)
 
     # -- factory SPI --------------------------------------------------------
 
@@ -147,6 +171,47 @@ class TpuDataStore:
     def create(cls, params: dict) -> "TpuDataStore":
         return cls(params)
 
+    @classmethod
+    def open(cls, path: str, params: Optional[dict] = None) -> "TpuDataStore":
+        """Open (or create) a durable store at ``path``: crash recovery runs
+        when a WAL/snapshot layout exists (newest valid snapshot + WAL
+        suffix replay, torn tail truncated), and every subsequent mutation
+        is write-ahead logged. ``store.recovery_report`` says what recovery
+        did; ``store.durability`` exposes WAL/snapshot state."""
+        p = dict(params or {})
+        p["durability"] = path
+        return cls(p)
+
+    def close(self) -> None:
+        """Flush + release durability resources (WAL fsync, background
+        syncer) and stop the query scheduler. Idempotent."""
+        with self._lock:
+            sched, self._scheduler = self._scheduler, None
+        if sched is not None:
+            sched.shutdown()
+        if self.durability is not None:
+            self.durability.close()
+
+    # -- durability plumbing -------------------------------------------------
+
+    def _wal_json(self, kind: str, meta: dict, rows: int = 0) -> None:
+        """Log a metadata mutation record (callers hold the store lock;
+        log-then-apply). No-op without durability or during replay."""
+        if self.durability is not None:
+            self.durability.log_json(kind, meta, rows=rows)
+
+    def _wal_table(self, kind: str, meta: dict, table=None, arrays=None,
+                   rows: int = 0) -> None:
+        if self.durability is not None:
+            self.durability.log_table(kind, meta, table=table, arrays=arrays,
+                                      rows=rows)
+
+    def _dur_tick(self) -> None:
+        """Post-mutation hook, called AFTER the store lock is released:
+        writes an incremental snapshot when thresholds are crossed."""
+        if self.durability is not None:
+            self.durability.maybe_snapshot()
+
     # -- schema lifecycle ---------------------------------------------------
 
     def create_schema(self, sft: Union[SimpleFeatureType, str],
@@ -157,6 +222,8 @@ class TpuDataStore:
         with self._lock:
             if sft.name in self.schemas:
                 raise ValueError(f"Schema {sft.name} already exists")
+            self._wal_json("create_schema",
+                           {"type": sft.name, "spec": sft.to_spec()})
             self.schemas[sft.name] = sft
             self.tables[sft.name] = None
         return sft
@@ -169,14 +236,18 @@ class TpuDataStore:
 
     def remove_schema(self, type_name: str) -> None:
         with self._lock:
-            # _interceptors/_counters included: a re-created type of the same
-            # name must not inherit the old type's guards or fid sequence.
-            # _generations deliberately excluded (bumped instead): cached
-            # plans must not survive a drop/re-create of the same name.
-            self._bump_generation(type_name)
-            for d in (self.schemas, self.tables, self.planners, self._stats,
-                      self.deltas, self._counters, self._interceptors):
-                d.pop(type_name, None)
+            self._wal_json("remove_schema", {"type": type_name})
+            self._remove_schema_locked(type_name)
+
+    def _remove_schema_locked(self, type_name: str) -> None:
+        # _interceptors/_counters included: a re-created type of the same
+        # name must not inherit the old type's guards or fid sequence.
+        # _generations deliberately excluded (bumped instead): cached
+        # plans must not survive a drop/re-create of the same name.
+        self._bump_generation(type_name)
+        for d in (self.schemas, self.tables, self.planners, self._stats,
+                  self.deltas, self._counters, self._interceptors):
+            d.pop(type_name, None)
 
     # -- writes -------------------------------------------------------------
 
@@ -200,8 +271,16 @@ class TpuDataStore:
         threshold. Queries merge main + delta exactly (see count/query)."""
         with self._lock:
             self._append_locked(type_name, batch, stats_cached)
+        self._dur_tick()
 
     def _append_locked(self, type_name, batch, stats_cached=None) -> None:
+        # WAL first (log-then-apply): the batch as handed in — replay runs
+        # it through this same path, so write-path age-off re-applies there
+        self._wal_table("append", {"type": type_name}, table=batch,
+                        rows=len(batch))
+        self._append_apply(type_name, batch, stats_cached)
+
+    def _append_apply(self, type_name, batch, stats_cached=None) -> None:
         from geomesa_tpu.metrics import REGISTRY as _metrics
         _metrics.inc("ingest.features", len(batch))
         # every append changes query results (even a delta-tier landing), so
@@ -263,6 +342,61 @@ class TpuDataStore:
                 self.tables[type_name] = merged
                 self._rebuild_indexes(type_name)
 
+    def upsert(self, type_name: str, batch: FeatureTable) -> int:
+        """Atomic put-by-fid: remove existing rows whose fids collide with
+        the batch, then append it — ONE mutation under ONE lock hold, logged
+        as ONE WAL record. Idempotent: re-applying the same batch (a crash
+        replay, a retried hot-tier persist) converges to the same state
+        instead of losing or double-counting rows. ≙ the Lambda tier's
+        hot→cold move, which the reference performs as delete+write against
+        the persistent store. Returns rows written."""
+        if type_name not in self.schemas:
+            raise KeyError(type_name)
+        with self._lock, _trace.span("ingest.upsert", kind="aggregate",
+                                     type=type_name):
+            self._wal_table("upsert", {"type": type_name}, table=batch,
+                            rows=len(batch))
+            self._upsert_locked(type_name, batch)
+        self._dur_tick()
+        return len(batch)
+
+    def _upsert_locked(self, type_name: str, batch: FeatureTable) -> None:
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        _metrics.inc("ingest.upserts")
+        batch_fids = np.asarray(batch.fids, dtype=object)
+        # collisions within the host-side delta run purge in place (cheap)
+        delta = self.deltas.get(type_name)
+        if delta is not None:
+            ddup = np.isin(np.asarray(delta.fids, dtype=object), batch_fids)
+            if ddup.any():
+                keep = np.flatnonzero(~ddup)
+                self.deltas[type_name] = delta.take(keep) if len(keep) \
+                    else None
+        current = self.tables.get(type_name)
+        main_dup = None
+        if current is not None and len(current):
+            main_dup = np.isin(np.asarray(current.fids, dtype=object),
+                               batch_fids)
+            if not main_dup.any():
+                main_dup = None
+        if main_dup is None:
+            # no main-table collisions: ride the ordinary LSM append path —
+            # a small hot-tier persist lands in the delta run and must NOT
+            # rebuild the cold device index (tests/test_lsm.py)
+            self._append_apply(type_name, batch)
+            return
+        self._bump_generation(type_name)
+        current = current.take(np.flatnonzero(~main_dup))
+        delta = self.deltas.get(type_name)
+        if delta is not None:
+            current = FeatureTable.concat([current, delta])
+            self.deltas[type_name] = None
+        merged = FeatureTable.concat([current, batch]) \
+            if len(current) else batch
+        merged, _ = self._apply_age_off(type_name, merged)
+        self.tables[type_name] = merged
+        self._rebuild_indexes(type_name)
+
     def _apply_age_off(self, type_name: str, table: Optional[FeatureTable],
                        now_ms: Optional[int] = None):
         """(surviving table, n_expired) under the type's
@@ -291,8 +425,13 @@ class TpuDataStore:
         row whose ``geomesa.feature.expiry`` TTL has lapsed and rebuilds the
         device index if anything dropped. Returns the number removed.
         ``now_ms`` overrides the clock (maintenance jobs, tests)."""
+        import time as _time
+        # resolve the clock BEFORE logging so the WAL record replays with
+        # the exact cutoff this compaction used (deterministic recovery)
+        now = int(_time.time() * 1000) if now_ms is None else int(now_ms)
         with self._lock, _trace.span("ingest.age_off", kind="aggregate",
                                      type=type_name):
+            self._wal_json("age_off", {"type": type_name, "now_ms": now})
             table = self.tables.get(type_name)
             delta = self.deltas.get(type_name)
             # merge the delta WITHOUT flush(): its age-off pass runs on the
@@ -300,13 +439,14 @@ class TpuDataStore:
             # from this method's returned count
             if delta is not None:
                 table = FeatureTable.concat([table, delta])
-            table2, n = self._apply_age_off(type_name, table, now_ms)
+            table2, n = self._apply_age_off(type_name, table, now)
             if n or delta is not None:
                 self._bump_generation(type_name)
                 self.deltas[type_name] = None
                 self.tables[type_name] = table2
                 self._rebuild_indexes(type_name)
-            return n
+        self._dur_tick()
+        return n
 
     def _snapshot(self, type_name: str):
         """One consistent (planner, delta) pair. The brief lock acquire is
@@ -403,12 +543,15 @@ class TpuDataStore:
             return self._generations.get(type_name, 0)
 
     def _sched_snapshot(self, type_name: str):
-        """(planner, delta, generation) captured atomically for the query
-        scheduler — the scheduler-side twin of ``_snapshot``."""
+        """(planner, delta, generation, epoch) captured atomically for the
+        query scheduler — the scheduler-side twin of ``_snapshot``. The
+        epoch salts cache keys so plans cached against a prior store
+        incarnation (same name, same restored generation) never alias."""
         with self._lock:
             return (self._main_planner(type_name),
                     self.deltas.get(type_name),
-                    self._generations.get(type_name, 0))
+                    self._generations.get(type_name, 0),
+                    self.epoch)
 
     def scheduler(self):
         """The store's micro-batching query scheduler (lazily started; one
@@ -631,6 +774,13 @@ class TpuDataStore:
             table = planner.table
             cols: Dict[str, object] = dict(table.columns)
             sub = None
+            # WAL record: the RESOLVED mutation (fids + final values, with
+            # callables already evaluated) — replay needs no closures and
+            # no re-planning of the original filter
+            wal_meta = {"type": type_name,
+                        "fids": [str(x) for x in table.fids_at(rows)],
+                        "scalars": {}, "geoms": {}, "string_lists": {}}
+            wal_arrays: Dict[str, object] = {}
             for name, val in updates.items():
                 attr = self.schemas[type_name].attribute(name)
                 if callable(val):
@@ -642,6 +792,8 @@ class TpuDataStore:
                         else GeometryArray.from_rows(
                             [val] * len(rows) if isinstance(val, str)
                             else list(val))
+                    wal_meta["geoms"][name] = [new_geoms.wkt(i)
+                                               for i in range(len(rows))]
                     keep = np.ones(len(table), dtype=bool)
                     keep[rows] = False
                     order = np.concatenate([np.flatnonzero(keep), rows])
@@ -657,6 +809,10 @@ class TpuDataStore:
                     values[rows] = val if isinstance(val, str) \
                         else np.asarray([str(v) for v in val], dtype=object)
                     cols[name] = StringColumn.encode(values)
+                    if isinstance(val, str):
+                        wal_meta["scalars"][name] = val
+                    else:
+                        wal_meta["string_lists"][name] = [str(v) for v in val]
                 else:
                     # copy-on-write: loaded tables may alias caller arrays
                     arr = np.array(col, copy=True)
@@ -666,12 +822,20 @@ class TpuDataStore:
                             val = v.astype("datetime64[ms]").astype(np.int64)
                     arr[rows] = val
                     cols[name] = arr
+                    if np.ndim(val) == 0:
+                        wal_meta["scalars"][name] = val
+                    else:
+                        wal_arrays[name] = np.asarray(val)
+            self._wal_table("update", wal_meta, arrays=wal_arrays,
+                            rows=len(rows))
             self._bump_generation(type_name)
             self.tables[type_name] = FeatureTable(
                 table.sft, table._fids, cols, table.visibility,
                 _n=len(table))
             self._rebuild_indexes(type_name)
-            return int(len(rows))
+            n_updated = int(len(rows))
+        self._dur_tick()
+        return n_updated
 
     def update_schema(self, type_name: str, add_attributes: str = "",
                       new_name: Optional[str] = None) -> SimpleFeatureType:
@@ -679,10 +843,15 @@ class TpuDataStore:
         append new attributes (spec-string syntax; existing rows take the
         type's zero/empty value) and/or rename the type."""
         with self._lock:
-            return self._update_schema_locked(type_name, add_attributes,
+            out = self._update_schema_locked(type_name, add_attributes,
                                              new_name)
+        self._dur_tick()
+        return out
 
     def _update_schema_locked(self, type_name, add_attributes, new_name):
+        self._wal_json("update_schema", {"type": type_name,
+                                         "add": add_attributes,
+                                         "new_name": new_name})
         sft = self.schemas[type_name]
         spec = sft.to_spec()
         if add_attributes:
@@ -714,7 +883,9 @@ class TpuDataStore:
         if new_name is not None and new_name != type_name:
             if new_name in self.schemas:
                 raise ValueError(f"Schema {new_name} already exists")
-            self.remove_schema(type_name)
+            # locked variant: the update_schema record above already covers
+            # the rename — a nested remove_schema record would double-log
+            self._remove_schema_locked(type_name)
         self._bump_generation(final)
         self.schemas[final] = out
         # the stat battery is built against the OLD attribute set — drop it
@@ -737,12 +908,21 @@ class TpuDataStore:
             rows = planner.select_indices(f)
             if len(rows) == 0:
                 return 0
+            # log the resolved fid set, not the filter: replay removes
+            # exactly these rows regardless of later index/stats drift
+            self._wal_json(
+                "remove",
+                {"type": type_name,
+                 "fids": [str(x) for x in planner.table.fids_at(rows)]},
+                rows=len(rows))
             keep = np.ones(len(planner.table), dtype=bool)
             keep[rows] = False
             self._bump_generation(type_name)
             self.tables[type_name] = planner.table.take(np.nonzero(keep)[0])
             self._rebuild_indexes(type_name)
-            return int(len(rows))
+            n_removed = int(len(rows))
+        self._dur_tick()
+        return n_removed
 
 
 class DataStoreFinder:

@@ -1,0 +1,23 @@
+"""Durability subsystem: write-ahead log, incremental snapshots, crash
+recovery, fault injection.
+
+≙ the reference's storage-tier durability (Accumulo/HBase WALs + the Lambda
+tier's DataStorePersistence, SURVEY.md §2.6/§3.6): every logical mutation is
+crash-safe before it is acknowledged, restarts recover to exactly the logged
+state, and the fault-injection harness proves it by killing the store at
+every WAL/snapshot boundary.
+
+    store = TpuDataStore.open("/data/mystore")      # recovers if needed
+    store.durability.snapshot()                      # force a snapshot
+    report = store.recovery_report                   # what recovery did
+
+Modules: wal (CRC-framed segments + group-commit fsync), snapshot
+(tmp+rename-installed incremental images), recovery (snapshot + WAL-suffix
+replay with torn-tail truncation), faults (crash-point registry), rotation
+(the shared fsync/rotate/atomic-install helpers), manager (store wiring)."""
+
+from geomesa_tpu.durability import faults  # noqa: F401
+from geomesa_tpu.durability.manager import DurabilityManager, attach  # noqa: F401
+from geomesa_tpu.durability.recovery import (RecoveryReport,  # noqa: F401
+                                             recover_into)
+from geomesa_tpu.durability.wal import KINDS, WriteAheadLog  # noqa: F401

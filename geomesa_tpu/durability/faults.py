@@ -1,0 +1,145 @@
+"""Deterministic fault injection for the durability subsystem.
+
+≙ the crash-consistency test harnesses real storage engines carry (e.g.
+Accumulo's WAL recovery tests kill tablet servers at write boundaries): a
+registry of named **crash points** threaded through every WAL/snapshot
+boundary, plus torn-write / short-write / fsync-failure injection. Tests arm
+a point, drive mutations until the injected crash fires, then assert that
+``recover()`` reconstructs exactly the oracle state.
+
+Design constraints:
+  - zero overhead when disarmed (one module-global boolean check);
+  - ``InjectedCrash`` derives from BaseException so production ``except
+    Exception`` guards can never swallow a simulated process death;
+  - deterministic: ``arm(point, at=n)`` fires on the n-th hit of that point,
+    so "kill at every crash point" enumerates reproducibly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+# every registered crash point, in rough mutation-lifecycle order. Tests
+# iterate this to kill the store at each WAL/snapshot boundary.
+CRASH_POINTS = (
+    "wal.append.before",     # op never reached the log (op lost, never acked)
+    "wal.append.torn",       # process died mid-frame-write (torn tail)
+    "wal.append.after",      # frame written; died before the in-memory apply
+    "wal.fsync",             # died inside the group-commit fsync
+    "wal.rotate",            # died between segment close and successor open
+    "snapshot.capture",      # died before the snapshot tmp dir was written
+    "snapshot.written",      # tmp complete; died before the atomic install
+    "snapshot.installed",    # installed; died before WAL rotate + GC
+    "wal.gc",                # died before old segments were deleted
+)
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death (BaseException: nothing in the store may
+    catch-and-continue past a crash)."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected crash at {point!r}")
+        self.point = point
+
+
+_lock = threading.Lock()
+_active = False                      # fast-path gate (read without the lock)
+_armed: Dict[str, int] = {}          # point -> remaining hits before firing
+_torn_frac: float = 0.5              # fraction of the frame written when torn
+_fsync_errors = 0                    # pending injected fsync failures
+_hits: Dict[str, int] = {}           # observability: point -> times reached
+
+
+def reset() -> None:
+    """Disarm everything (test teardown)."""
+    global _active, _fsync_errors
+    with _lock:
+        _armed.clear()
+        _hits.clear()
+        _fsync_errors = 0
+        _active = False
+
+
+def arm(point: str, at: int = 1) -> None:
+    """Fire an InjectedCrash on the ``at``-th hit of ``point``."""
+    global _active
+    if point not in CRASH_POINTS:
+        raise ValueError(f"unknown crash point {point!r} "
+                         f"(have {list(CRASH_POINTS)})")
+    with _lock:
+        _armed[point] = int(at)
+        _active = True
+
+
+def arm_torn(at: int = 1, frac: float = 0.5) -> None:
+    """Arm a torn write: the ``at``-th WAL frame write persists only
+    ``frac`` of its bytes before the injected crash — the short-write /
+    power-loss-mid-sector shape recovery must truncate at."""
+    global _torn_frac
+    with _lock:
+        _torn_frac = float(frac)
+    arm("wal.append.torn", at=at)
+
+
+def arm_fsync_errors(n: int = 1) -> None:
+    """Make the next ``n`` fsyncs raise OSError (disk-full / EIO shape)."""
+    global _active, _fsync_errors
+    with _lock:
+        _fsync_errors = int(n)
+        _active = True
+
+
+def crash_point(point: str) -> None:
+    """Call site hook: dies here iff the point is armed and its countdown
+    reaches zero. Disarmed cost: one global read + compare."""
+    if not _active:
+        return
+    with _lock:
+        _hits[point] = _hits.get(point, 0) + 1
+        n = _armed.get(point)
+        if n is None:
+            return
+        if n > 1:
+            _armed[point] = n - 1
+            return
+        del _armed[point]
+    raise InjectedCrash(point)
+
+
+def torn_cut(size: int) -> Optional[int]:
+    """If a torn write is armed (and due), return how many of ``size``
+    frame bytes to persist before crashing; None = write normally. The cut
+    is clamped to [0, size-1] so the frame is always incomplete."""
+    if not _active:
+        return None
+    with _lock:
+        _hits["wal.append.torn"] = _hits.get("wal.append.torn", 0) + 1
+        n = _armed.get("wal.append.torn")
+        if n is None:
+            return None
+        if n > 1:
+            _armed["wal.append.torn"] = n - 1
+            return None
+        del _armed["wal.append.torn"]
+        return max(0, min(size - 1, int(size * _torn_frac)))
+
+
+def fsync_gate() -> None:
+    """Raise an injected fsync failure if one is pending (rotation.fsync_file
+    calls this before the real os.fsync)."""
+    global _fsync_errors
+    if not _active:
+        return
+    with _lock:
+        if _fsync_errors <= 0:
+            return
+        _fsync_errors -= 1
+    raise OSError("injected fsync failure")
+
+
+def hits() -> Dict[str, int]:
+    """Times each point was reached since the last reset (diagnostics)."""
+    with _lock:
+        return dict(_hits)

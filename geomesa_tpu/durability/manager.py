@@ -1,0 +1,197 @@
+"""DurabilityManager: the store-side owner of WAL + snapshot lifecycle.
+
+One manager per durable TpuDataStore. Mutators (holding the store lock) log
+their record BEFORE applying in memory (log-then-apply); after the public
+mutator releases the lock it calls ``maybe_snapshot()``, which writes an
+incremental snapshot once enough rows/bytes accumulated since the last one,
+rotates the WAL, and garbage-collects fully-covered segments.
+
+The ``replaying`` flag suppresses logging and snapshot triggers while
+recovery replays records through the same mutation paths.
+
+Layout under the durability directory::
+
+    <dir>/wal/wal-<first_seq>.log     append-only CRC-framed segments
+    <dir>/snapshot-<wal_seq>/         installed snapshots (catalog + npz)
+    <dir>/.tmp-snapshot-*             in-flight snapshot writes (crash junk)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from geomesa_tpu.durability.wal import WriteAheadLog
+
+
+def attach(store, path: str, params: Optional[dict] = None) -> None:
+    """Wire durability onto a fresh store: recover from an existing layout
+    when one is present, then start logging. Called from
+    ``TpuDataStore.__init__`` for ``params={"durability": path}`` /
+    ``TpuDataStore.open(path)``."""
+    from geomesa_tpu.durability import recovery as _recovery
+    from geomesa_tpu.durability import snapshot as _snap
+    from geomesa_tpu.durability import wal as _wal
+
+    params = params or {}
+    report = None
+    has_layout = bool(_snap.snapshot_dirs(path)) or \
+        bool(_wal.segments(os.path.join(path, "wal")))
+    if has_layout:
+        report = _recovery.recover_into(store, path)
+    start_seq = (report.last_seq + 1) if report else 1
+    store.durability = DurabilityManager(
+        store, path,
+        fsync=params.get("wal.fsync"),
+        segment_bytes=params.get("wal.segment_bytes"),
+        interval_ms=params.get("wal.interval_ms"),
+        snapshot_rows=params.get("snapshot.rows"),
+        snapshot_wal_bytes=params.get("snapshot.wal_bytes"),
+        start_seq=start_seq,
+        snapshot_seq=report.snapshot_seq if report else 0)
+    store.recovery_report = report
+
+
+class DurabilityManager:
+
+    def __init__(self, store, path: str, fsync: Optional[str] = None,
+                 segment_bytes: Optional[int] = None,
+                 interval_ms: Optional[float] = None,
+                 snapshot_rows: Optional[int] = None,
+                 snapshot_wal_bytes: Optional[int] = None,
+                 start_seq: int = 1, snapshot_seq: int = 0):
+        from geomesa_tpu import config
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        self.store = store
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.wal = WriteAheadLog(os.path.join(path, "wal"), fsync=fsync,
+                                 segment_bytes=segment_bytes,
+                                 interval_ms=interval_ms,
+                                 start_seq=start_seq)
+        self.replaying = False
+        self.snapshot_seq = int(snapshot_seq)
+        self._snapshot_rows = int(snapshot_rows
+                                  or config.SNAPSHOT_ROWS.get())
+        self._snapshot_wal_bytes = int(snapshot_wal_bytes
+                                       or config.SNAPSHOT_WAL_BYTES.get())
+        self._rows_since_snapshot = 0
+        self._bytes_since_snapshot = 0
+        self._last_snapshot_ts = time.time()
+        self._snap_lock = threading.Lock()
+        self.closed = False
+        # process-level gauges (last attached durable store wins — the
+        # one-store-per-process serving shape)
+        _metrics.set_gauge("durability.unsynced_bytes",
+                           lambda: self.wal.unsynced_bytes)
+        _metrics.set_gauge("durability.wal_seq", lambda: self.wal.last_seq)
+        _metrics.set_gauge(
+            "durability.last_snapshot_age_s",
+            lambda: round(time.time() - self._last_snapshot_ts, 1))
+
+    # -- logging (called by datastore mutators, store lock held) -------------
+
+    def log_json(self, kind: str, meta: dict, rows: int = 0) -> Optional[int]:
+        if self.replaying or self.closed:
+            return None
+        from geomesa_tpu.durability.wal import encode_json
+        return self._log(kind, encode_json(meta), rows)
+
+    def log_table(self, kind: str, meta: dict, table=None, arrays=None,
+                  rows: int = 0) -> Optional[int]:
+        if self.replaying or self.closed:
+            return None
+        from geomesa_tpu.durability.wal import encode_table
+        return self._log(kind, encode_table(meta, table, arrays), rows)
+
+    def _log(self, kind: str, payload: bytes, rows: int) -> int:
+        seq = self.wal.append(kind, payload)
+        self._rows_since_snapshot += rows
+        self._bytes_since_snapshot += len(payload)
+        return seq
+
+    # -- snapshots ------------------------------------------------------------
+
+    def maybe_snapshot(self) -> bool:
+        """Write a snapshot when the accumulation thresholds are crossed.
+        Called by mutators AFTER releasing the store lock."""
+        if self.replaying or self.closed:
+            return False
+        if (self._rows_since_snapshot < self._snapshot_rows
+                and self._bytes_since_snapshot < self._snapshot_wal_bytes):
+            return False
+        return self.snapshot()
+
+    def snapshot(self) -> bool:
+        """Capture (briefly under the store lock), write + install, rotate
+        the WAL, GC covered segments. Serialized; concurrent triggers
+        coalesce into one snapshot."""
+        from geomesa_tpu import trace as _trace
+        from geomesa_tpu.durability import snapshot as _snap
+        from geomesa_tpu.features.table import FeatureTable
+
+        if not self._snap_lock.acquire(blocking=False):
+            return False  # a snapshot is already in flight
+        try:
+            with _trace.span("durability.snapshot", kind="aggregate"):
+                store = self.store
+                with store._lock:
+                    schemas = dict(store.schemas)
+                    tables = {}
+                    for name in schemas:
+                        t = store.tables.get(name)
+                        d = store.deltas.get(name)
+                        if t is not None and d is not None:
+                            t = FeatureTable.concat([t, d])
+                        elif t is None:
+                            t = d
+                        tables[name] = t
+                    counters = dict(store._counters)
+                    generations = dict(store._generations)
+                    wal_seq = self.wal.last_seq
+                # everything captured is immutable (build-then-swap): the
+                # write happens outside the lock; later mutations get
+                # seq > wal_seq and stay in the replay suffix
+                self.wal.sync()
+                _snap.write_snapshot(self.path, schemas, tables, counters,
+                                     generations, wal_seq)
+                self.snapshot_seq = wal_seq
+                self._rows_since_snapshot = 0
+                self._bytes_since_snapshot = 0
+                self._last_snapshot_ts = time.time()
+                self.wal.rotate()
+                # GC only records the OLDEST retained snapshot covers: if
+                # the newest snapshot is later found corrupt, recovery can
+                # still fall back one generation and replay forward from it
+                retained = _snap.snapshot_dirs(self.path)
+                self.wal.gc(retained[0][0] if retained else wal_seq)
+            return True
+        finally:
+            self._snap_lock.release()
+
+    # -- surfaces -------------------------------------------------------------
+
+    def status(self) -> dict:
+        from geomesa_tpu.durability import snapshot as _snap
+        snaps = _snap.snapshot_dirs(self.path)
+        return {
+            "enabled": True,
+            "dir": self.path,
+            "wal": self.wal.stats(),
+            "snapshot_seq": self.snapshot_seq,
+            "snapshots": len(snaps),
+            "last_snapshot_age_s": round(time.time()
+                                         - self._last_snapshot_ts, 1),
+            "rows_since_snapshot": self._rows_since_snapshot,
+            "wal_bytes_since_snapshot": self._bytes_since_snapshot,
+            "snapshot_rows_threshold": self._snapshot_rows,
+            "snapshot_wal_bytes_threshold": self._snapshot_wal_bytes,
+        }
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.wal.close()

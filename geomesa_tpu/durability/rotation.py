@@ -1,0 +1,98 @@
+"""Shared file rotation / fsync / atomic-install policy.
+
+One tested home for the three disciplines every durable file in the system
+uses (≙ the reference's WAL + RFile commit discipline — Accumulo WALs fsync
+group-committed batches, and both stores install immutable files via
+tmp+rename):
+
+  rotate(path, keep)       keep-N numbered rotation (``path`` → ``path.1`` →
+                           ``path.2`` …), the AuditWriter JSONL policy and the
+                           WAL's bounded-history slot
+  atomic_install(tmp, dst) tmp+rename installation with parent-dir fsync —
+                           a reader never observes a half-written file/dir
+  fsync_file(fh)           flush + fsync with fault-injection hooks
+                           (durability/faults.py) threaded through
+
+Everything here is host-side posix file plumbing; no jax."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from geomesa_tpu.durability import faults
+
+
+def fsync_file(fh) -> None:
+    """flush + os.fsync, honouring injected fsync failures (faults.py).
+    Raises OSError when an injected (or real) fsync error fires — callers
+    decide whether that fails the write (WAL ``always``) or is retried
+    (WAL ``batch`` background syncer)."""
+    fh.flush()
+    faults.fsync_gate()
+    os.fsync(fh.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename inside it is durable (posix requires
+    the parent-dir fsync for the rename itself to survive power loss)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without dir-fd fsync: best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_install(tmp_path: str, final_path: str) -> None:
+    """Atomically install ``tmp_path`` at ``final_path`` (file or directory)
+    via rename, then fsync the parent so the rename is durable. The unit of
+    crash-atomicity for snapshots: a crash leaves either the old state or
+    the complete new one, never a torn install."""
+    faults.crash_point("snapshot.written")
+    os.replace(tmp_path, final_path)
+    fsync_dir(os.path.dirname(final_path) or ".")
+    faults.crash_point("snapshot.installed")
+
+
+def rotate(path: str, keep: int = 1,
+           on_drop: Optional[Callable[[str], None]] = None) -> None:
+    """Numbered keep-N rotation: ``path`` becomes ``path.1``, shifting
+    ``path.k`` → ``path.k+1`` up to ``keep``; the former ``path.keep`` is
+    dropped (``on_drop(dropped_path)`` runs first — the hook AuditWriter
+    uses to account discarded events). Each step is an atomic os.replace."""
+    if keep < 1:
+        raise ValueError("keep must be >= 1")
+    oldest = f"{path}.{keep}"
+    if os.path.exists(oldest) and on_drop is not None:
+        on_drop(oldest)
+    for k in range(keep, 1, -1):
+        src = f"{path}.{k - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{k}")
+    os.replace(path, f"{path}.1")
+
+
+def keep_newest(paths: List[str], keep: int,
+                on_drop: Optional[Callable[[str], None]] = None) -> List[str]:
+    """Delete all but the ``keep`` newest entries of ``paths`` (assumed
+    sorted oldest→newest; files or directories). Returns the dropped paths.
+    The snapshot-GC and WAL-segment-GC share this so 'how many old
+    generations survive' has one tested definition."""
+    import shutil
+    dropped = []
+    excess = paths[:-keep] if keep > 0 else list(paths)
+    for p in excess:
+        if on_drop is not None:
+            on_drop(p)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        else:
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+        dropped.append(p)
+    return dropped

@@ -1,0 +1,136 @@
+"""Incremental snapshots: periodic full-state images that let the WAL stay
+short.
+
+≙ the Lambda tier's ``DataStorePersistence`` flushing hot state to the cold
+store plus the reference's metadata/stats persistence (SURVEY.md §2.6/§3.6):
+rather than replaying an unbounded log on restart, the store periodically
+writes its complete columnar state (reusing io/checkpoint's table codec,
+compressed) tagged with the WAL sequence number it covers. Recovery loads
+the newest valid snapshot and replays only the WAL suffix past it; the WAL
+then rotates and fully-covered segments are garbage-collected — the
+"incremental" part is that each snapshot resets the replay horizon.
+
+Crash-atomicity: a snapshot directory is written under a dot-tmp name, every
+file fsynced, then installed via one atomic rename (rotation.atomic_install).
+A crash mid-write leaves a ``.tmp-`` dir recovery ignores (and cleans); a
+crash between install and WAL GC just means the next recovery skips records
+the snapshot already covers (replay starts strictly after ``wal_seq``)."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu.durability import faults, rotation
+
+_PREFIX = "snapshot-"
+_TMP_PREFIX = ".tmp-snapshot-"
+_VERSION = 2
+
+
+def snapshot_dirs(directory: str) -> List[Tuple[int, str]]:
+    """(wal_seq, path) for every installed snapshot, oldest first."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for fn in os.listdir(directory):
+        if fn.startswith(_PREFIX):
+            try:
+                out.append((int(fn[len(_PREFIX):]), os.path.join(directory, fn)))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def clean_tmp(directory: str) -> int:
+    """Remove torn ``.tmp-snapshot-*`` leftovers (a crash mid-write)."""
+    n = 0
+    if not os.path.isdir(directory):
+        return 0
+    for fn in os.listdir(directory):
+        if fn.startswith(_TMP_PREFIX):
+            shutil.rmtree(os.path.join(directory, fn), ignore_errors=True)
+            n += 1
+    return n
+
+
+def write_snapshot(directory: str, schemas: Dict[str, object],
+                   tables: Dict[str, object], counters: Dict[str, int],
+                   generations: Dict[str, int], wal_seq: int,
+                   keep: Optional[int] = None) -> str:
+    """Write + atomically install one snapshot covering WAL records up to
+    and including ``wal_seq``; prune to the newest ``keep`` snapshots.
+    ``tables`` must be the fully-merged immutable view (main ∪ delta) —
+    the caller captures it under the store lock; this function only reads.
+
+    Stats sketches are deliberately NOT persisted here (unlike io/checkpoint):
+    a snapshot's table may merge an unflushed delta the live battery has not
+    observed, so recovery re-observes — exactness over restore speed."""
+    from geomesa_tpu import config
+    from geomesa_tpu.io.checkpoint import _save_table
+    from geomesa_tpu.metrics import REGISTRY as _metrics
+
+    faults.crash_point("snapshot.capture")
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f"{_TMP_PREFIX}{wal_seq:020d}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    catalog: dict = {"version": _VERSION, "wal_seq": int(wal_seq),
+                     "ts_ms": int(time.time() * 1000), "types": {}}
+    for name, sft in schemas.items():
+        table = tables.get(name)
+        catalog["types"][name] = {
+            "spec": sft.to_spec(),
+            "counter": int(counters.get(name, 0)),
+            "generation": int(generations.get(name, 0)),
+            "rows": 0 if table is None else len(table),
+        }
+        if table is not None and len(table):
+            _save_table(table, os.path.join(tmp, f"{name}.npz"))
+    with open(os.path.join(tmp, "catalog.json"), "w") as fh:
+        json.dump(catalog, fh)
+        rotation.fsync_file(fh)
+    for fn in os.listdir(tmp):  # data files durable before the rename
+        if fn.endswith(".npz"):
+            with open(os.path.join(tmp, fn), "rb+") as fh:
+                rotation.fsync_file(fh)
+    rotation.fsync_dir(tmp)
+    final = os.path.join(directory, f"{_PREFIX}{wal_seq:020d}")
+    rotation.atomic_install(tmp, final)
+    _metrics.inc("snapshot.writes")
+    keep_n = int(keep if keep is not None else config.SNAPSHOT_KEEP.get())
+    rotation.keep_newest([p for _, p in snapshot_dirs(directory)], keep_n)
+    return final
+
+
+def load_snapshot(path: str):
+    """(wal_seq, {type: {"sft", "table", "counter", "generation"}}) from an
+    installed snapshot. Raises on a corrupt catalog — recovery falls back
+    to the next-older snapshot."""
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.io.checkpoint import _load_table
+
+    with open(os.path.join(path, "catalog.json")) as fh:
+        catalog = json.load(fh)
+    types = {}
+    for name, entry in catalog["types"].items():
+        sft = SimpleFeatureType.from_spec(name, entry["spec"])
+        table = None
+        if entry.get("rows", 0):
+            npz = os.path.join(path, f"{name}.npz")
+            if not os.path.exists(npz):
+                raise ValueError(
+                    f"corrupt snapshot: {entry['rows']} rows recorded for "
+                    f"{name!r} but {npz} is missing")
+            table = _load_table(sft, npz)
+            if len(table) != entry["rows"]:
+                raise ValueError(
+                    f"corrupt snapshot: {name!r} has {len(table)} rows, "
+                    f"catalog says {entry['rows']}")
+        types[name] = {"sft": sft, "table": table,
+                       "counter": int(entry.get("counter", 0)),
+                       "generation": int(entry.get("generation", 0))}
+    return int(catalog["wal_seq"]), types

@@ -175,17 +175,22 @@ class AuditWriter:
             return 0
 
     def _rotate(self) -> None:
-        import os
-        prev = self.path + ".1"
-        if os.path.exists(prev):
+        # the keep-N shuffle itself is the shared, tested helper the WAL
+        # segments and snapshot pruning also use (durability/rotation.py);
+        # only the event accounting is audit-specific
+        from geomesa_tpu.durability.rotation import rotate
+
+        def _account_drop(dropped_path: str) -> None:
             dropped = self._prev_events if self._prev_events is not None \
-                else self._count_lines(prev)
+                else self._count_lines(dropped_path)
             if dropped:
                 from geomesa_tpu.metrics import REGISTRY
                 REGISTRY.inc("audit.dropped", dropped)
-        os.replace(self.path, prev)
+
+        rotate(self.path, keep=1, on_drop=_account_drop)
         self._prev_events = self._file_events \
-            if self._file_events is not None else self._count_lines(prev)
+            if self._file_events is not None \
+            else self._count_lines(self.path + ".1")
         self._size = 0
         self._file_events = 0
 

@@ -26,7 +26,7 @@ from geomesa_tpu.features.geometry import GeometryArray
 from geomesa_tpu.features.sft import SimpleFeatureType
 from geomesa_tpu.features.table import FeatureTable, StringColumn
 
-_VERSION = 1
+_VERSION = 2
 
 
 def save_store(store, path: str) -> None:
@@ -39,6 +39,12 @@ def save_store(store, path: str) -> None:
         entry = {
             "spec": sft.to_spec(),
             "counter": store._counters.get(name, 0),
+            # v2: mutation-generation counters persist so a restore
+            # continues the sequence monotonically — a restored store's
+            # serving caches can never alias a prior incarnation's plans
+            # (belt-and-braces with the per-incarnation epoch salt in the
+            # scheduler's cache keys)
+            "generation": getattr(store, "_generations", {}).get(name, 0),
             "rows": 0 if table is None else len(table),
         }
         stats = store._stats.get(name)
@@ -73,13 +79,23 @@ def load_store(path: str):
             if stats_dict is not None:
                 cached = GeoMesaStats.from_dict(sft, stats_dict).cached
             store.load(name, table, stats_cached=cached)
+        # v2 catalogs: the restore counts as one more mutation on top of the
+        # persisted generation. v1 catalogs carry no counters — the store's
+        # fresh epoch (salted into every scheduler cache key) already makes
+        # cross-incarnation aliasing impossible, so the load-bump suffices.
+        stored_gen = entry.get("generation")
+        if stored_gen is not None:
+            store._generations[name] = max(
+                store._generations.get(name, 0), int(stored_gen) + 1)
     return store
 
 
 # -- columnar table codec ----------------------------------------------------
 
 
-def _save_table(table: FeatureTable, path: str) -> None:
+def table_payload(table: FeatureTable) -> Dict[str, np.ndarray]:
+    """The columnar npz payload for one table (shared by checkpoint files,
+    durability snapshots, and WAL append/upsert records)."""
     payload: Dict[str, np.ndarray] = {
         "__fids__": np.asarray(table.fids, dtype="U"),
     }
@@ -100,11 +116,16 @@ def _save_table(table: FeatureTable, path: str) -> None:
             payload[k + ":vocab"] = np.asarray(col.vocab, dtype="U")
         else:
             payload[k] = np.asarray(col)
-    np.savez_compressed(path, **payload)
+    return payload
 
 
-def _load_table(sft: SimpleFeatureType, path: str) -> FeatureTable:
-    z = np.load(path, allow_pickle=False)
+def _save_table(table: FeatureTable, path: str) -> None:
+    np.savez_compressed(path, **table_payload(table))
+
+
+def table_from_payload(sft: SimpleFeatureType, z) -> FeatureTable:
+    """Rebuild a FeatureTable from a ``table_payload`` mapping (an open npz
+    or any dict of arrays)."""
     data: Dict[str, object] = {}
     for attr in sft.attributes:
         k = f"col:{attr.name}"
@@ -123,3 +144,7 @@ def _load_table(sft: SimpleFeatureType, path: str) -> FeatureTable:
         table.visibility = StringColumn(
             z["__vis__:codes"], [str(v) for v in z["__vis__:vocab"]])
     return table
+
+
+def _load_table(sft: SimpleFeatureType, path: str) -> FeatureTable:
+    return table_from_payload(sft, np.load(path, allow_pickle=False))

@@ -19,12 +19,14 @@ the RTT instead of summing with it.
 
 Caching in front of the batcher:
 
-  plan cache   (type, generation, normalized filter, auths) → folded plan.
+  plan cache   (epoch, type, generation, normalized filter, auths) →
+               folded plan (epoch = the store incarnation's salt, so a
+               restored store never aliases a prior incarnation's plans).
                A hit skips parse + strategy selection + auths fold entirely
                (the trace tree shows no ``plan`` span). Keyed by auths so a
                privileged query's visibility-folded plan can never serve an
                unprivileged caller (tests/test_security.py).
-  cover cache  (type, generation, index, boxes, windows) → candidate gather
+  cover cache  (epoch, type, generation, index, boxes, windows) → candidate gather
                blocks. Parameterized queries that share a spatial/temporal
                region but differ in residual or auths skip the host range
                decomposition.
@@ -135,13 +137,16 @@ class StoreBinding:
 
 class PlannerBinding:
     """Bind a scheduler to bare QueryPlanners (bench / tests — no store, no
-    delta tier, one immutable generation)."""
+    delta tier, one immutable generation). Each binding gets its own epoch
+    so two bindings over recycled planner dicts cannot share cache keys."""
 
     def __init__(self, planners: Dict[str, object]):
+        from geomesa_tpu.datastore import _next_epoch
         self._planners = dict(planners)
+        self._epoch = _next_epoch()
 
     def snapshot(self, type_name: str):
-        return self._planners[type_name], None, 0
+        return self._planners[type_name], None, 0, self._epoch
 
     def delta_rows(self, delta, f, auths):
         return ()
@@ -155,12 +160,12 @@ class Request:
     the timing fields feed the caller's trace after resolution."""
 
     __slots__ = ("type_name", "f_ir", "f_key", "auths", "auths_key",
-                 "planner", "delta", "generation", "future", "t_submit",
-                 "plan", "queue_wait_s", "plan_s", "scan_s", "batched",
-                 "batch_size")
+                 "planner", "delta", "generation", "epoch", "future",
+                 "t_submit", "plan", "queue_wait_s", "plan_s", "scan_s",
+                 "batched", "batch_size")
 
     def __init__(self, type_name, f_ir, f_key, auths, auths_key,
-                 planner, delta, generation):
+                 planner, delta, generation, epoch):
         self.type_name = type_name
         self.f_ir = f_ir
         self.f_key = f_key
@@ -169,6 +174,7 @@ class Request:
         self.planner = planner
         self.delta = delta
         self.generation = generation
+        self.epoch = epoch
         self.future: Future = Future()
         self.t_submit = _pc()
         self.plan = None
@@ -254,9 +260,9 @@ class QueryScheduler:
         f_ir = parse_ecql(f) if isinstance(f, str) else f
         auths_key = None if auths is None \
             else tuple(sorted(str(a) for a in auths))
-        planner, delta, gen = self.binding.snapshot(type_name)
+        planner, delta, gen, epoch = self.binding.snapshot(type_name)
         req = Request(type_name, f_ir, repr(f_ir), auths, auths_key,
-                      planner, delta, gen)
+                      planner, delta, gen, epoch)
         _metrics.inc("scheduler.queries")
         self._queue.put(req)
         return req
@@ -386,7 +392,8 @@ class QueryScheduler:
         """Fill ``req.plan`` via the plan cache (auths-folded; cover cached
         on the plan). A cache hit leaves ``req.plan_s`` None — the trace
         shows no plan stage at all."""
-        pkey = (req.type_name, req.generation, req.f_key, req.auths_key)
+        pkey = (req.epoch, req.type_name, req.generation, req.f_key,
+                req.auths_key)
         plan = self.plans.get(pkey)
         if plan is not _MISS:
             req.plan = plan
@@ -408,7 +415,8 @@ class QueryScheduler:
         if plan.empty or plan.candidate_slices is not None \
                 or plan.index is None or plan.boxes_loose is None:
             return  # cover never applies; leave lazy
-        ckey = (req.type_name, req.generation, type(plan.index).__name__,
+        ckey = (req.epoch, req.type_name, req.generation,
+                type(plan.index).__name__,
                 plan.boxes_loose.tobytes(),
                 None if plan.windows is None else plan.windows.tobytes())
         cached = self.covers.get(ckey)

@@ -167,22 +167,86 @@ class LiveLayer:
 class LambdaDataStore:
     """Hot live tier + cold indexed tier, merged (≙ LambdaDataStore.scala:
     query = union(cache, store minus overlap); persistence flushes the hot
-    tier into the cold store)."""
+    tier into the cold store).
+
+    Durability: with a ``journal_dir`` the hot tier is write-ahead journaled
+    (every GeoMessage logged before it is applied — the moral slot of the
+    reference's Kafka topic as the durable message log), and ``persist()``
+    becomes a WAL-fenced two-phase move: ``persist_begin(fids)`` is
+    journaled, the rows move to the cold store through the ATOMIC
+    ``TpuDataStore.upsert`` (one cold-WAL record, idempotent), the captured
+    fids are cleared from the hot tier, and ``persist_commit`` closes the
+    fence. ``LambdaDataStore.open`` replays the journal on restart and
+    completes any begin-without-commit persist idempotently — a crash
+    between cold-append and hot-clear can neither drop nor duplicate rows."""
 
     def __init__(self, cold_store, type_name: str,
                  expiry_ms: Optional[int] = None,
                  event_time: Optional[str] = None,
-                 persist_threshold: int = 100_000):
+                 persist_threshold: int = 100_000,
+                 journal_dir: Optional[str] = None):
         self.cold = cold_store
         self.type_name = type_name
         self.sft = cold_store.get_schema(type_name)
         self.live = LiveLayer(self.sft, expiry_ms, event_time)
         self.persist_threshold = persist_threshold
+        self.journal = None
+        if journal_dir is not None:
+            from geomesa_tpu.durability.wal import WriteAheadLog
+            self.journal = WriteAheadLog(journal_dir, name="journal")
+
+    @classmethod
+    def open(cls, cold_store, type_name: str, journal_dir: str,
+             expiry_ms: Optional[int] = None,
+             event_time: Optional[str] = None,
+             persist_threshold: int = 100_000) -> "LambdaDataStore":
+        """Recover a journaled hot tier: replay GeoMessages (torn tail
+        stops at the first bad CRC), drop fids covered by committed
+        persists, and idempotently complete a begin-without-commit persist
+        against the (separately recovered) cold store."""
+        from geomesa_tpu.durability import wal as _wal
+        from geomesa_tpu.durability.wal import WriteAheadLog
+        lam = cls(cold_store, type_name, expiry_ms, event_time,
+                  persist_threshold)
+        last_seq = 0
+        pending: Optional[List[str]] = None
+        for seq, kind, payload in _wal.iter_records(journal_dir,
+                                                    name="journal"):
+            last_seq = seq
+            meta = _wal.decode_json(payload)
+            if kind == "hot_put":
+                lam.live.apply(GeoMessage("upsert", meta["fid"],
+                                          meta["attributes"],
+                                          int(meta["ts_ms"])))
+            elif kind == "hot_delete":
+                lam.live.apply(GeoMessage("delete", meta["fid"]))
+            elif kind == "hot_clear":
+                lam.live.apply(GeoMessage.clear())
+            elif kind == "hot_expire":
+                lam.live.expire(now_ms=int(meta["now_ms"]))
+            elif kind == "persist_begin":
+                pending = list(meta["fids"])
+            elif kind == "persist_commit":
+                lam._drop_hot(pending or [])
+                pending = None
+        lam.journal = WriteAheadLog(journal_dir, name="journal",
+                                    start_seq=last_seq + 1)
+        if pending is not None:
+            lam._complete_persist(pending)
+        return lam
+
+    def close(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
 
     # -- writes land in the hot tier -----------------------------------------
 
     def put(self, fid: str, **attributes) -> None:
-        self.live.put(fid, **attributes)
+        msg = GeoMessage.upsert(fid, attributes)
+        if self.journal is not None:
+            self.journal.append_json("hot_put", {
+                "fid": fid, "attributes": attributes, "ts_ms": msg.ts_ms})
+        self.live.apply(msg)
         if len(self.live) >= self.persist_threshold:
             self.persist()
 
@@ -190,26 +254,67 @@ class LambdaDataStore:
         """Remove from the hot tier AND the cold tier — a delete must reach
         whichever tier currently holds the feature (≙ the lambda tier
         writing Kafka deletes while also deleting from the persistent store)."""
+        if self.journal is not None:
+            self.journal.append_json("hot_delete", {"fid": fid})
         self.live.delete(fid)
         if self.cold.tables.get(self.type_name) is not None:
             self.cold.remove_features(self.type_name, ir.FidFilter((fid,)))
 
+    def expire(self, now_ms: Optional[int] = None) -> int:
+        """Journaled event/ingest-time expiry of the hot tier (the clock is
+        resolved before logging so replay uses the same cutoff)."""
+        now = int(time.time() * 1000) if now_ms is None else int(now_ms)
+        if self.journal is not None:
+            self.journal.append_json("hot_expire", {"now_ms": now})
+        return self.live.expire(now)
+
+    def _drop_hot(self, fids) -> None:
+        """Remove exactly these fids from the hot tier (not a blanket
+        clear: puts that raced in after the persist captured its table
+        survive)."""
+        dropped = False
+        for fid in fids:
+            if self.live._state.pop(fid, None) is not None:
+                dropped = True
+        if dropped:
+            self.live._dirty = True
+
     def persist(self) -> int:
-        """Flush the hot tier into the cold store (≙ DataStorePersistence).
-        Hot rows that shadow cold fids replace them. Returns rows flushed."""
+        """Move the hot tier into the cold store (≙ DataStorePersistence).
+        Hot rows that shadow cold fids replace them. The move itself is the
+        cold store's atomic ``upsert`` (remove-duplicates + append under one
+        lock hold, one WAL record) — re-running it after a crash at ANY
+        point converges instead of losing or double-counting rows, because
+        until the hot fids are dropped they shadow their cold copies on
+        every read. Returns rows flushed."""
         table = self.live.table()
         if table is None:
             return 0
-        shadowed = [f for f in table.fids]
-        if self.cold.tables.get(self.type_name) is not None:
-            existing = set(self.cold.tables[self.type_name].fids)
-            dup = [f for f in shadowed if f in existing]
-            if dup:
-                self.cold.remove_features(
-                    self.type_name, ir.FidFilter(tuple(dup)))
-        self.cold.load(self.type_name, table)
-        self.live.clear()
+        fids = [str(f) for f in table.fids]
+        if self.journal is not None:
+            self.journal.append_json("persist_begin", {"fids": fids})
+        self.cold.upsert(self.type_name, table)
+        self._drop_hot(fids)
+        if self.journal is not None:
+            self.journal.append_json("persist_commit", {"n": len(fids)})
         return len(table)
+
+    def _complete_persist(self, fids) -> int:
+        """Finish a begin-without-commit persist found at recovery: re-move
+        whichever of its fids still sit in the hot tier (idempotent against
+        a cold store that already replayed the original upsert) and close
+        the fence."""
+        present = [f for f in fids if f in self.live._state]
+        if present:
+            table = self.live.table()
+            idx = np.flatnonzero(np.isin(
+                np.asarray(table.fids, dtype=object),
+                np.asarray(present, dtype=object)))
+            self.cold.upsert(self.type_name, table.take(idx))
+            self._drop_hot(present)
+        self.journal.append_json("persist_commit",
+                                 {"n": len(present), "recovered": True})
+        return len(present)
 
     # -- merged reads --------------------------------------------------------
 

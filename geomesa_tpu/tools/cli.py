@@ -12,7 +12,8 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|scheduler [--format prometheus] [-s STORE -f NAME -q ECQL]
+    geomesa-tpu debug         metrics|traces|scheduler|wal [--format prometheus] [-s STORE -f NAME -q ECQL]
+    geomesa-tpu recover       --dir DURABILITY_DIR
     geomesa-tpu describe / list / remove-schema
 """
 
@@ -195,16 +196,48 @@ def cmd_age_off(args):
     print(f"Aged off {n} features")
 
 
+def cmd_recover(args):
+    """Crash recovery (the runbook command): load the newest valid snapshot
+    under the durability dir, replay the WAL suffix past it (truncating a
+    torn tail at the first bad CRC), rebuild indexes, then write a fresh
+    post-recovery snapshot so the next restart replays nothing."""
+    from geomesa_tpu.datastore import TpuDataStore
+    d = args.dir or args.store
+    if not d:
+        raise SystemExit("recover requires --dir (or -s) DURABILITY_DIR")
+    store = TpuDataStore.open(d)
+    report = store.recovery_report
+    out = report.to_dict() if report is not None else {"recovered": False}
+    out["rows"] = {t: (0 if store.tables.get(t) is None
+                       else len(store.tables[t]))
+                   for t in store.get_type_names()}
+    out["post_recovery_snapshot"] = store.durability.snapshot()
+    store.close()
+    print(json.dumps(out, indent=2, default=str))
+
+
 def cmd_debug(args):
     """Observability surface: dump the process metrics registry, the
-    recent-trace ring, or the query-scheduler state (≙ the reference's
-    stats/audit debug commands). With a store + feature + CQL, runs the
+    recent-trace ring, the query-scheduler state, or the WAL segment
+    inspector (≙ the reference's stats/audit debug commands plus an
+    accumulo-style wal-info). With a store + feature + CQL, runs the
     query first so the dump reflects a real execution — the offline way to
     read a trace tree. ``debug scheduler`` drives the warm query THROUGH the
     scheduler (a concurrent burst, so the dump shows real coalescing:
-    queue depth, batch-size histogram, flush reasons, cache hit rates)."""
+    queue depth, batch-size histogram, flush reasons, cache hit rates).
+    ``debug wal -s DIR`` lists every segment's records (seq ranges, kinds,
+    torn-tail diagnostics) without opening the store."""
     from geomesa_tpu.metrics import REGISTRY
     from geomesa_tpu.trace import RING
+    if args.what == "wal":
+        if not args.store:
+            raise SystemExit("debug wal requires -s DURABILITY_DIR")
+        from geomesa_tpu.durability import wal as _walmod
+        out = _walmod.inspect(os.path.join(args.store, "wal"))
+        out["journal"] = _walmod.inspect(
+            os.path.join(args.store, "journal"), name="journal")["segments"]
+        print(json.dumps(out, indent=2))
+        return
     store = None
     if args.store:
         store = _load(args.store, must_exist=True)
@@ -349,8 +382,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_config)
 
     sp = sub.add_parser(
-        "debug", help="dump metrics, recent query traces, or scheduler state")
-    sp.add_argument("what", choices=("metrics", "traces", "scheduler"))
+        "recover",
+        help="crash-recover a durable store directory (snapshot + WAL "
+             "replay, torn tail truncated) and write a fresh snapshot")
+    sp.add_argument("--dir", help="durability directory (as passed to "
+                                  "TpuDataStore.open / params['durability'])")
+    sp.add_argument("-s", "--store", help="alias for --dir")
+    sp.set_defaults(fn=cmd_recover)
+
+    sp = sub.add_parser(
+        "debug", help="dump metrics, recent query traces, scheduler state, "
+                      "or the WAL segment inspector")
+    sp.add_argument("what", choices=("metrics", "traces", "scheduler", "wal"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query")
     sp.add_argument("-q", "--cql", help="ECQL filter for the warm query")

@@ -23,6 +23,9 @@ Span kinds (the fixed vocabulary hot paths use):
   refine           host f64 re-evaluation of device candidates
   aggregate        host-side merge/summarize (density decode, join merge…)
   serialize        row hydration / output encoding
+  wal_append       write-ahead-log frame write (durability/wal.py)
+  wal_fsync        group-commit fsync (the durability tax, measured)
+  recovery         snapshot load + WAL replay at DataStore.open()
 
 Usage::
 
@@ -54,7 +57,8 @@ from typing import Dict, Iterator, List, Optional
 from geomesa_tpu.metrics import REGISTRY as _REGISTRY
 
 SPAN_KINDS = ("plan", "range_decompose", "queue_wait", "scan", "device_scan",
-              "device_wait", "refine", "aggregate", "serialize")
+              "device_wait", "refine", "aggregate", "serialize",
+              "wal_append", "wal_fsync", "recovery")
 
 _pc = time.perf_counter  # cached: spans sit on µs-scale hot paths
 

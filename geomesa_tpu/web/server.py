@@ -22,7 +22,10 @@ Routes:
   GET  /traces?limit=N                 → recent query traces, newest first
   GET  /scheduler                      → scheduler state (queue depth, batch
                                          histogram, cache hit rates)
-  GET  /healthz                        → liveness + device count
+  GET  /durability                     → WAL/snapshot status (policy, seq,
+                                         unsynced bytes, last-snapshot age)
+  GET  /healthz                        → liveness + device count + durability
+                                         and recovery/replay state
   GET  /config                         → system-property listing
 """
 
@@ -68,11 +71,25 @@ class GeoJsonApi:
             return 200, {"traces": RING.recent(limit)}
         if parts == ["scheduler"]:
             return 200, self.store.scheduler().stats()
+        if parts == ["durability"]:
+            d = getattr(self.store, "durability", None)
+            if d is None:
+                return 200, {"enabled": False}
+            return 200, d.status()
         if parts == ["healthz"]:
             import jax
+            report = getattr(self.store, "recovery_report", None)
+            d = getattr(self.store, "durability", None)
             return 200, {"status": "ok",
                          "devices": len(jax.local_devices()),
-                         "types": len(self.store.get_type_names())}
+                         "types": len(self.store.get_type_names()),
+                         "durability": {
+                             "enabled": d is not None,
+                             "wal_policy": d.wal.policy if d else None,
+                             "unsynced_bytes": d.wal.unsynced_bytes
+                             if d else None},
+                         "recovery": report.to_dict() if report is not None
+                         else {"recovered": False}}
         if parts == ["config"]:
             from geomesa_tpu import config
             return 200, config.describe()

@@ -132,3 +132,85 @@ def test_lambda_auto_persist():
     assert len(lam.live) == 0  # threshold crossed -> flushed
     assert lam.cold.count("live") == 10
     assert lam.count("v < 5") == 5
+
+
+# -- durability: journaled hot tier + idempotent persist ----------------------
+
+
+def test_upsert_idempotent(lam):
+    """The hot→cold move primitive: re-applying the same batch converges
+    (no lost rows, no double counts) — the property a crash replay needs."""
+    total = lam.count()
+    lam.put("u1", name="a", v=7, dtg=DTG, geom=(2.0, 2.0))
+    lam.put("c2", name="a", v=7000, dtg=DTG, geom=(2.0, 2.0))  # shadows cold
+    table = lam.live.table()
+    lam.cold.upsert("live", table)
+    lam.cold.upsert("live", table)  # replay of the same move
+    assert lam.cold.count("live") == total + 1  # u1 new, c2 replaced once
+    assert int(np.sum(lam.cold.tables["live"].fids == "c2")) == 1
+    assert lam.cold.count("live", "v = 7000") == 1
+
+
+def test_persist_crash_window_idempotent(lam):
+    """Regression for the half-completed persist: cold-append done, hot tier
+    NOT yet cleared (the old remove-then-load window). Reads stay exact
+    (hot shadows cold) and re-running persist neither loses nor
+    double-counts rows."""
+    total = lam.count()
+    lam.put("w1", name="b", v=11, dtg=DTG, geom=(4.0, 4.0))
+    lam.put("c3", name="b", v=8000, dtg=DTG, geom=(4.0, 4.0))
+    # simulate the crash window: the move happened, the hot-clear did not
+    lam.cold.upsert("live", lam.live.table())
+    assert len(lam.live) == 2              # hot tier still holds both
+    assert lam.count() == total + 1        # no double count while shadowed
+    flushed = lam.persist()                # re-run the interrupted persist
+    assert flushed == 2
+    assert len(lam.live) == 0
+    assert lam.count() == total + 1        # still exactly once
+    assert int(np.sum(lam.cold.tables["live"].fids == "w1")) == 1
+    assert int(np.sum(lam.cold.tables["live"].fids == "c3")) == 1
+
+
+def test_journaled_lambda_recovers(tmp_path):
+    """Hot-tier WAL journal: puts/deletes replay; a committed persist's fids
+    do not resurrect in the hot tier."""
+    cold = TpuDataStore()
+    cold.create_schema("live", SPEC)
+    jd = str(tmp_path / "journal")
+    lam = LambdaDataStore(cold, "live", journal_dir=jd)
+    for i in range(6):
+        lam.put(f"h{i}", name="a", v=i, dtg=DTG, geom=(float(i), 0.0))
+    lam.delete("h0")
+    lam.persist()
+    lam.put("late", name="b", v=99, dtg=DTG, geom=(9.0, 9.0))
+    lam.journal.close()
+    # crash: rebuild the hot tier from the journal over the same cold store
+    lam2 = LambdaDataStore.open(cold, "live", jd)
+    assert sorted(lam2.live.fids) == ["late"]   # persisted fids stay cold
+    assert lam2.count() == 6                    # h1..h5 cold + late hot
+    assert lam2.count("v = 99") == 1
+    lam2.close()
+
+
+def test_journaled_persist_two_phase_completion(tmp_path):
+    """A begin-without-commit persist (crash between cold-append and
+    hot-clear) completes idempotently at recovery: rows exactly once."""
+    cold = TpuDataStore()
+    cold.create_schema("live", SPEC)
+    jd = str(tmp_path / "journal")
+    lam = LambdaDataStore(cold, "live", journal_dir=jd)
+    for i in range(4):
+        lam.put(f"p{i}", name="a", v=i, dtg=DTG, geom=(1.0, 1.0))
+    fids = [str(f) for f in lam.live.table().fids]
+    lam.journal.append_json("persist_begin", {"fids": fids})
+    cold.upsert("live", lam.live.table())   # cold-append landed …
+    lam.journal.close()                     # … crash before commit
+    lam2 = LambdaDataStore.open(cold, "live", jd)
+    assert len(lam2.live) == 0              # completion cleared the hot tier
+    assert lam2.count() == 4                # no loss
+    assert cold.count("live") == 4          # no duplication
+    # and the fence is closed: another recovery replays cleanly
+    lam2.close()
+    lam3 = LambdaDataStore.open(cold, "live", jd)
+    assert lam3.count() == 4 and len(lam3.live) == 0
+    lam3.close()
