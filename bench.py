@@ -27,6 +27,11 @@ Covers the five BASELINE.md configs:
   6. WAL ingest overhead: sustained bulk-ingest rows/s through the
      datastore with durability off vs WAL fsync=off/batch/always
      (durability subsystem acceptance: batch within 15% of no-WAL).
+  7. Overload behavior: 4x the admission bound of concurrent interactive
+     clients against a tightly bounded scheduler — measures the shed rate
+     (excess rejected with backpressure, not queued into collapse) and the
+     p99 latency of the ADMITTED requests (the property load shedding
+     exists to protect).
 
 Headline metric = config 1 blocking p50 (RTT included; see rtt field).
 ``vs_baseline`` = indexed-CPU comparator p50 / batch64 per-query (sustained
@@ -148,7 +153,7 @@ def main() -> None:
     n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
     configs = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
-                                 "0,1,2,3,4,5,6").split(","))
+                                 "0,1,2,3,4,5,6,7").split(","))
     rng = np.random.default_rng(1234)
     detail: dict = {"n_points": n, "device": str(jax.devices()[0]),
                     "host_cores": os.cpu_count()}
@@ -753,6 +758,78 @@ def main() -> None:
             detail[f"cfg6_ingest_qps_wal_{pol}"] = round(q, 0)
             detail[f"cfg6_wal_{pol}_overhead_pct"] = round(
                 100.0 * (1.0 - q / base), 1)
+
+    # ---- config 7: overload shed rate + admitted p99 ----------------------
+    if "7" in configs:
+        import threading
+
+        from geomesa_tpu import config as _cfg
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.serve.resilience.admission import ShedError
+        from geomesa_tpu.serve.scheduler import QueryScheduler, StoreBinding
+
+        n7 = min(n, 2_000_000)
+        sft7 = SimpleFeatureType.from_spec(
+            "ovl", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
+        st7 = TpuDataStore()
+        st7.create_schema(sft7)
+        st7.load("ovl", FeatureTable.build(
+            sft7, {"dtg": dtg[:n7], "geom": (x[:n7], y[:n7])}))
+        limit7 = 16
+        _cfg.ADMIT_INTERACTIVE.set(limit7)
+        sched7 = QueryScheduler(StoreBinding(st7), flush_size=8,
+                                window_us=300)
+        try:
+            q7 = (f"BBOX(geom, {qx0}, {qy0}, {qx1}, {qy1}) AND dtg DURING "
+                  "2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+            sched7.count("ovl", q7)  # warm: plan + kernels compiled
+            n_clients = 4 * limit7              # the 4x saturation burst
+            per_client = 8
+            lat_ok: list = []
+            shed = admitted = 0
+            tally = threading.Lock()
+
+            def client(i):
+                nonlocal shed, admitted
+                for j in range(per_client):
+                    t0 = time.perf_counter()
+                    try:
+                        sched7.count(
+                            "ovl", f"BBOX(geom, {qx0 + (i + j) % 7 * 0.1}, "
+                                   f"{qy0}, {qx1}, {qy1}) AND dtg DURING "
+                                   "2020-01-05T00:00:00Z/"
+                                   "2020-01-12T00:00:00Z",
+                            timeout=30)
+                    except ShedError:
+                        with tally:
+                            shed += 1
+                        continue
+                    dt = time.perf_counter() - t0
+                    with tally:
+                        admitted += 1
+                        lat_ok.append(dt)
+
+            ts7 = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+            t0 = time.perf_counter()
+            [t.start() for t in ts7]
+            [t.join() for t in ts7]
+            wall7 = time.perf_counter() - t0
+            submitted = n_clients * per_client
+            detail["cfg7_n"] = n7
+            detail["cfg7_submitted"] = submitted
+            detail["cfg7_admitted"] = admitted
+            detail["cfg7_overload_shed_rate"] = round(shed / submitted, 3)
+            if lat_ok:
+                detail["cfg7_overload_admitted_p99_ms"] = round(float(
+                    np.percentile(np.asarray(lat_ok) * 1000, 99)), 2)
+                detail["cfg7_overload_admitted_p50_ms"] = round(
+                    _p50(lat_ok), 2)
+            detail["cfg7_overload_qps"] = round(admitted / wall7, 1)
+            assert admitted + shed == submitted  # nothing silently dropped
+        finally:
+            _cfg.ADMIT_INTERACTIVE.unset()
+            sched7.shutdown()
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

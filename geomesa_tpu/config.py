@@ -187,6 +187,89 @@ KERNEL_CACHE = _register(
     "with many residual structures stay bounded; evicted signatures "
     "recompile on next use.")
 
+# -- query-lifecycle resilience (serve/resilience/) ---------------------------
+
+DEADLINE_DEFAULT_MS = _register(
+    "GEOMESA_TPU_DEADLINE_DEFAULT_MS", 0.0, float,
+    "Default per-request deadline the web layer attaches when the client "
+    "sends none (X-Deadline-Ms header / ?deadline_ms=). 0 disables the "
+    "implicit deadline; production serving should set ~30000.")
+
+DEADLINE_MAX_MS = _register(
+    "GEOMESA_TPU_DEADLINE_MAX_MS", 300_000.0, float,
+    "Hard cap on client-requested deadlines (a client cannot hold serving "
+    "resources longer than this).")
+
+DEADLINE_DEGRADE_MS = _register(
+    "GEOMESA_TPU_DEADLINE_DEGRADE_MS", 25.0, float,
+    "Graceful degradation floor: when a deadlined count reaches dispatch "
+    "with less than this many ms remaining, an eligible query returns the "
+    "stats-estimator approximation (flagged) instead of risking a device "
+    "round trip it cannot afford. 0 disables degradation (expired queries "
+    "then fail with deadline-exceeded only).")
+
+ADMIT_ENABLED = _register(
+    "GEOMESA_TPU_ADMIT", True, _parse_bool,
+    "Master switch for serving-path admission control (bounded in-flight "
+    "work per priority class; excess sheds with 429 + Retry-After).")
+
+ADMIT_INTERACTIVE = _register(
+    "GEOMESA_TPU_ADMIT_INTERACTIVE", 512, int,
+    "Max in-flight (queued + executing) interactive-class queries before "
+    "new ones shed. Sized so a full queue drains within a typical "
+    "interactive deadline at the measured batch throughput.")
+
+ADMIT_BATCH = _register(
+    "GEOMESA_TPU_ADMIT_BATCH", 128, int,
+    "Max in-flight analytics/batch-class queries (the lower bound keeps "
+    "background scans from starving interactive traffic; the scheduler "
+    "queue additionally serves interactive requests first).")
+
+ADMIT_RETRY_AFTER_S = _register(
+    "GEOMESA_TPU_ADMIT_RETRY_AFTER_S", 1.0, float,
+    "Retry-After seconds returned with shed (429) responses.")
+
+BREAKER_THRESHOLD = _register(
+    "GEOMESA_TPU_BREAKER_THRESHOLD", 5, int,
+    "Consecutive device-dispatch failures that open the circuit breaker "
+    "(while open, eligible counts degrade to the stats estimator and "
+    "other queries fail fast with 503 instead of queueing onto a sick "
+    "device path).")
+
+BREAKER_COOLDOWN_MS = _register(
+    "GEOMESA_TPU_BREAKER_COOLDOWN_MS", 1000.0, float,
+    "How long an open breaker waits before letting half-open probe "
+    "traffic through.")
+
+BREAKER_PROBES = _register(
+    "GEOMESA_TPU_BREAKER_PROBES", 2, int,
+    "Consecutive half-open probe successes required to close the breaker "
+    "(any probe failure re-opens and restarts the cooldown).")
+
+BREAKER_DEGRADE = _register(
+    "GEOMESA_TPU_BREAKER_DEGRADE", True, _parse_bool,
+    "When the breaker is open, serve eligible counts from the stats "
+    "estimator (flagged approximate) instead of failing fast.")
+
+RETRY_ATTEMPTS = _register(
+    "GEOMESA_TPU_RETRY_ATTEMPTS", 3, int,
+    "Max attempts for the device-dispatch retry wrapper (capped "
+    "exponential backoff with full jitter between attempts).")
+
+RETRY_BASE_MS = _register(
+    "GEOMESA_TPU_RETRY_BASE_MS", 5.0, float,
+    "Backoff base: attempt i sleeps uniform(0, min(cap, base * 2^i)) ms.")
+
+RETRY_CAP_MS = _register(
+    "GEOMESA_TPU_RETRY_CAP_MS", 100.0, float,
+    "Backoff ceiling per retry sleep.")
+
+RETRY_WAL_FSYNC = _register(
+    "GEOMESA_TPU_RETRY_WAL_FSYNC", 1, int,
+    "Attempts for a failing WAL group-commit fsync before the error "
+    "propagates (transient EIO/disk-pressure absorption). 1 = no retry, "
+    "the strict policy the durability tests pin.")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

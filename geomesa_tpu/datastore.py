@@ -556,8 +556,16 @@ class TpuDataStore:
     def scheduler(self):
         """The store's micro-batching query scheduler (lazily started; one
         per store). Concurrent counts submitted here coalesce into fused
-        batched device dispatches — see serve/scheduler.py."""
+        batched device dispatches — see serve/scheduler.py. A scheduler
+        whose worker threads died (fault injection, a bug) is replaced
+        with a fresh one on next access — outstanding futures were already
+        failed with a structured error by the crash handler."""
         with self._lock:
+            if self._scheduler is not None and not self._scheduler.healthy():
+                from geomesa_tpu.metrics import REGISTRY as _metrics
+                _metrics.inc("scheduler.restarts")
+                self._scheduler.shutdown(timeout=0.1)
+                self._scheduler = None
             if self._scheduler is None:
                 from geomesa_tpu.serve.scheduler import (QueryScheduler,
                                                          StoreBinding)
@@ -565,31 +573,47 @@ class TpuDataStore:
             return self._scheduler
 
     def count_many(self, type_name: str, filters,
-                   auths: Optional[list] = None) -> List[int]:
+                   auths: Optional[list] = None,
+                   deadline_ms: Optional[float] = None,
+                   priority: str = "interactive") -> List[int]:
         """Counts for many filters through the scheduler: compatible queries
         fuse into single batched device dispatches; repeated/parameterized
-        filters hit the plan/cover caches. Order-preserving."""
-        return self.scheduler().count_many(type_name, filters, auths=auths)
+        filters hit the plan/cover caches. Order-preserving. ``deadline_ms``
+        bounds every count in the set; ``priority`` classes the work for
+        admission control ('interactive' | 'batch')."""
+        return self.scheduler().count_many(type_name, filters, auths=auths,
+                                           deadline_ms=deadline_ms,
+                                           priority=priority)
 
     def count_future(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
-                     auths: Optional[list] = None):
+                     auths: Optional[list] = None,
+                     deadline_ms: Optional[float] = None,
+                     priority: str = "interactive"):
         """Async count: submit to the scheduler and return the Request
         handle (``.result()`` blocks; ``.future`` is a concurrent.futures
         Future) — the serving-path analogue of PreparedQuery.count_async."""
-        return self.scheduler().submit(type_name, f, auths=auths)
+        return self.scheduler().submit(type_name, f, auths=auths,
+                                       deadline_ms=deadline_ms,
+                                       priority=priority)
 
     def count_coalesced(self, type_name: str,
                         f: Union[str, ir.Filter] = "INCLUDE",
-                        auths: Optional[list] = None) -> int:
+                        auths: Optional[list] = None,
+                        deadline_ms: Optional[float] = None,
+                        priority: str = "interactive") -> int:
         """Count via the scheduler when serving coalescing is enabled
         (GEOMESA_TPU_SCHEDULER / params {'scheduler': False}); otherwise the
         direct per-request path. The web /count route calls this, so
-        concurrent HTTP requests share device dispatches."""
+        concurrent HTTP requests share device dispatches — and propagate
+        their deadline/priority envelope into the scheduler."""
         from geomesa_tpu import config
         if not config.SCHED_ENABLED.get() \
                 or self.params.get("scheduler") is False:
-            return self.count(type_name, f, auths=auths)
-        return self.scheduler().count(type_name, f, auths=auths)
+            return self.count(type_name, f, auths=auths,
+                              deadline_ms=deadline_ms)
+        return self.scheduler().count(type_name, f, auths=auths,
+                                      deadline_ms=deadline_ms,
+                                      priority=priority)
 
     # -- queries ------------------------------------------------------------
 
@@ -609,7 +633,8 @@ class TpuDataStore:
         return self.planners[type_name]
 
     def query(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
-              hints: Optional[dict] = None, auths: Optional[list] = None):
+              hints: Optional[dict] = None, auths: Optional[list] = None,
+              deadline_ms: Optional[float] = None):
         """Run a query; ``hints`` switch the result form exactly like the
         reference's QueryHints (conf/QueryHints.scala — DENSITY_*/BIN_*/
         STATS_*/SAMPLING keys):
@@ -629,7 +654,9 @@ class TpuDataStore:
           hints["transform"] = ["attr", "out=expr(...)"]  (projected type)
           hints["crs"]       = "EPSG:3857"                (output reprojection)
         """
-        with _trace.trace("query.features", type=type_name, filter=str(f)):
+        from geomesa_tpu.serve.resilience import deadline as _rdl
+        with _trace.trace("query.features", type=type_name, filter=str(f)), \
+                _rdl.scope(deadline_ms):
             return self._query_impl(type_name, f, hints, auths)
 
     def _query_impl(self, type_name, f, hints, auths):
@@ -719,10 +746,13 @@ class TpuDataStore:
         raise ValueError(f"Unknown hints: {sorted(hints)}")
 
     def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
-              auths: Optional[list] = None) -> int:
+              auths: Optional[list] = None,
+              deadline_ms: Optional[float] = None) -> int:
         from geomesa_tpu.metrics import REGISTRY as _metrics
+        from geomesa_tpu.serve.resilience import deadline as _rdl
         _metrics.inc("query.counts")
-        with _trace.trace("query.count", type=type_name, filter=str(f)):
+        with _trace.trace("query.count", type=type_name, filter=str(f)), \
+                _rdl.scope(deadline_ms):
             return self._count_impl(type_name, f, auths)
 
     def _count_impl(self, type_name, f, auths) -> int:

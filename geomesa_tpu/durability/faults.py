@@ -1,4 +1,4 @@
-"""Deterministic fault injection for the durability subsystem.
+"""Deterministic fault injection for the durability + serving subsystems.
 
 ≙ the crash-consistency test harnesses real storage engines carry (e.g.
 Accumulo's WAL recovery tests kill tablet servers at write boundaries): a
@@ -6,6 +6,14 @@ registry of named **crash points** threaded through every WAL/snapshot
 boundary, plus torn-write / short-write / fsync-failure injection. Tests arm
 a point, drive mutations until the injected crash fires, then assert that
 ``recover()`` reconstructs exactly the oracle state.
+
+The serving path threads through the same registry (**serve points**,
+``SERVE_POINTS``): tests inject slow device rounds (``arm_serve_delay``),
+dispatch errors (``arm_serve_error``), queue saturation (a collector stall
+is a delay at ``sched.collect``), and killed scheduler worker threads
+(``arm_serve_crash``) — so every overload / breaker / worker-death behavior
+in serve/resilience is exercised deterministically, never by racing real
+load.
 
 Design constraints:
   - zero overhead when disarmed (one module-global boolean check);
@@ -18,6 +26,7 @@ Design constraints:
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, Optional
 
 # every registered crash point, in rough mutation-lifecycle order. Tests
@@ -44,12 +53,30 @@ class InjectedCrash(BaseException):
         self.point = point
 
 
+# serving-side injection points (scheduler worker loops + device boundary),
+# in request-lifecycle order. Tests arm delays/errors/crashes at these.
+SERVE_POINTS = (
+    "sched.collect",       # top of a collector iteration (stall = queue
+                           # saturation; crash = killed collector thread)
+    "sched.dispatch",      # immediately before the fused device dispatch
+                           # (error = failing device path, feeds the breaker)
+    "sched.device_wait",   # before the batched readback blocks (delay =
+                           # slow device round, the overload-burst shape)
+    "sched.complete",      # top of a completer iteration (crash = killed
+                           # completer thread)
+    "sched.single",        # before a fallback single execution
+)
+
+
 _lock = threading.Lock()
 _active = False                      # fast-path gate (read without the lock)
 _armed: Dict[str, int] = {}          # point -> remaining hits before firing
 _torn_frac: float = 0.5              # fraction of the frame written when torn
 _fsync_errors = 0                    # pending injected fsync failures
 _hits: Dict[str, int] = {}           # observability: point -> times reached
+_serve_errors: Dict[str, int] = {}   # point -> remaining injected errors
+_serve_crash: Dict[str, int] = {}    # point -> hits until InjectedCrash
+_serve_delay: Dict[str, list] = {}   # point -> [remaining, seconds]
 
 
 def reset() -> None:
@@ -58,6 +85,9 @@ def reset() -> None:
     with _lock:
         _armed.clear()
         _hits.clear()
+        _serve_errors.clear()
+        _serve_crash.clear()
+        _serve_delay.clear()
         _fsync_errors = 0
         _active = False
 
@@ -143,3 +173,78 @@ def hits() -> Dict[str, int]:
     """Times each point was reached since the last reset (diagnostics)."""
     with _lock:
         return dict(_hits)
+
+
+# -- serving-side injections --------------------------------------------------
+
+
+def _check_serve_point(point: str) -> None:
+    if point not in SERVE_POINTS:
+        raise ValueError(f"unknown serve point {point!r} "
+                         f"(have {list(SERVE_POINTS)})")
+
+
+def arm_serve_error(point: str, n: int = 1) -> None:
+    """Make the next ``n`` hits of ``point`` raise RuntimeError — the
+    injected-dispatch-failure shape (retried by the retry wrapper, counted
+    by the circuit breaker)."""
+    global _active
+    _check_serve_point(point)
+    with _lock:
+        _serve_errors[point] = int(n)
+        _active = True
+
+
+def arm_serve_crash(point: str, at: int = 1) -> None:
+    """Raise InjectedCrash on the ``at``-th hit of ``point`` — a killed
+    scheduler worker thread (BaseException: the worker's ``except
+    Exception`` guards cannot swallow it; the thread-level handler must
+    fail all outstanding futures)."""
+    global _active
+    _check_serve_point(point)
+    with _lock:
+        _serve_crash[point] = int(at)
+        _active = True
+
+
+def arm_serve_delay(point: str, seconds: float, n: int = 1) -> None:
+    """Sleep ``seconds`` at the next ``n`` hits of ``point`` — slow device
+    rounds (``sched.device_wait``) or queue saturation (a stalled
+    collector, ``sched.collect``)."""
+    global _active
+    _check_serve_point(point)
+    with _lock:
+        _serve_delay[point] = [int(n), float(seconds)]
+        _active = True
+
+
+def serve_gate(point: str) -> None:
+    """Call-site hook on the serving path: applies any armed delay, then
+    any armed error or crash, in that order. Disarmed cost: one global
+    read + compare (the same zero-overhead contract as crash_point)."""
+    if not _active:
+        return
+    sleep_s = None
+    exc: Optional[BaseException] = None
+    with _lock:
+        _hits[point] = _hits.get(point, 0) + 1
+        d = _serve_delay.get(point)
+        if d is not None and d[0] > 0:
+            d[0] -= 1
+            sleep_s = d[1]
+        n = _serve_errors.get(point, 0)
+        if n > 0:
+            _serve_errors[point] = n - 1
+            exc = RuntimeError(f"injected serve error at {point!r}")
+        else:
+            c = _serve_crash.get(point)
+            if c is not None:
+                if c > 1:
+                    _serve_crash[point] = c - 1
+                else:
+                    del _serve_crash[point]
+                    exc = InjectedCrash(point)
+    if sleep_s:
+        _time.sleep(sleep_s)
+    if exc is not None:
+        raise exc

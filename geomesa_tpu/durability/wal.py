@@ -465,8 +465,19 @@ class WriteAheadLog:
                 written = self._written_bytes
             t0 = time.perf_counter()
             faults.crash_point("wal.fsync")
+            from geomesa_tpu import config
             from geomesa_tpu.durability.rotation import fsync_file
-            fsync_file(fh)
+            attempts = int(config.RETRY_WAL_FSYNC.get())
+            if attempts <= 1:
+                fsync_file(fh)
+            else:
+                # transient-EIO absorption behind the shared capped-backoff
+                # wrapper (GEOMESA_TPU_RETRY_WAL_FSYNC > 1 opts in; the
+                # default stays strict so 'always' surfaces the first
+                # failure to the writer that demanded durability)
+                from geomesa_tpu.serve.resilience.breaker import retry_call
+                retry_call(lambda: fsync_file(fh), attempts=attempts,
+                           counter="wal.fsync_retries")
             dt = time.perf_counter() - t0
         except OSError:
             _metrics.inc("wal.fsync_errors")

@@ -216,13 +216,22 @@ class AuditWriter:
 
 
 class Deadline:
-    """Cooperative deadline checked between pipeline stages."""
+    """Cooperative deadline checked between pipeline stages. Also honors
+    the ambient per-REQUEST deadline (serve/resilience/deadline.py) when
+    one is installed, so a web/API deadline propagates through planner
+    stages without threading a parameter through every call — whichever
+    of the two budgets lapses first wins."""
 
     def __init__(self, timeout_ms: Optional[float]):
         self.t0 = time.perf_counter()
         self.timeout_ms = timeout_ms
+        # lazy import: guards loads before/without the serve package
+        from geomesa_tpu.serve.resilience import deadline as _rdl
+        self._request = _rdl.current()
 
     def check(self, stage: str) -> None:
+        if self._request is not None:
+            self._request.check(stage)  # raises DeadlineExceeded
         if self.timeout_ms is None:
             return
         elapsed = (time.perf_counter() - self.t0) * 1000

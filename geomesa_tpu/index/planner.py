@@ -30,6 +30,7 @@ from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.index.api import IndexScanPlan, QueryResult, UnionScanPlan
 from geomesa_tpu.index import prune as _prune
+from geomesa_tpu.serve.resilience import deadline as _rdl
 
 _SELECT_CAP = 1 << 16
 # select-capacity tiers: each distinct capacity compiles its own packed
@@ -260,6 +261,10 @@ class QueryPlanner:
         if not config.PRUNE_ENABLED.get():
             return None
         if plan.blocks is False:
+            # per-request deadline checkpoint: the range decomposition is
+            # the priciest host stage before device dispatch — a request
+            # whose budget already lapsed must not start it
+            _rdl.check_current("range_decompose")
             blocks = None
             if (not plan.empty and plan.index is not None
                     and plan.candidate_slices is None
@@ -495,6 +500,7 @@ class QueryPlanner:
         predicates run batched (geom_batch) rather than per-feature."""
         if len(rows) == 0 or plan.residual_host is None:
             return rows
+        _rdl.check_current("refine")
         with _trace.span("refine", kind="refine", rows=len(rows)):
             mask = _evaluate_at(plan.residual_host, self.table, rows)
             return rows[mask]

@@ -246,6 +246,15 @@ class MetricsRegistry:
                 self._report(reporters, "timer", name, seconds)
         return out
 
+    def snapshot_prefixed(self, *prefixes: str) -> dict:
+        """``snapshot()`` filtered to names under the given prefixes — the
+        focused debug surfaces (CLI ``debug admission``/``debug scheduler``,
+        web overload state) without the whole registry."""
+        snap = self.snapshot()
+        return {section: {k: v for k, v in values.items()
+                          if k.startswith(prefixes)}
+                for section, values in snap.items()}
+
     def to_prometheus(self) -> str:
         """Prometheus text exposition: counters as *_total, timers as
         summaries with p50/p90/p99 quantiles, gauges as gauges. Never emits

@@ -1,6 +1,12 @@
-"""Serving-path runtime: the adaptive micro-batching query scheduler and its
-plan/cover caches (≙ the amortize-per-query-cost discipline of the reference's
-server-side scans, applied to concurrent request traffic)."""
+"""Serving-path runtime: the adaptive micro-batching query scheduler, its
+plan/cover caches (≙ the amortize-per-query-cost discipline of the
+reference's server-side scans, applied to concurrent request traffic), and
+the query-lifecycle resilience layer (deadlines, admission control, circuit
+breaking, graceful degradation — serve/resilience/)."""
 
+from geomesa_tpu.serve.resilience import (ApproximateCount,  # noqa: F401
+                                          CircuitOpenError, Deadline,
+                                          DeadlineExceeded, ShedError)
 from geomesa_tpu.serve.scheduler import (PlannerBinding,  # noqa: F401
-                                         QueryScheduler, StoreBinding)
+                                         QueryScheduler, SchedulerCrashed,
+                                         SchedulerShutdown, StoreBinding)

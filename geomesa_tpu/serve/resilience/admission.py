@@ -1,0 +1,124 @@
+"""Priority-aware admission control with load shedding.
+
+≙ the overload-control discipline of Zhou et al., *Overload Control for
+Scaling WeChat Microservices* (SoCC 2018): requests are classed by business
+priority at the entry point and an overloaded server rejects excess work
+EARLY — a bounded amount of in-flight work per class, shed-with-backpressure
+(HTTP 429 + Retry-After) past the bound — instead of queueing until every
+admitted request misses its deadline (queueing collapse).
+
+Two classes:
+
+  interactive   dashboard/map-tile style point queries; the class whose
+                tail latency the system protects. Served first by the
+                scheduler's priority queue.
+  batch         analytics / bulk scans; bounded lower so background load
+                can never starve interactive traffic.
+
+Accounting is in-flight based (admitted minus completed, counted via a
+future done-callback), so the bound covers queued AND executing work — the
+quantity that actually determines how long a newly admitted request waits.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+PRIORITIES = ("interactive", "batch")
+
+
+def normalize_priority(p) -> str:
+    """Canonical priority class for a request parameter; unknown values
+    fall back to interactive (a typo must not silently deprioritize)."""
+    p = str(p or "interactive").lower()
+    if p in ("batch", "analytics", "background", "bulk"):
+        return "batch"
+    return "interactive"
+
+
+class ShedError(Exception):
+    """The request was rejected by admission control (→ HTTP 429). Carries
+    the Retry-After the client should honor."""
+
+    def __init__(self, priority: str, in_flight: int, limit: int,
+                 retry_after_s: float):
+        super().__init__(
+            f"overloaded: {in_flight}/{limit} {priority} queries in flight; "
+            f"retry after {retry_after_s:g}s")
+        self.priority = priority
+        self.in_flight = in_flight
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Bounded in-flight work per priority class; excess sheds."""
+
+    def __init__(self, interactive_limit=None, batch_limit=None):
+        self._lock = threading.Lock()
+        self._limits_override = {"interactive": interactive_limit,
+                                 "batch": batch_limit}
+        self._in_flight: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self._admitted: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self._shed: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        _metrics.set_gauge("admission.in_flight.interactive",
+                           lambda: self._in_flight["interactive"])
+        _metrics.set_gauge("admission.in_flight.batch",
+                           lambda: self._in_flight["batch"])
+
+    def _limit(self, priority: str) -> int:
+        ov = self._limits_override.get(priority)
+        if ov is not None:
+            return int(ov)
+        prop = config.ADMIT_INTERACTIVE if priority == "interactive" \
+            else config.ADMIT_BATCH
+        return int(prop.get())
+
+    def admit(self, priority: str) -> str:
+        """Admit one request of ``priority`` (returns the normalized class)
+        or raise ShedError. The caller MUST pair a successful admit with
+        exactly one ``release`` (the scheduler wires it to the request
+        future's done-callback, covering every resolution path)."""
+        p = normalize_priority(priority)
+        if not config.ADMIT_ENABLED.get():
+            with self._lock:
+                self._in_flight[p] += 1
+                self._admitted[p] += 1
+            _metrics.inc("admission.admitted")
+            return p
+        limit = self._limit(p)
+        with self._lock:
+            n = self._in_flight[p]
+            if n >= limit:
+                self._shed[p] += 1
+            else:
+                self._in_flight[p] = n + 1
+                self._admitted[p] += 1
+                n = -1
+        if n >= 0:
+            _metrics.inc("admission.shed")
+            _metrics.inc(f"admission.shed.{p}")
+            raise ShedError(p, n, limit,
+                            float(config.ADMIT_RETRY_AFTER_S.get()))
+        _metrics.inc("admission.admitted")
+        return p
+
+    def release(self, priority: str) -> None:
+        with self._lock:
+            self._in_flight[priority] = max(
+                0, self._in_flight[priority] - 1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": bool(config.ADMIT_ENABLED.get()),
+                "in_flight": dict(self._in_flight),
+                "limits": {p: self._limit(p) for p in PRIORITIES},
+                "admitted": dict(self._admitted),
+                "shed": dict(self._shed),
+                "retry_after_s": float(config.ADMIT_RETRY_AFTER_S.get()),
+            }

@@ -40,28 +40,70 @@ future; one collector thread owns batching/planning/dispatch, one completer
 thread owns device readbacks + host fallbacks. Requests capture a consistent
 (planner, delta, generation) snapshot at submit time, so a mid-flush mutation
 never pairs a pre-flush plan with post-flush state.
+
+Resilience (serve/resilience/): every request may carry a Deadline —
+checked when its batch reaches dispatch, so a request that timed out in the
+queue is cancelled BEFORE it costs a device round trip; admission control
+bounds in-flight work per priority class (interactive requests dequeue
+first) and sheds the excess; device dispatch runs behind a circuit breaker
++ capped-jittered retry; a request with (almost) no budget left — or any
+eligible count while the breaker is open — degrades to the stats estimator
+and resolves with a flagged ApproximateCount. Worker loops are crash-safe:
+an unexpected worker death (or shutdown with work still queued) fails every
+outstanding future with a structured SchedulerCrashed/SchedulerShutdown
+error instead of leaving callers blocked forever.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 from collections import OrderedDict
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 from geomesa_tpu import config
 from geomesa_tpu import trace as _trace
+from geomesa_tpu.durability import faults as _faults
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.serve.resilience import deadline as _rdl
+from geomesa_tpu.serve.resilience import degrade as _degrade
+from geomesa_tpu.serve.resilience.admission import (AdmissionController,
+                                                    normalize_priority)
+from geomesa_tpu.serve.resilience.breaker import CircuitBreaker, retry_call
+from geomesa_tpu.serve.resilience.deadline import Deadline, DeadlineExceeded
 
 _pc = time.perf_counter
 _MISS = object()
 _STOP = object()
+
+# priority-queue ranks: interactive dequeues before batch; _STOP ranks last
+# so a graceful shutdown serves already-queued work first
+_RANKS = {"interactive": 0, "batch": 1}
+_STOP_RANK = 9
+
+
+class SchedulerCrashed(RuntimeError):
+    """A scheduler worker thread died unexpectedly; the outstanding request
+    was failed (structured, promptly) rather than left to hang. ``worker``
+    names the thread; ``cause`` is the error that killed it."""
+
+    def __init__(self, worker: str, cause: BaseException):
+        super().__init__(
+            f"scheduler {worker} thread died ({cause!r}); "
+            f"outstanding requests failed")
+        self.worker = worker
+        self.cause = cause
+
+
+class SchedulerShutdown(RuntimeError):
+    """The scheduler was shut down with this request still unresolved."""
 
 
 # -- caches -------------------------------------------------------------------
@@ -157,15 +199,20 @@ class PlannerBinding:
 
 class Request:
     """One in-flight scheduled query. ``result()`` blocks for the count;
-    the timing fields feed the caller's trace after resolution."""
+    the timing fields feed the caller's trace after resolution.
+    ``deadline``/``priority`` are the resilience envelope; ``cancelled`` /
+    ``degraded`` say how the request resolved off the exact path."""
 
     __slots__ = ("type_name", "f_ir", "f_key", "auths", "auths_key",
                  "planner", "delta", "generation", "epoch", "future",
                  "t_submit", "plan", "queue_wait_s", "plan_s", "scan_s",
-                 "batched", "batch_size")
+                 "batched", "batch_size", "deadline", "priority",
+                 "cancelled", "degraded")
 
     def __init__(self, type_name, f_ir, f_key, auths, auths_key,
-                 planner, delta, generation, epoch):
+                 planner, delta, generation, epoch,
+                 deadline: Optional[Deadline] = None,
+                 priority: str = "interactive"):
         self.type_name = type_name
         self.f_ir = f_ir
         self.f_key = f_key
@@ -183,6 +230,10 @@ class Request:
         self.scan_s: Optional[float] = None
         self.batched = False
         self.batch_size = 1
+        self.deadline = deadline
+        self.priority = priority
+        self.cancelled = False
+        self.degraded = False
 
     def result(self, timeout: Optional[float] = None) -> int:
         return self.future.result(timeout=timeout)
@@ -222,8 +273,19 @@ class QueryScheduler:
         cap_c = config.SCHED_COVER_CACHE.get() if cover_cache is None else cover_cache
         self.plans = LruCache(cap_p, "scheduler.plan_cache")
         self.covers = LruCache(cap_c, "scheduler.cover_cache")
-        self._queue: "queue.Queue" = queue.Queue()
+        # priority queue: (rank, seq, request) — interactive before batch,
+        # FIFO within a class, _STOP after all queued work
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._seq = itertools.count()
         self._done: "queue.Queue" = queue.Queue()
+        # resilience: admission bounds + device-dispatch breaker + the
+        # registry of every unresolved request (failed en masse if a worker
+        # dies or shutdown leaves work behind)
+        self.admission = AdmissionController()
+        self.breaker = CircuitBreaker("device_dispatch")
+        self._outstanding: set = set()
+        self._out_lock = threading.Lock()
+        self._crash_error: Optional[SchedulerCrashed] = None
         # collector-thread-only tallies (read-only elsewhere)
         self._batch_hist: Dict[int, int] = {}
         self._flush_reasons: Dict[str, int] = {"size": 0, "window": 0}
@@ -243,59 +305,173 @@ class QueryScheduler:
             tiers.append(b)
         warm_transfer_shapes(batch_sizes=tiers or [1])
         self._collector = threading.Thread(
-            target=self._collect_loop, name="geomesa-sched-collect", daemon=True)
+            target=self._worker_main, args=("collector", self._collect_loop),
+            name="geomesa-sched-collect", daemon=True)
         self._completer = threading.Thread(
-            target=self._complete_loop, name="geomesa-sched-complete", daemon=True)
+            target=self._worker_main, args=("completer", self._complete_loop),
+            name="geomesa-sched-complete", daemon=True)
         self._collector.start()
         self._completer.start()
 
     # -- public API ---------------------------------------------------------
 
     def submit(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
-               auths: Optional[list] = None) -> Request:
+               auths: Optional[list] = None,
+               deadline: Optional[Deadline] = None,
+               deadline_ms: Optional[float] = None,
+               priority: str = "interactive") -> Request:
         """Enqueue one count; returns a Request whose ``result()`` blocks.
-        Parse errors raise here (before anything queues)."""
+        Parse errors and admission sheds (ShedError) raise here, before
+        anything queues. The effective deadline is the sooner of the
+        explicit one and any ambient request deadline."""
         if not self._running:
             raise RuntimeError("scheduler is shut down")
         f_ir = parse_ecql(f) if isinstance(f, str) else f
         auths_key = None if auths is None \
             else tuple(sorted(str(a) for a in auths))
         planner, delta, gen, epoch = self.binding.snapshot(type_name)
+        dl = _rdl.resolve(deadline, deadline_ms)
         req = Request(type_name, f_ir, repr(f_ir), auths, auths_key,
-                      planner, delta, gen, epoch)
+                      planner, delta, gen, epoch, deadline=dl,
+                      priority=normalize_priority(priority))
         _metrics.inc("scheduler.queries")
-        self._queue.put(req)
+        if dl is not None:
+            _metrics.observe_value("deadline.remaining_ms",
+                                   max(0.0, dl.remaining_ms()))
+            if dl.expired:
+                # dead on arrival: fail before admission/queue/dispatch
+                # spend anything on it (Tail-at-Scale rule: never do work
+                # whose result cannot be delivered in time)
+                self._cancel(req, "submit")
+                return req
+        # retry_after_s > 0 means the breaker is open AND still cooling
+        # down (probe-free check: allow() would consume a half-open slot)
+        if self.breaker.retry_after_s() > 0 and config.BREAKER_DEGRADE.get():
+            approx = _degrade.estimate(planner, f_ir, "breaker_open")
+            if approx is not None:
+                req.degraded = True
+                _metrics.inc("scheduler.degraded")
+                req.future.set_result(approx)
+                return req
+        cls = self.admission.admit(req.priority)  # raises ShedError to shed
+        self._track(req, cls)
+        self._queue.put((_RANKS[cls], next(self._seq), req))
         return req
 
     def count(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
               auths: Optional[list] = None,
-              timeout: Optional[float] = None) -> int:
+              timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None,
+              priority: str = "interactive") -> int:
         """Blocking scheduled count. The caller's trace receives queue_wait
         / plan / scan leaves — a plan-cache hit shows NO plan span."""
         with _trace.trace("query.count", type=type_name, filter=str(f),
                           scheduled=True):
-            req = self.submit(type_name, f, auths)
+            req = self.submit(type_name, f, auths, deadline_ms=deadline_ms,
+                              priority=priority)
             return self._finish(req, timeout)
 
     def count_many(self, type_name: str, filters, auths: Optional[list] = None,
-                   timeout: Optional[float] = None) -> List[int]:
+                   timeout: Optional[float] = None,
+                   deadline_ms: Optional[float] = None,
+                   priority: str = "interactive") -> List[int]:
         """Counts for many filters, submitted together so they coalesce into
         fused dispatches. Order-preserving."""
         with _trace.trace("query.count_many", type=type_name,
                           n=len(filters), scheduled=True):
-            reqs = [self.submit(type_name, f, auths) for f in filters]
+            reqs = [self.submit(type_name, f, auths, deadline_ms=deadline_ms,
+                                priority=priority) for f in filters]
             return [self._finish(r, timeout) for r in reqs]
 
     def _finish(self, req: Request, timeout: Optional[float]) -> int:
-        n = req.future.result(timeout=timeout)
-        if _trace.enabled():
-            if req.queue_wait_s is not None:
-                _trace.record("queue_wait", "queue_wait", req.queue_wait_s)
-            if req.plan_s is not None:
-                _trace.record("plan", "plan", req.plan_s)
-            if req.scan_s is not None:
-                _trace.record("scan", "scan", req.scan_s)
-        return n
+        try:
+            return req.future.result(timeout=timeout)
+        finally:
+            if _trace.enabled():
+                if req.queue_wait_s is not None:
+                    _trace.record("queue_wait", "queue_wait",
+                                  req.queue_wait_s)
+                if req.plan_s is not None:
+                    _trace.record("plan", "plan", req.plan_s)
+                if req.scan_s is not None:
+                    _trace.record("scan", "scan", req.scan_s)
+                if req.cancelled:
+                    # the trace-visible proof a timed-out query was dropped
+                    # WITHOUT a device round trip: a cancel leaf and no scan
+                    _trace.record("cancel", "cancel", 0.0)
+                if req.degraded:
+                    _trace.record("degrade", "degrade", 0.0)
+
+    # -- resilience plumbing -------------------------------------------------
+
+    def _track(self, req: Request, cls: str) -> None:
+        """Register an admitted request as outstanding; the future's done
+        callback (fires on every resolution path) releases its admission
+        slot and drops it from the registry."""
+        with self._out_lock:
+            self._outstanding.add(req)
+
+        def _done(_f, req=req, cls=cls):
+            self.admission.release(cls)
+            with self._out_lock:
+                self._outstanding.discard(req)
+
+        req.future.add_done_callback(_done)
+
+    @staticmethod
+    def _resolve(req: Request, value) -> None:
+        try:
+            req.future.set_result(value)
+        except InvalidStateError:
+            pass  # already failed by a crash/shutdown sweep — that wins
+
+    @staticmethod
+    def _fail(req: Request, exc: BaseException) -> None:
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+    def _cancel(self, req: Request, stage: str) -> None:
+        req.cancelled = True
+        _metrics.inc("scheduler.deadline_cancelled")
+        overrun = -req.deadline.remaining_ms() if req.deadline else 0.0
+        _metrics.observe_value("deadline.overrun_ms", max(0.0, overrun))
+        self._fail(req, DeadlineExceeded(stage, max(0.0, overrun)))
+
+    def _fail_outstanding(self, exc: BaseException) -> None:
+        """Resolve EVERY unresolved future with ``exc`` — queued, batched,
+        or in flight. Callers blocked in result() unblock promptly."""
+        with self._out_lock:
+            pending = list(self._outstanding)
+        for r in pending:
+            if not r.future.done():
+                self._fail(r, exc)
+
+    def _worker_main(self, which: str, loop) -> None:
+        """Thread wrapper: an escaping error (InjectedCrash is a
+        BaseException no inner guard may swallow) marks the scheduler
+        crashed and fails all outstanding futures instead of silently
+        stranding them."""
+        try:
+            loop()
+        except BaseException as e:  # worker death — by injection or bug
+            err = SchedulerCrashed(which, e)
+            self._crash_error = err
+            self._running = False
+            _metrics.inc("scheduler.worker_deaths")
+            self._fail_outstanding(err)
+            # unblock the surviving worker so it can exit
+            if which == "collector":
+                self._done.put(_STOP)
+            else:
+                self._queue.put((_STOP_RANK, next(self._seq), _STOP))
+
+    def healthy(self) -> bool:
+        """True while both workers are alive and accepting work (the store
+        replaces an unhealthy scheduler on next access)."""
+        return (self._running and self._collector.is_alive()
+                and self._completer.is_alive())
 
     def stats(self) -> dict:
         """Live scheduler state for the debug surfaces (CLI / web)."""
@@ -314,22 +490,37 @@ class QueryScheduler:
                                 sorted(self._batch_hist.items())},
             "plan_cache": self.plans.stats(),
             "cover_cache": self.covers.stats(),
+            "healthy": self.healthy(),
+            "admission": self.admission.stats(),
+            "breaker": self.breaker.stats(),
         }
 
-    def shutdown(self) -> None:
-        """Stop both threads (outstanding requests complete first)."""
-        if not self._running:
-            return
-        self._running = False
-        self._queue.put(_STOP)
-        self._collector.join(timeout=5)
-        self._completer.join(timeout=5)
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop both threads. Graceful first: already-queued requests are
+        served before the stop sentinel (it ranks last in the priority
+        queue). Then ANY still-unresolved future — a died worker, a wedged
+        device round, work the join timeout abandoned — is failed with a
+        structured SchedulerShutdown, so no caller blocked in ``result()``
+        ever hangs past shutdown. Idempotent."""
+        if self._running:
+            self._running = False
+            self._queue.put((_STOP_RANK, next(self._seq), _STOP))
+        self._collector.join(timeout=timeout)
+        if self._completer.is_alive() and not self._collector.is_alive():
+            # collector died/stalled without forwarding the sentinel
+            self._done.put(_STOP)
+        self._completer.join(timeout=timeout)
+        self._fail_outstanding(
+            self._crash_error
+            or SchedulerShutdown("scheduler shut down with this request "
+                                 "unresolved"))
 
     # -- collector thread ---------------------------------------------------
 
     def _collect_loop(self) -> None:
         while True:
-            req = self._queue.get()
+            _, _, req = self._queue.get()
+            _faults.serve_gate("sched.collect")
             if req is _STOP:
                 self._done.put(_STOP)
                 return
@@ -345,7 +536,7 @@ class QueryScheduler:
                     # window must not fragment into the next one
                     try:
                         while len(batch) < self._flush_size:
-                            nxt = self._queue.get_nowait()
+                            _, _, nxt = self._queue.get_nowait()
                             if nxt is _STOP:
                                 stop = True
                                 break
@@ -354,7 +545,7 @@ class QueryScheduler:
                         pass
                     break
                 try:
-                    nxt = self._queue.get(timeout=remaining)
+                    _, _, nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
                 if nxt is _STOP:
@@ -368,8 +559,7 @@ class QueryScheduler:
                 self._dispatch(batch)
             except Exception as e:  # never kill the loop: fail the batch
                 for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                    self._fail(r, e)
             if stop:
                 self._done.put(_STOP)
                 return
@@ -433,12 +623,29 @@ class QueryScheduler:
         from geomesa_tpu.index.scan import PRIMARY_FNS
 
         groups: Dict[tuple, List[Request]] = {}
+        degrade_floor = config.DEADLINE_DEGRADE_MS.get()
         for r in batch:
             r.queue_wait_s = _pc() - r.t_submit
+            if r.deadline is not None:
+                rem = r.deadline.remaining_ms()
+                if rem < 0:
+                    # timed out while queued: cancelled HERE, before any
+                    # plan/device work is spent on it
+                    self._cancel(r, "dispatch")
+                    continue
+                if degrade_floor and rem < degrade_floor:
+                    # not enough budget for a device round trip — serve
+                    # the flagged estimator answer instead (when eligible)
+                    approx = _degrade.estimate(r.planner, r.f_ir, "deadline")
+                    if approx is not None:
+                        r.degraded = True
+                        _metrics.inc("scheduler.degraded")
+                        self._resolve(r, approx)
+                        continue
             try:
                 self._plan_request(r)
             except Exception as e:  # parse/guard/plan errors fail one query
-                r.future.set_exception(e)
+                self._fail(r, e)
                 continue
             plan = r.plan
             if (plan.device_exact and plan.primary_kind in PRIMARY_FNS
@@ -469,8 +676,7 @@ class QueryScheduler:
                 self._dispatch_group(grp, pruned=gkey[-1])
             except Exception as e:
                 for r in grp:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                    self._fail(r, e)
 
     def _dispatch_group(self, grp: List[Request], pruned: bool) -> None:
         """ONE async fused dispatch for a compatible group: per-query boxes
@@ -495,8 +701,17 @@ class QueryScheduler:
         else:
             disp = kern.prepare_counts_multi(
                 lead.primary_kind, boxes, lead.windows, lead.residual_device)
+
+        def _launch():
+            _faults.serve_gate("sched.dispatch")
+            return disp()  # async: enqueue only; the completer blocks for it
+
         t0 = _pc()
-        out = disp()  # async: enqueue only; the completer blocks for it
+        # the device boundary runs behind the breaker + capped-jitter
+        # retries: transient dispatch failures retry (and count), a sick
+        # device path opens the breaker and subsequent traffic fails fast
+        # or degrades instead of piling on
+        out = retry_call(_launch, breaker=self.breaker)
         self._done.put(("batch", out, grp, t0))
 
     # -- completer thread ---------------------------------------------------
@@ -506,6 +721,7 @@ class QueryScheduler:
             item = self._done.get()
             if item is _STOP:
                 return
+            _faults.serve_gate("sched.complete")
             try:
                 if item[0] == "batch":
                     self._complete_batch(item[1], item[2], item[3])
@@ -514,37 +730,56 @@ class QueryScheduler:
             except Exception as e:
                 reqs = item[2] if item[0] == "batch" else [item[1]]
                 for r in reqs:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                    self._fail(r, e)
 
     def _complete_batch(self, out, grp: List[Request], t0: float) -> None:
         # host-side LSM-delta counts first: they overlap the in-flight
         # device round trip instead of adding to it
         extras = [len(self.binding.delta_rows(r.delta, r.f_ir, r.auths))
                   if r.delta is not None else 0 for r in grp]
-        counts = np.asarray(out)  # blocks until the device batch is ready
+        _faults.serve_gate("sched.device_wait")
+        try:
+            counts = np.asarray(out)  # blocks until the device batch is ready
+        except Exception:
+            # a readback failure is a device-path failure too (the dispatch
+            # already consumed its retries; the breaker learns either way)
+            self.breaker.record_failure()
+            raise
         scan_s = _pc() - t0
         for i, r in enumerate(grp):
             r.batched = True
             r.batch_size = len(grp)
             r.scan_s = scan_s
-            r.future.set_result(int(counts[i]) + extras[i])
+            self._resolve(r, int(counts[i]) + extras[i])
 
     def _complete_single(self, r: Request) -> None:
         """Fallback execution for plans the fused kernel can't serve (host
         residuals, unions, fid lookups, multi-box primaries, attribute
         slices, empty plans). Runs planner._count with the cached plan — the
-        plan/auths work is still amortized even off the fused path."""
+        plan/auths work is still amortized even off the fused path. The
+        request's deadline rides along as the ambient deadline, so the
+        planner's range-decompose/refine checkpoints fire for it too."""
+        if r.deadline is not None and r.deadline.expired:
+            self._cancel(r, "single")
+            return
         t0 = _pc()
         try:
-            if r.plan.empty:
-                n = 0
-            else:  # _count handles empty covers, unions, fids, residuals
-                n = r.planner._count(r.plan, r.f_ir, r.auths)
-            if r.delta is not None:
-                n += len(self.binding.delta_rows(r.delta, r.f_ir, r.auths))
+            _faults.serve_gate("sched.single")
+            with _rdl.use(r.deadline):
+                if r.plan.empty:
+                    n = 0
+                else:  # _count handles empty covers, unions, fids, residuals
+                    n = r.planner._count(r.plan, r.f_ir, r.auths)
+                if r.delta is not None:
+                    n += len(self.binding.delta_rows(r.delta, r.f_ir,
+                                                     r.auths))
+        except DeadlineExceeded as e:
+            r.cancelled = True
+            _metrics.inc("scheduler.deadline_cancelled")
+            self._fail(r, e)
+            return
         except Exception as e:
-            r.future.set_exception(e)
+            self._fail(r, e)
             return
         r.scan_s = _pc() - t0
-        r.future.set_result(int(n))
+        self._resolve(r, int(n))

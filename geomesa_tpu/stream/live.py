@@ -286,14 +286,19 @@ class LambdaDataStore:
         lock hold, one WAL record) — re-running it after a crash at ANY
         point converges instead of losing or double-counting rows, because
         until the hot fids are dropped they shadow their cold copies on
-        every read. Returns rows flushed."""
+        every read. BECAUSE the upsert is idempotent it is also safe to
+        retry, so transient cold-store failures (a WAL fsync hiccup under
+        'always') ride the shared capped-backoff retry wrapper instead of
+        stranding rows in the hot tier. Returns rows flushed."""
         table = self.live.table()
         if table is None:
             return 0
         fids = [str(f) for f in table.fids]
         if self.journal is not None:
             self.journal.append_json("persist_begin", {"fids": fids})
-        self.cold.upsert(self.type_name, table)
+        from geomesa_tpu.serve.resilience.breaker import retry_call
+        retry_call(lambda: self.cold.upsert(self.type_name, table),
+                   counter="stream.persist_retries")
         self._drop_hot(fids)
         if self.journal is not None:
             self.journal.append_json("persist_commit", {"n": len(fids)})
@@ -318,10 +323,21 @@ class LambdaDataStore:
 
     # -- merged reads --------------------------------------------------------
 
-    def count(self, f: Union[str, ir.Filter] = "INCLUDE") -> int:
-        return len(self.query_indices(f)[0]) + self.live.count(f)
+    def count(self, f: Union[str, ir.Filter] = "INCLUDE",
+              deadline_ms: Optional[float] = None) -> int:
+        """Merged hot+cold count; ``deadline_ms`` installs a per-request
+        deadline that the cold planner's checkpoints honor."""
+        from geomesa_tpu.serve.resilience import deadline as _rdl
+        with _rdl.scope(deadline_ms):
+            return len(self.query_indices(f)[0]) + self.live.count(f)
 
-    def query(self, f: Union[str, ir.Filter] = "INCLUDE") -> FeatureTable:
+    def query(self, f: Union[str, ir.Filter] = "INCLUDE",
+              deadline_ms: Optional[float] = None) -> FeatureTable:
+        from geomesa_tpu.serve.resilience import deadline as _rdl
+        with _rdl.scope(deadline_ms):
+            return self._query_impl(f)
+
+    def _query_impl(self, f) -> FeatureTable:
         rows, planner = self.query_indices(f)
         cold_part = planner.table.take(rows) if planner is not None else None
         hot_part = self.live.query(f)

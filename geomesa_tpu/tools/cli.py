@@ -12,7 +12,7 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|scheduler|wal [--format prometheus] [-s STORE -f NAME -q ECQL]
+    geomesa-tpu debug         metrics|traces|scheduler|admission|wal [--format prometheus] [-s STORE -f NAME -q ECQL]
     geomesa-tpu recover       --dir DURABILITY_DIR
     geomesa-tpu describe / list / remove-schema
 """
@@ -255,6 +255,22 @@ def cmd_debug(args):
             sys.stdout.write(REGISTRY.to_prometheus())
         else:
             print(json.dumps(REGISTRY.snapshot(), indent=2, default=str))
+    elif args.what == "admission":
+        # the overload runbook surface: live queue depths per priority
+        # class, shed/retry/breaker counters, deadline histograms
+        out = {}
+        if store is not None:
+            sched = store.scheduler()
+            out["admission"] = sched.admission.stats()
+            out["breaker"] = sched.breaker.stats()
+            out["queue_depth"] = sched._queue.qsize()
+            out["healthy"] = sched.healthy()
+        snap = REGISTRY.snapshot_prefixed(
+            "admission.", "breaker.", "retry.", "degrade.",
+            "scheduler.deadline", "scheduler.degraded",
+            "scheduler.worker_deaths", "scheduler.restarts", "deadline.")
+        out["metrics"] = {k: v for k, v in snap.items() if v}
+        print(json.dumps(out, indent=2, default=str))
     elif args.what == "scheduler":
         out = {}
         if store is not None:
@@ -392,8 +408,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser(
         "debug", help="dump metrics, recent query traces, scheduler state, "
-                      "or the WAL segment inspector")
-    sp.add_argument("what", choices=("metrics", "traces", "scheduler", "wal"))
+                      "admission/overload state, or the WAL segment "
+                      "inspector")
+    sp.add_argument("what", choices=("metrics", "traces", "scheduler",
+                                     "admission", "wal"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query")
     sp.add_argument("-q", "--cql", help="ECQL filter for the warm query")

@@ -58,7 +58,12 @@ from geomesa_tpu.metrics import REGISTRY as _REGISTRY
 
 SPAN_KINDS = ("plan", "range_decompose", "queue_wait", "scan", "device_scan",
               "device_wait", "refine", "aggregate", "serialize",
-              "wal_append", "wal_fsync", "recovery")
+              "wal_append", "wal_fsync", "recovery",
+              # query-lifecycle resilience (serve/resilience/): a request
+              # cancelled at its deadline BEFORE device dispatch, a count
+              # degraded to the stats estimator, a request shed by admission
+              # control — the overload test asserts on these leaves
+              "cancel", "degrade", "shed")
 
 _pc = time.perf_counter  # cached: spans sit on µs-scale hot paths
 

@@ -7,6 +7,17 @@ GeoMesaStatsEndpoint.scala) and the pure-JSON API of geomesa-geojson
 dependency; the handler core (`GeoJsonApi.handle`) is transport-agnostic so
 it can mount under any WSGI/ASGI shim.
 
+Resilience envelope (serve/resilience/): every request may carry
+``?deadline_ms=``/``X-Deadline-Ms`` (default/cap from
+GEOMESA_TPU_DEADLINE_*) and ``?priority=``/``X-Priority``
+(interactive | batch). Errors come back as a structured JSON envelope
+``{"error": ..., "kind": ...}`` with a correct status: deadline-exceeded →
+504, admission shed → 429 (+ Retry-After), breaker open → 503
+(+ Retry-After), guard veto / bad input → 400, unexpected → 500 — and a
+handler thread can no longer die (resetting the client connection) on an
+exception anywhere in routing. Degraded counts are flagged:
+``{"count": n, "approximate": true, "reason": ...}``.
+
 Routes:
   GET  /types                          → type names
   GET  /types/{t}                      → schema + row count
@@ -47,15 +58,71 @@ class GeoJsonApi:
     def __init__(self, store):
         self.store = store
 
-    # returns (status, payload) — dict for JSON, str for raw text bodies
-    def handle(self, method: str, path: str, query: dict,
-               body: Optional[bytes] = None) -> Tuple[int, object]:
+    @staticmethod
+    def _request_deadline(query: dict, headers) -> Optional[object]:
+        """Per-request Deadline from ?deadline_ms= / X-Deadline-Ms, falling
+        back to the configured default, capped at the configured max.
+        None when unconstrained."""
+        from geomesa_tpu import config
+        from geomesa_tpu.serve.resilience.deadline import Deadline
+        raw = query.get("deadline_ms", [None])[0]
+        if raw is None and headers is not None:
+            raw = headers.get("X-Deadline-Ms")
         try:
-            return self._route(method, path, query, body)
-        except Exception as e:  # surface planner/parser/data errors as 400s
-            return 400, {"error": str(e)}
+            ms = float(raw) if raw is not None else 0.0
+        except (TypeError, ValueError):
+            ms = 0.0
+        if ms <= 0:
+            ms = float(config.DEADLINE_DEFAULT_MS.get())
+        if ms <= 0:
+            return None
+        return Deadline.after_ms(min(ms, float(config.DEADLINE_MAX_MS.get())))
 
-    def _route(self, method, path, query, body):
+    @staticmethod
+    def _request_priority(query: dict, headers) -> str:
+        from geomesa_tpu.serve.resilience.admission import normalize_priority
+        raw = query.get("priority", [None])[0]
+        if raw is None and headers is not None:
+            raw = headers.get("X-Priority")
+        return normalize_priority(raw)
+
+    # returns (status, payload) — dict for JSON, str for raw text bodies.
+    # A 429/503 payload carries retry_after_s; the transport turns it into
+    # a Retry-After header.
+    def handle(self, method: str, path: str, query: dict,
+               body: Optional[bytes] = None,
+               headers=None) -> Tuple[int, object]:
+        from geomesa_tpu import trace as _trace
+        from geomesa_tpu.index.guards import QueryGuardError, QueryTimeout
+        from geomesa_tpu.serve.resilience import deadline as _rdl
+        from geomesa_tpu.serve.resilience.breaker import CircuitOpenError
+        from geomesa_tpu.serve.resilience.admission import ShedError
+        try:
+            with _rdl.use(self._request_deadline(query, headers)):
+                return self._route(method, path, query, body,
+                                   headers=headers)
+        except ShedError as e:        # admission control shed this request
+            if _trace.enabled():
+                _trace.record("shed", "shed", 0.0)
+            return 429, {"error": str(e), "kind": "shed",
+                         "priority": e.priority,
+                         "retry_after_s": e.retry_after_s}
+        except CircuitOpenError as e:  # failing fast on a sick device path
+            return 503, {"error": str(e), "kind": "breaker_open",
+                         "retry_after_s": e.retry_after_s}
+        except QueryTimeout as e:     # deadline exceeded / planner timeout
+            return 504, {"error": str(e), "kind": "deadline"}
+        except QueryGuardError as e:  # an interceptor vetoed the query
+            return 400, {"error": str(e), "kind": "guard"}
+        except (KeyError, ValueError, TypeError, IndexError,
+                json.JSONDecodeError) as e:
+            # planner/parser/data errors stay 400s (client-fixable input)
+            return 400, {"error": str(e), "kind": "bad_request"}
+        except Exception as e:        # anything else is OUR fault: 500,
+            return 500, {"error": str(e), "kind": "internal",
+                         "type": type(e).__name__}
+
+    def _route(self, method, path, query, body, headers=None):
         parts = [p for p in path.split("/") if p]
         if parts == ["types"]:
             return 200, {"types": self.store.get_type_names()}
@@ -80,9 +147,21 @@ class GeoJsonApi:
             import jax
             report = getattr(self.store, "recovery_report", None)
             d = getattr(self.store, "durability", None)
+            # overload state reads the LIVE scheduler only — a health probe
+            # must never be the thing that spins one up
+            sched = getattr(self.store, "_scheduler", None)
+            if sched is None:
+                overload = {"scheduler": "idle"}
+            else:
+                overload = {"scheduler": "ok" if sched.healthy()
+                            else "unhealthy",
+                            "queue_depth": sched._queue.qsize(),
+                            "admission": sched.admission.stats(),
+                            "breaker": sched.breaker.stats()}
             return 200, {"status": "ok",
                          "devices": len(jax.local_devices()),
                          "types": len(self.store.get_type_names()),
+                         "overload": overload,
                          "durability": {
                              "enabled": d is not None,
                              "wal_policy": d.wal.policy if d else None,
@@ -122,9 +201,18 @@ class GeoJsonApi:
                              "count": count}
             if rest == ["count"]:
                 # coalesced: concurrent counts micro-batch into shared
-                # fused device dispatches (serve/scheduler.py)
-                return 200, {"count": self.store.count_coalesced(
-                    t, cql, auths=auths)}
+                # fused device dispatches (serve/scheduler.py); the ambient
+                # request deadline propagates through the scheduler and an
+                # overload/breaker condition may degrade the answer to the
+                # flagged stats estimate
+                n = self.store.count_coalesced(
+                    t, cql, auths=auths,
+                    priority=self._request_priority(query, headers))
+                out = {"count": int(n)}
+                if getattr(n, "approximate", False):
+                    out["approximate"] = True
+                    out["reason"] = n.reason
+                return 200, out
             if rest == ["explain"]:
                 out = self.store.explain(t, cql)
                 return 200, json.loads(json.dumps(out, default=str))
@@ -195,21 +283,42 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        if isinstance(payload, dict) and "retry_after_s" in payload:
+            # shed (429) / breaker-open (503) backpressure: the standard
+            # header clients and proxies honor
+            self.send_header("Retry-After",
+                             str(max(1, int(-(-payload["retry_after_s"]
+                                             // 1)))))
         self.end_headers()
         self.wfile.write(data)
 
+    def _serve(self, method: str) -> None:
+        """Route + respond inside a last-resort guard: NOTHING a route
+        raises may kill the handler thread and reset the client connection
+        — an unexpected error becomes a structured 500 envelope (the
+        kind/status mapping itself lives in GeoJsonApi.handle)."""
+        try:
+            u = urlparse(self.path)
+            body = None
+            if method == "POST":
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+            status, payload = self.api.handle(method, u.path,
+                                              parse_qs(u.query), body,
+                                              headers=self.headers)
+        except Exception as e:  # handle() failed outside its own guards
+            status, payload = 500, {"error": str(e), "kind": "internal",
+                                    "type": type(e).__name__}
+        try:
+            self._respond(status, payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; the server thread must survive it
+
     def do_GET(self):
-        u = urlparse(self.path)
-        status, payload = self.api.handle("GET", u.path, parse_qs(u.query))
-        self._respond(status, payload)
+        self._serve("GET")
 
     def do_POST(self):
-        u = urlparse(self.path)
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length) if length else b""
-        status, payload = self.api.handle("POST", u.path, parse_qs(u.query),
-                                          body)
-        self._respond(status, payload)
+        self._serve("POST")
 
     def log_message(self, *a):  # quiet by default
         pass
