@@ -411,6 +411,19 @@ def main() -> None:
                 detail["cfg1_scheduler_qps"]
                 / max(detail["cfg1_unbatched_qps"], 1e-9), 2)
 
+            # observability tax at full scale: the same unbatched workload
+            # with the whole obs layer (tracing + flight recorder + tail
+            # sampling + kernel attribution) muted — the production-size
+            # counterpart of the <5% guard in test_perf_budget.py
+            from geomesa_tpu import trace as _tr
+            with _tr.disabled():
+                lat_d, wall_d = run_clients(lambda q: planner.count(q), 2)
+            obs_off_qps = len(lat_d) / wall_d
+            detail["cfg1_obs_off_qps"] = round(obs_off_qps, 1)
+            detail["cfg1_obs_overhead_pct"] = round(
+                (obs_off_qps / max(detail["cfg1_unbatched_qps"], 1e-9) - 1)
+                * 100, 2)
+
         # full-mask scan for comparison (same query, pruning disabled)
         os.environ["GEOMESA_TPU_PRUNE"] = "0"
         pq_full = planner.prepare(ecql)

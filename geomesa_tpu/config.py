@@ -270,6 +270,61 @@ RETRY_WAL_FSYNC = _register(
     "propagates (transient EIO/disk-pressure absorption). 1 = no retry, "
     "the strict policy the durability tests pin.")
 
+# -- request-centric observability (obs/) -------------------------------------
+
+OBS_ENABLED = _register(
+    "GEOMESA_TPU_OBS", True, _parse_bool,
+    "Master switch for the request-centric observability layer (flight "
+    "recorder wide events, tail-based trace sampling, per-kernel device "
+    "attribution). Off: trace close pays nothing beyond the base ring.")
+
+OBS_RING = _register(
+    "GEOMESA_TPU_OBS_RING", 2048, int,
+    "Flight-recorder ring capacity (wide events retained in memory for "
+    "GET /events and `debug events`).")
+
+OBS_TRACE_RING = _register(
+    "GEOMESA_TPU_OBS_TRACE_RING", 256, int,
+    "Tail-sampled trace ring capacity: retained traces (errors, deadline/"
+    "shed/degrade outcomes, slow outliers, probabilistic sample) that "
+    "/metrics exemplars link to.")
+
+OBS_SAMPLE = _register(
+    "GEOMESA_TPU_OBS_SAMPLE", 0.02, float,
+    "Probabilistic retention rate for ordinary traces (errors and slow "
+    "outliers are ALWAYS retained — tail-based sampling keeps the "
+    "interesting tail at full fidelity and this fraction of the rest).")
+
+OBS_SLOW_MS = _register(
+    "GEOMESA_TPU_OBS_SLOW_MS", 0.0, float,
+    "Slow-trace retention threshold in ms. 0 = adaptive: retain anything "
+    "over the rolling p99 of recent root-trace durations.")
+
+OBS_JSONL = _register(
+    "GEOMESA_TPU_OBS_JSONL", "", str,
+    "Path for the flight recorder's JSONL sink (one wide event per line, "
+    "size-rotated). Empty = in-memory ring only.")
+
+OBS_JSONL_MAX_BYTES = _register(
+    "GEOMESA_TPU_OBS_JSONL_MAX_BYTES", 64 * 1024 * 1024, int,
+    "Rotation threshold for the flight-recorder JSONL sink (keep-one-"
+    "previous, shared durability/rotation.py policy).")
+
+SLO_LATENCY_MS = _register(
+    "GEOMESA_TPU_SLO_LATENCY_MS", 250.0, float,
+    "Latency objective threshold for the default serving SLO: a count "
+    "is 'good' when it lands under this many ms.")
+
+SLO_TARGET = _register(
+    "GEOMESA_TPU_SLO_TARGET", 0.999, float,
+    "Target good-fraction for the default latency SLO (error budget = "
+    "1 - target, the quantity burn rates are measured against).")
+
+SLO_AVAIL_TARGET = _register(
+    "GEOMESA_TPU_SLO_AVAIL_TARGET", 0.999, float,
+    "Target success-fraction for the default availability SLO (sheds, "
+    "deadline cancellations and worker deaths spend its budget).")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

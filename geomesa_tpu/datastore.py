@@ -118,8 +118,10 @@ class TpuDataStore:
     def __init__(self, params: Optional[dict] = None):
         import threading
 
+        from geomesa_tpu import obs as _obs
         from geomesa_tpu.metrics import register_device_gauges
         register_device_gauges()
+        _obs.install()
         self._lock = threading.RLock()
         self.params = params or {}
         self.schemas: Dict[str, SimpleFeatureType] = {}
@@ -762,11 +764,34 @@ class TpuDataStore:
             c += len(self._delta_rows(delta, f, auths))
         return c
 
-    def explain(self, type_name: str, f: Union[str, ir.Filter]) -> dict:
+    def explain(self, type_name: str, f: Union[str, ir.Filter],
+                analyze: bool = False, auths: Optional[list] = None) -> dict:
         planner, delta = self._snapshot(type_name)
-        out = planner.explain(f)
+        out = planner.explain(f, analyze=analyze, auths=auths)
         if delta is not None:
             out["delta_rows"] = len(delta)  # unflushed LSM run merged inline
+            if analyze and "analyze" in out:
+                # store-level analyze must match store-level count: the
+                # planner executed the main table only, the delta rows
+                # merge here exactly like _count_impl does
+                d = int(len(self._delta_rows(delta, f, auths)))
+                out["analyze"]["rows_matched"] += d
+                out["analyze"]["rows_scanned"] += len(delta)
+                out["analyze"]["delta_rows_matched"] = d
+        if analyze and "analyze" in out:
+            # overlay the LIVE scheduler's cache provenance: would this
+            # filter be served from the plan cache right now? (peek only —
+            # an explain must not skew serving hit rates)
+            sched = self._scheduler
+            if sched is not None and sched.healthy():
+                from geomesa_tpu.filter.parser import parse_ecql as _pe
+                f_ir = _pe(f) if isinstance(f, str) else f
+                auths_key = None if auths is None \
+                    else tuple(sorted(str(a) for a in auths))
+                pkey = (self.epoch, type_name, self.generation(type_name),
+                        repr(f_ir), auths_key)
+                out["analyze"]["provenance"]["plan_cache"] = \
+                    "hit" if sched.plans.peek(pkey) else "miss"
         return out
 
     def stats(self, type_name: str):

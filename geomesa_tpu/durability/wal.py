@@ -319,6 +319,23 @@ def inspect(directory: str, name: str = "wal") -> dict:
 # -- the log ------------------------------------------------------------------
 
 
+import weakref
+
+# live WriteAheadLog instances (weak: a closed+dropped store frees its WAL)
+# — the `wal.open_segments` gauge sums on-disk segment counts over these
+_LIVE_WALS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def open_segment_count() -> int:
+    """Total on-disk segments across every live (unclosed) WAL in this
+    process — the observability-buffer-pressure gauge feed."""
+    total = 0
+    for w in list(_LIVE_WALS):
+        if not w._closed:
+            total += len(segments(w.dir, w.name))
+    return total
+
+
 class WriteAheadLog:
     """One append-only log (a directory of numbered segments). Thread-safe;
     mutators call ``append`` before applying their mutation in memory
@@ -355,6 +372,7 @@ class WriteAheadLog:
         self._syncer: Optional[threading.Thread] = None
         self._syncer_stop = threading.Event()
         self._open_segment()
+        _LIVE_WALS.add(self)
 
     # -- state ---------------------------------------------------------------
 

@@ -97,7 +97,11 @@ class DeviceTable:
         period: when set, the default dtg column is decomposed into exact
         (bin, off) int32 pairs for temporal predicates.
         """
+        from geomesa_tpu.obs import attrib as _attrib
         planes = host_planes(table, period)
+        _attrib.record_transfer(
+            "device_table.build", 1,
+            sum(int(v.nbytes) for v in planes.values()))
         cols = {k: jnp.asarray(v[perm]) for k, v in planes.items()}
         return cls(len(perm), cols)
 
@@ -114,8 +118,13 @@ class DeviceTable:
         keeps the O(N) reorder on the accelerator instead of the host."""
         import jax
 
+        from geomesa_tpu.obs import attrib as _attrib
+
         if planes is None:
             planes = host_planes(table, period)
+        _attrib.record_transfer(
+            "device_table.build_on_device", 1,
+            sum(int(v.nbytes) for v in planes.values()))
         unsorted = {k: jnp.asarray(v) for k, v in planes.items()}
 
         @jax.jit

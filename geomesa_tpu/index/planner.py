@@ -176,17 +176,34 @@ class QueryPlanner:
                      "strategies": [p.explain.get("index")
                                     for _, p in branches]})
 
-    def explain(self, f: Union[str, ir.Filter]) -> Dict[str, object]:
+    def explain(self, f: Union[str, ir.Filter], analyze: bool = False,
+                auths=None) -> Dict[str, object]:
         """Hierarchical plan description (≙ Explainer / CLI explain). The
         ``trace`` key carries the span tree of the dry-run (plan + range
         decomposition — no scan executes), so explain shows where planning
-        time goes, not just what the plan is."""
+        time goes, not just what the plan is.
+
+        ``analyze=True`` (≙ EXPLAIN ANALYZE) additionally EXECUTES the
+        plan's count path inside the same trace and annotates each span in
+        the returned tree with its device ms and cache provenance, plus an
+        ``analyze`` summary: rows scanned/matched, device-vs-host split,
+        per-stage self times."""
         with _trace.trace("explain", type=self.sft.name) as t:
             plan = self.plan(f)
             blocks = self._pruned_blocks(plan)  # surface the pruning decision
+            n = None
+            if analyze:
+                plan_x = self._apply_auths(plan, auths)
+                n = self._count(
+                    plan_x, f if isinstance(f, ir.Filter) else parse_ecql(f),
+                    auths)
         out = dict(plan.explain)
         if t is not None:
-            out["trace"] = t.to_dict()
+            tdict = t.to_dict()
+            if analyze:
+                from geomesa_tpu.obs import attrib as _oattrib
+                _oattrib.annotate_tree(tdict["root"])
+            out["trace"] = tdict
         out["scan"] = "range-pruned" if blocks is not None else "full-mask"
         out.update({
             "type": self.sft.name,
@@ -196,6 +213,26 @@ class QueryPlanner:
             "n_boxes": 0 if plan.boxes_loose is None else len(plan.boxes_loose),
             "n_windows": 0 if plan.windows is None else len(plan.windows),
         })
+        if analyze and t is not None:
+            stages = t.self_times_ms()
+            device_ms = stages.get("device_scan", 0.0) \
+                + stages.get("device_wait", 0.0)
+            out["analyze"] = {
+                "executed": True,
+                "rows_matched": int(n) if n is not None else None,
+                "rows_scanned": (len(blocks) * _prune.BLOCK_SIZE
+                                 if blocks is not None else len(self.table)),
+                "duration_ms": round(t.duration_ms, 3),
+                "device_ms": round(device_ms, 3),
+                "host_ms": round(max(0.0, t.duration_ms - device_ms), 3),
+                "stages_ms": {k: round(v, 3) for k, v in stages.items()},
+                # direct-path execution never serves from the scheduler's
+                # plan/cover caches; the store-level explain overlays the
+                # live scheduler's provenance when one is running
+                "provenance": {"plan": "fresh",
+                               "cover": "fresh" if blocks is not None
+                               else "n/a"},
+            }
         return out
 
     # -- visibility enforcement (≙ VisibilityFilter, geomesa-security) -------

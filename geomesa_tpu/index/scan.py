@@ -31,6 +31,7 @@ import numpy as np
 
 from geomesa_tpu import trace as _trace
 from geomesa_tpu.filter import ir
+from geomesa_tpu.obs import attrib as _attrib
 
 
 def _fetch(dispatch, *args):
@@ -754,6 +755,12 @@ class ScanKernels:
             raise ValueError(mode)
 
         jitted = jax.jit(run)
+        if _attrib.enabled():
+            # per-(kernel, tier) compile attribution: the first invocation
+            # is where XLA traces + compiles, and that cost lands on the
+            # kernel's labeled series instead of vanishing into one query
+            jitted = _attrib.compile_probe(
+                jitted, f"{mode}.{primary_kind}", n_boxes)
         self._jitted[key] = jitted
         from geomesa_tpu import config
         # NB fresh name: the mode closures above capture _get locals (cap,
@@ -771,9 +778,10 @@ class ScanKernels:
                        residual[2] if residual else None,
                        0 if boxes is None else boxes.shape[0],
                        0 if windows is None else windows.shape[0])
-        return int(_fetch(
-            fn, self.cols, _dev(boxes), _dev(windows),
-            [jnp.asarray(p) for p in residual[1]] if residual else []))
+        with _attrib.kernel(f"count.{primary_kind}"):
+            return int(_fetch(
+                fn, self.cols, _dev(boxes), _dev(windows),
+                [jnp.asarray(p) for p in residual[1]] if residual else []))
 
     def mask(self, primary_kind, boxes, windows, residual) -> jnp.ndarray:
         fn = self._get("mask", primary_kind, windows is not None,
@@ -839,8 +847,10 @@ class ScanKernels:
                      residual) -> np.ndarray:
         """Per-box counts for a (B, 8) box array: one upload, one kernel,
         one readback — B counts for the price of one round trip."""
-        out = np.asarray(_fetch(self.prepare_counts_multi(
-            primary_kind, boxes, windows, residual)))
+        tier = max(1, 1 << max(0, (len(boxes) - 1)).bit_length())
+        with _attrib.kernel(f"count_multi.{primary_kind}", tier):
+            out = np.asarray(_fetch(self.prepare_counts_multi(
+                primary_kind, boxes, windows, residual)))
         return out[: len(boxes)]
 
     def prepare_count(self, primary_kind, boxes, windows, residual):
@@ -880,8 +890,9 @@ class ScanKernels:
     def count_blocks(self, primary_kind, boxes, windows, residual,
                      blocks: np.ndarray, block_size: int) -> int:
         """Exact count scanning only the candidate blocks (range-pruned)."""
-        return int(_fetch(self.prepare_count_blocks(
-            primary_kind, boxes, windows, residual, blocks, block_size)))
+        with _attrib.kernel(f"count_blocks.{primary_kind}"):
+            return int(_fetch(self.prepare_count_blocks(
+                primary_kind, boxes, windows, residual, blocks, block_size)))
 
     def prepare_count_blocks(self, primary_kind, boxes, windows, residual,
                              blocks: np.ndarray, block_size: int):
@@ -947,8 +958,10 @@ class ScanKernels:
                             residual, blocks: np.ndarray,
                             block_size: int) -> np.ndarray:
         """Blocking counterpart of ``prepare_counts_multi_blocks``."""
-        out = np.asarray(_fetch(self.prepare_counts_multi_blocks(
-            primary_kind, boxes, windows, residual, blocks, block_size)))
+        tier = max(1, 1 << max(0, (len(boxes) - 1)).bit_length())
+        with _attrib.kernel(f"count_multi_blocks.{primary_kind}", tier):
+            out = np.asarray(_fetch(self.prepare_counts_multi_blocks(
+                primary_kind, boxes, windows, residual, blocks, block_size)))
         return out[: len(boxes)]
 
     def prepare_density_compact(self, primary_kind, boxes, windows, residual,

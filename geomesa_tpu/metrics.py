@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import threading
 import time
 from collections import defaultdict
@@ -123,8 +124,21 @@ class MetricsRegistry:
         self._reporters: List[Callable[[str, str, float], None]] = []
         # span trees awaiting histogram feed (GIL-atomic appends from trace
         # close; drained under the lock at snapshot time) — keeps the
-        # per-query trace-close cost to one list append
+        # per-query trace-close cost to one list append. Entries are
+        # (root, trace_id) so retained traces can land bucket exemplars.
         self._pending: List[object] = []
+        # timer name -> {bucket index -> (trace_id, seconds)}: the newest
+        # RETAINED trace that observed into that bucket (OpenMetrics
+        # exemplar slot). Populated at drain time through _exemplar_filter
+        # (obs/sampling installs it — only tail-retained traces qualify,
+        # so every exemplar links to a trace a reader can actually fetch).
+        self._exemplars: Dict[str, Dict[int, tuple]] = {}
+        self._exemplar_filter: Optional[Callable[[int], bool]] = None
+        # runs BEFORE the lock on every snapshot-ish read: obs/sampling
+        # drains its deferred retention queue here, so the exemplar filter
+        # (consulted under the lock) sees up-to-date retention without ever
+        # nesting locks
+        self._pre_drain_hook: Optional[Callable[[], None]] = None
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -157,14 +171,37 @@ class MetricsRegistry:
         with self._lock:
             self._values[name].observe(value)
 
-    def feed_tree(self, root) -> None:
+    def feed_tree(self, root, trace_id: Optional[int] = None) -> None:
         """Defer a whole span tree (an object with ``walk()`` yielding nodes
         with ``name``/``duration_ms``) to the next drain — the trace-close
         hot-path feed: ONE locked list append now, histogram math at
         snapshot time. Reporters consequently see trace-span timer events at
-        drain time (they poll snapshots anyway, the dropwizard model)."""
+        drain time (they poll snapshots anyway, the dropwizard model).
+        ``trace_id`` tags the tree so retained traces become exemplars.
+        Lockless by design (list appends are GIL-atomic; the drain swap
+        under the lock captures the same list object, so nothing is
+        lost) — this is the trace-close hot path."""
+        self._pending.append((root, trace_id))
+
+    def set_exemplar_filter(self, fn: Optional[Callable[[int], bool]]) -> None:
+        """``fn(trace_id) -> bool`` gates which drained trees land bucket
+        exemplars (obs/sampling installs its retained-set membership).
+        MUST NOT acquire this registry's lock."""
         with self._lock:
-            self._pending.append(root)
+            self._exemplar_filter = fn
+
+    def set_pre_drain_hook(self, fn: Optional[Callable[[], None]]) -> None:
+        """Zero-arg hook run before snapshot/export/timer_good_total take
+        the lock (the tail sampler's deferred-decision drain slot)."""
+        self._pre_drain_hook = fn
+
+    def _pre_drain(self) -> None:
+        hook = self._pre_drain_hook
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass  # a failing drain must never fail the surface
 
     def _drain_locked(self) -> Optional[list]:
         """Fold pending span trees into the histograms (lock held). Returns
@@ -172,11 +209,43 @@ class MetricsRegistry:
         if not self._pending:
             return None
         pending, self._pending = self._pending, []
-        pairs = [(s.name, s.duration_ms / 1000.0)
-                 for root in pending for s in root.walk()]
+        flt = self._exemplar_filter
+        pairs = []
+        for root, tid in pending:
+            keep = False
+            if tid is not None and flt is not None:
+                try:
+                    keep = bool(flt(tid))
+                except Exception:
+                    keep = False
+            for s in root.walk():
+                seconds = s.duration_ms / 1000.0
+                pairs.append((s.name, seconds))
+                if keep:
+                    self._exemplars.setdefault(s.name, {})[
+                        bucket_index(seconds)] = (tid, seconds)
         for name, seconds in pairs:
             self._timers[name].observe(seconds)
         return pairs if self._reporters else None
+
+    def timer_good_total(self, name: str, threshold_s: float):
+        """(good, total) observation counts for one timer, where 'good'
+        means the observation landed in a bucket whose UPPER bound is
+        <= threshold_s (conservative by at most one bucket factor, ~19%).
+        The SLO engine's latency feed. Drains pending trees first so the
+        answer reflects every closed trace."""
+        self._pre_drain()
+        with self._lock:
+            self._drain_locked()
+            h = self._timers.get(name)
+            if h is None or h.count == 0:
+                return 0, 0
+            good = 0
+            for i, c in enumerate(h.buckets):
+                if BUCKET_BOUNDS[i] > threshold_s:
+                    break
+                good += c
+            return good, h.count
 
     @contextmanager
     def time(self, name: str):
@@ -230,6 +299,7 @@ class MetricsRegistry:
         return out
 
     def snapshot(self) -> dict:
+        self._pre_drain()
         gauges = self._gauge_values()  # probes run OUTSIDE the lock
         with self._lock:
             pairs = self._drain_locked()
@@ -255,25 +325,89 @@ class MetricsRegistry:
                           if k.startswith(prefixes)}
                 for section, values in snap.items()}
 
+    def _export_locked_state(self):
+        """One consistent view for the exposition: (counters, timer
+        summaries+buckets, value summaries+buckets, exemplars) captured
+        under ONE lock hold, so the summary and histogram families of a
+        metric can never disagree. Gauges probe outside the lock."""
+        self._pre_drain()
+        gauges = self._gauge_values()
+        with self._lock:
+            pairs = self._drain_locked()
+            reporters = list(self._reporters) if pairs else None
+            counters = dict(self._counters)
+            timers = {k: (h.to_dict(), list(h.buckets), h.total_s)
+                      for k, h in self._timers.items()}
+            values = {k: (h.to_value_dict(), list(h.buckets), h.total_s)
+                      for k, h in self._values.items()}
+            flt = self._exemplar_filter
+            exemplars = {}
+            for name, by_bucket in self._exemplars.items():
+                kept = {}
+                for bi, (tid, sec) in by_bucket.items():
+                    # re-check retention at emission: a trace evicted from
+                    # the tail-sampled ring must not leave a dangling link
+                    try:
+                        if flt is None or flt(tid):
+                            kept[bi] = (tid, sec)
+                    except Exception:
+                        pass
+                by_bucket.clear()
+                by_bucket.update(kept)
+                if kept:
+                    exemplars[name] = dict(kept)
+        if pairs:
+            for name, seconds in pairs:
+                self._report(reporters, "timer", name, seconds)
+        return counters, gauges, timers, values, exemplars
+
+    @staticmethod
+    def _bucket_lines(lines: List[str], m: str, buckets: List[int],
+                      count: int, total: float,
+                      exemplars: Optional[Dict[int, tuple]]) -> None:
+        """Native cumulative ``_bucket{le=...}`` lines (only bounds that
+        hold observations — le stays strictly increasing, cumulative counts
+        non-decreasing) + the +Inf bucket, _count and _sum. Buckets backed
+        by a retained trace carry an OpenMetrics-style exemplar."""
+        cum = 0
+        for i, c in enumerate(buckets):
+            if not c:
+                continue
+            cum += c
+            line = f'{m}_bucket{{le="{BUCKET_BOUNDS[i]:.9g}"}} {cum}'
+            ex = exemplars.get(i) if exemplars else None
+            if ex is not None:
+                line += f' # {{trace_id="{ex[0]}"}} {ex[1]:.9g}'
+            lines.append(line)
+        lines.append(f'{m}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{m}_count {count}")
+        lines.append(f"{m}_sum {total:.9g}")
+
     def to_prometheus(self) -> str:
-        """Prometheus text exposition: counters as *_total, timers as
-        summaries with p50/p90/p99 quantiles, gauges as gauges. Never emits
-        NaN (empty timers emit count/sum only)."""
+        """Prometheus text exposition: counters as *_total, gauges as
+        gauges, and each timer/value histogram as TWO families — the
+        ``summary`` family (p50/p90/p99 quantile lines, the established
+        names) plus a native ``histogram`` family under ``<name>_hist``
+        with cumulative ``_bucket{le=...}`` lines and exemplar annotations
+        on buckets where a tail-retained trace exists. Never emits NaN
+        (empty timers emit count/sum only); every family name carries
+        exactly one # TYPE line."""
         def sane(name: str) -> str:
             return "geomesa_tpu_" + "".join(
                 c if c.isalnum() or c == "_" else "_" for c in name)
 
-        snap = self.snapshot()
+        counters, gauges, timers, values, exemplars = \
+            self._export_locked_state()
         lines: List[str] = []
-        for name, v in sorted(snap["counters"].items()):
+        for name, v in sorted(counters.items()):
             m = sane(name) + "_total"
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m} {v}")
-        for name, g in sorted(snap["gauges"].items()):
+        for name, g in sorted(gauges.items()):
             m = sane(name)
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {float(g):g}")
-        for name, h in sorted(snap["timers"].items()):
+        for name, (h, buckets, total_s) in sorted(timers.items()):
             m = sane(name) + "_seconds"
             lines.append(f"# TYPE {m} summary")
             if h["count"]:
@@ -282,15 +416,22 @@ class MetricsRegistry:
                     lines.append(
                         f'{m}{{quantile="{q}"}} {h[key] / 1000:.9g}')
             lines.append(f"{m}_count {h['count']}")
-            lines.append(f"{m}_sum {h['total_s']:.9g}")
-        for name, h in sorted(snap["histograms"].items()):
+            lines.append(f"{m}_sum {total_s:.9g}")
+            mh = m + "_hist"
+            lines.append(f"# TYPE {mh} histogram")
+            self._bucket_lines(lines, mh, buckets, h["count"], total_s,
+                               exemplars.get(name))
+        for name, (h, buckets, total) in sorted(values.items()):
             m = sane(name)  # raw units: no _seconds suffix
             lines.append(f"# TYPE {m} summary")
             if h["count"]:
                 for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
                     lines.append(f'{m}{{quantile="{q}"}} {h[key]:.9g}')
             lines.append(f"{m}_count {h['count']}")
-            lines.append(f"{m}_sum {h['total']:.9g}")
+            lines.append(f"{m}_sum {total:.9g}")
+            mh = m + "_hist"
+            lines.append(f"# TYPE {mh} histogram")
+            self._bucket_lines(lines, mh, buckets, h["count"], total, None)
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -303,6 +444,7 @@ class MetricsRegistry:
             self._timers.clear()
             self._values.clear()
             self._pending.clear()  # same straddling-discard semantics
+            self._exemplars.clear()
 
 
 # process-global default registry (≙ the shared MetricRegistry)
@@ -312,10 +454,14 @@ _DEVICE_GAUGES_REGISTERED = False
 
 
 def register_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
-    """Install lazy device gauges: ``device.count`` and
+    """Install lazy device + host-pressure gauges: ``device.count`` and
     ``device.bytes_in_use`` (summed ``memory_stats()`` over
-    ``jax.local_devices()`` where the backend reports them). Idempotent;
-    probes evaluate at snapshot time and never raise through the surface."""
+    ``jax.local_devices()`` where the backend reports them), plus
+    ``process.rss_bytes`` (host resident set), ``trace.ring_depth``
+    (recent-trace ring occupancy) and ``wal.open_segments`` (live WAL
+    segment files) — so /metrics reflects host memory and observability-
+    buffer pressure, not just device state. Idempotent; probes evaluate at
+    snapshot time and never raise through the surface."""
     global _DEVICE_GAUGES_REGISTERED
     reg = registry or REGISTRY
     if reg is REGISTRY and _DEVICE_GAUGES_REGISTERED:
@@ -338,5 +484,27 @@ def register_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
                 seen = True
         return total if seen else None
 
+    def _rss():
+        # current (not peak) resident set via /proc; ru_maxrss fallback
+        try:
+            with open("/proc/self/statm") as fh:
+                pages = int(fh.read().split()[1])
+            return pages * (os.sysconf("SC_PAGE_SIZE")
+                            if hasattr(os, "sysconf") else 4096)
+        except OSError:
+            import resource
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    def _ring_depth():
+        from geomesa_tpu.trace import RING
+        return len(RING)
+
+    def _wal_segments():
+        from geomesa_tpu.durability.wal import open_segment_count
+        return open_segment_count()
+
     reg.set_gauge("device.count", _count)
     reg.set_gauge("device.bytes_in_use", _mem)
+    reg.set_gauge("process.rss_bytes", _rss)
+    reg.set_gauge("trace.ring_depth", _ring_depth)
+    reg.set_gauge("wal.open_segments", _wal_segments)

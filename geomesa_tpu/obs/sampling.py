@@ -1,0 +1,190 @@
+"""Tail-based trace sampling: keep the traces worth reading.
+
+Head sampling (decide at trace START) throws away exactly the traces you
+end up needing — the slow outliers and the failures are invisible until
+the trace closes. This sampler decides at CLOSE (the Canopy/X-Ray "tail"
+discipline):
+
+  keep always      error traces, and anything that cancelled / shed /
+                   degraded (the kinds the resilience layer stamps)
+  keep slow        duration over the slow threshold — a fixed
+                   GEOMESA_TPU_OBS_SLOW_MS, or (at 0, the default) an
+                   ADAPTIVE threshold: the rolling p99 of recent root
+                   durations (decayed log-bucket histogram, so a traffic
+                   shift re-learns what "slow" means within ~1k queries)
+  sample the rest  probabilistically at GEOMESA_TPU_OBS_SAMPLE
+
+Retained traces land in a dedicated ring (``SAMPLER.recent()``, web
+``GET /traces?retained=1``) and their ids pass the metrics registry's
+exemplar filter — so a `/metrics` histogram bucket annotates the id of a
+concrete retained trace a reader can actually pull up.
+
+Deterministic by construction: the rng is injectable (tests pin rates to
+0/1 anyway) and nothing sleeps or reads wall-clock.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.metrics import Histogram
+from geomesa_tpu.trace import QueryTrace, TraceRing
+
+# span kinds whose presence always retains the trace
+_KEEP_KINDS = frozenset(("cancel", "shed", "degrade"))
+
+# decay the rolling-duration histogram every N offers (halving counts) so
+# the adaptive p99 tracks the RECENT distribution, not all of history
+_DECAY_EVERY = 1024
+
+
+class TailSampler:
+    """Tail-based retention over closed root traces."""
+
+    # pending-queue bound: past this, enqueue() drains inline so the
+    # deferred decision can never hoard unbounded traces
+    _PENDING_MAX = 1024
+
+    def __init__(self, keep: Optional[int] = None,
+                 rng: Optional[random.Random] = None):
+        self._keep = int(keep or config.OBS_TRACE_RING.get())
+        self.ring = TraceRing(keep=self._keep)
+        self._rng = rng or random.Random(0x6E05A)
+        self._lock = threading.Lock()
+        self._durations = Histogram()
+        self._since_decay = 0
+        self._p99_ms = 0.0  # cached; recomputed on decay + every 32 offers
+        self._since_p99 = 0
+        # ids of traces currently retained, insertion-ordered and trimmed
+        # alongside the ring (its deque evicts silently)
+        self._retained_ids: "OrderedDict[int, str]" = OrderedDict()
+        # closed traces awaiting their retention decision: the close hook
+        # pays ONE list append (GIL-atomic); every reader drains first
+        self._pending: List[QueryTrace] = []
+        self.kept = 0
+        self.seen = 0
+
+    # -- deferred offers ------------------------------------------------------
+
+    def enqueue(self, t: QueryTrace) -> None:
+        """Hot-path entry: queue a closed trace for a lazy retention
+        decision (decided at the next read — recent()/is_retained()/
+        stats()/metrics drain). Bounded: past _PENDING_MAX the decision
+        runs inline."""
+        self._pending.append(t)
+        if len(self._pending) > self._PENDING_MAX:
+            self.drain()
+
+    def drain(self) -> None:
+        """Decide retention for every queued trace."""
+        if not self._pending:
+            return
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for t in pending:
+            self.offer(t)
+
+    # -- the decision ---------------------------------------------------------
+
+    def _slow_threshold_ms(self) -> float:
+        fixed = float(config.OBS_SLOW_MS.get())
+        if fixed > 0:
+            return fixed
+        # adaptive: the rolling p99 (0 until enough traffic to be meaningful
+        # — below 100 observations everything would be "slow", so gate)
+        return self._p99_ms if self._durations.count >= 100 else float("inf")
+
+    def offer(self, t: QueryTrace, stages: Optional[dict] = None) -> bool:
+        """Decide retention for one closed root trace; returns True when
+        retained (the trace landed in the sampled ring). ``stages`` is an
+        optional precomputed per-kind breakdown (its keys ARE the span
+        kinds) so the hot-path caller walks the span tree once, not
+        twice."""
+        dur_ms = t.duration_ms
+        kinds = stages.keys() if stages is not None else t.kinds()
+        with self._lock:
+            self.seen += 1
+            self._durations.observe(dur_ms / 1000.0)
+            self._since_decay += 1
+            self._since_p99 += 1
+            if self._since_decay >= _DECAY_EVERY:
+                self._since_decay = 0
+                h = self._durations
+                h.buckets = [c >> 1 for c in h.buckets]
+                h.count = sum(h.buckets)
+                h.total_s /= 2.0
+                h.max_s = 0.0  # re-learned by subsequent observations
+                self._since_p99 = 32  # force recompute below
+            if self._since_p99 >= 32:
+                self._since_p99 = 0
+                self._p99_ms = self._durations.percentile(0.99) * 1000.0
+            reason = None
+            if t.error is not None:
+                reason = "error"
+            elif not _KEEP_KINDS.isdisjoint(kinds):
+                reason = "outcome"
+            elif dur_ms >= self._slow_threshold_ms():
+                reason = "slow"
+            elif self._rng.random() < float(config.OBS_SAMPLE.get()):
+                reason = "sampled"
+            if reason is None:
+                return False
+            self.kept += 1
+            self._retained_ids[t.trace_id] = reason
+            while len(self._retained_ids) > self._keep:
+                self._retained_ids.popitem(last=False)
+        self.ring.append(t)
+        _metrics.inc("obs.traces_retained")
+        _metrics.inc(f"obs.traces_retained.{reason}")
+        return True
+
+    # -- queries --------------------------------------------------------------
+
+    def is_retained(self, trace_id: int) -> bool:
+        """Retained-ring membership. NOTE: does NOT drain (the metrics
+        registry consults this under ITS lock — the registry's pre-drain
+        hook runs ``drain()`` beforehand instead)."""
+        with self._lock:
+            return trace_id in self._retained_ids
+
+    def recent(self, limit: Optional[int] = None) -> List[dict]:
+        self.drain()
+        return self.ring.recent(limit)
+
+    def slow_threshold_ms(self) -> float:
+        with self._lock:
+            th = self._slow_threshold_ms()
+        return -1.0 if th == float("inf") else round(th, 3)
+
+    def stats(self) -> dict:
+        self.drain()
+        with self._lock:
+            th = self._slow_threshold_ms()
+            return {
+                "seen": self.seen,
+                "kept": self.kept,
+                "retained": len(self._retained_ids),
+                "capacity": self._keep,
+                "slow_threshold_ms": -1.0 if th == float("inf")
+                else round(th, 3),
+                "sample_rate": float(config.OBS_SAMPLE.get()),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._retained_ids.clear()
+            self._pending = []
+            self._durations = Histogram()
+            self._p99_ms = 0.0
+            self._since_decay = self._since_p99 = 0
+            self.kept = self.seen = 0
+        self.ring.clear()
+
+
+# process-global sampler
+SAMPLER = TailSampler()

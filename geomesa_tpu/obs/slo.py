@@ -1,0 +1,228 @@
+"""SLO engine: declarative objectives evaluated as multi-window burn rates.
+
+The SRE-workbook alerting discipline: an SLO (say 99.9% of counts under
+250ms) defines an error budget (0.1%); the *burn rate* over a window is
+how many times faster than budget-neutral the service is spending it
+(burn 1.0 = exactly exhausting the budget over the SLO period). Alerting
+on multi-window burn rates gets both fast detection and low flap:
+
+  page    burn >= 14.4 over BOTH the 5m and 1h windows
+          (at 14.4x, a 30-day budget is gone in ~2 days)
+  ticket  burn >= 6 over BOTH the 30m and 6h windows
+
+Objectives read the metrics registry we already populate — latency SLOs
+count good observations straight out of the timer's log-scale buckets
+(``timer_good_total``), availability SLOs diff counters (total vs bad).
+The engine snapshots (ts, good, total) samples on an injectable clock;
+window burn rates are computed by diffing against the newest sample at
+least window-old, so tests drive hours of budget history in microseconds
+with a fake clock and zero sleeps.
+
+Surfaces: ``GET /slo``, the ``slo`` section of ``/healthz``, and CLI
+``geomesa-tpu debug slo``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _default_registry
+
+# evaluation windows (seconds) and the two alert pairings
+WINDOWS: Dict[str, float] = {"5m": 300.0, "30m": 1800.0,
+                             "1h": 3600.0, "6h": 21600.0}
+PAGE_WINDOWS: Tuple[str, str] = ("5m", "1h")
+TICKET_WINDOWS: Tuple[str, str] = ("30m", "6h")
+PAGE_BURN = 14.4
+TICKET_BURN = 6.0
+
+
+@dataclass
+class Objective:
+    """One declarative objective.
+
+    kind 'latency':      good = observations of ``timer`` landing under
+                         ``threshold_ms`` (bucket-resolution, conservative)
+    kind 'availability': good = ``total_counter`` minus the sum of
+                         ``bad_counters``
+    """
+
+    name: str
+    kind: str                      # "latency" | "availability"
+    target: float                  # e.g. 0.999
+    timer: Optional[str] = None
+    threshold_ms: float = 0.0
+    total_counter: Optional[str] = None
+    bad_counters: tuple = field(default_factory=tuple)
+
+    @property
+    def budget(self) -> float:
+        return max(1e-9, 1.0 - float(self.target))
+
+    def describe(self) -> dict:
+        out = {"name": self.name, "kind": self.kind, "target": self.target}
+        if self.kind == "latency":
+            out["timer"] = self.timer
+            out["threshold_ms"] = self.threshold_ms
+        else:
+            out["total_counter"] = self.total_counter
+            out["bad_counters"] = list(self.bad_counters)
+        return out
+
+
+class SloEngine:
+    """Burn-rate evaluation over registry snapshots."""
+
+    def __init__(self, registry=None, clock=time.monotonic,
+                 history: int = 8192):
+        self._registry = registry or _default_registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._objectives: Dict[str, Objective] = {}
+        # per-objective (ts, good, total) cumulative samples, oldest first
+        self._samples: Dict[str, deque] = {}
+        self._history = int(history)
+
+    # -- registration ---------------------------------------------------------
+
+    def add(self, obj: Objective) -> Objective:
+        with self._lock:
+            self._objectives[obj.name] = obj
+            self._samples.setdefault(obj.name,
+                                     deque(maxlen=self._history))
+        return obj
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._objectives.pop(name, None)
+            self._samples.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._objectives.clear()
+            self._samples.clear()
+
+    def objectives(self) -> List[Objective]:
+        with self._lock:
+            return list(self._objectives.values())
+
+    # -- sampling -------------------------------------------------------------
+
+    def _totals(self, obj: Objective) -> Tuple[int, int]:
+        """Cumulative (good, total) for an objective right now."""
+        if obj.kind == "latency":
+            return self._registry.timer_good_total(
+                obj.timer, obj.threshold_ms / 1000.0)
+        counters = self._registry.snapshot()["counters"]
+        total = int(counters.get(obj.total_counter, 0))
+        bad = sum(int(counters.get(b, 0)) for b in obj.bad_counters)
+        bad = min(bad, total)
+        return total - bad, total
+
+    def tick(self) -> None:
+        """Append one (ts, good, total) sample per objective — called on
+        every evaluation (and by anything periodic an operator wires up)."""
+        now = self._clock()
+        with self._lock:
+            objs = list(self._objectives.values())
+        for obj in objs:
+            good, total = self._totals(obj)
+            with self._lock:
+                self._samples[obj.name].append((now, good, total))
+
+    # -- evaluation -----------------------------------------------------------
+
+    @staticmethod
+    def _baseline(samples, cutoff: float):
+        """Newest sample no newer than ``cutoff`` (the window's start),
+        else the oldest available (a partially-filled window measures the
+        history it has — better than pretending zero traffic)."""
+        base = None
+        for s in samples:
+            if s[0] <= cutoff:
+                base = s
+            else:
+                break
+        return base if base is not None else (samples[0] if samples else None)
+
+    def evaluate(self, tick: bool = True) -> dict:
+        """Burn rates + alert state per objective. ``tick=False`` evaluates
+        the existing history without adding a sample (pure readers)."""
+        if tick:
+            self.tick()
+        now = self._clock()
+        with self._lock:
+            objs = list(self._objectives.values())
+            hist = {n: list(s) for n, s in self._samples.items()}
+        out = {}
+        for obj in objs:
+            samples = hist.get(obj.name, [])
+            latest = samples[-1] if samples else (now, 0, 0)
+            burns: Dict[str, Optional[float]] = {}
+            for wname, wsec in WINDOWS.items():
+                base = self._baseline(samples, now - wsec)
+                if base is None or latest[2] <= base[2]:
+                    burns[wname] = None  # no traffic in the window
+                    continue
+                d_total = latest[2] - base[2]
+                d_bad = (latest[2] - latest[1]) - (base[2] - base[1])
+                err_rate = max(0.0, d_bad) / d_total
+                burns[wname] = round(err_rate / obj.budget, 3)
+
+            def _pair(pair, bar):
+                return all(burns.get(w) is not None and burns[w] >= bar
+                           for w in pair)
+
+            page = _pair(PAGE_WINDOWS, PAGE_BURN)
+            ticket = _pair(TICKET_WINDOWS, TICKET_BURN)
+            status = "page" if page else ("ticket" if ticket else "ok")
+            good, total = latest[1], latest[2]
+            out[obj.name] = {
+                **obj.describe(),
+                "good": good,
+                "total": total,
+                "error_budget": obj.budget,
+                "compliance": round(good / total, 6) if total else None,
+                "burn_rates": burns,
+                "page": page,
+                "ticket": ticket,
+                "status": status,
+            }
+        return out
+
+    def summary(self, tick: bool = True) -> dict:
+        """Worst-status rollup for /healthz."""
+        ev = self.evaluate(tick=tick)
+        statuses = [v["status"] for v in ev.values()]
+        worst = "page" if "page" in statuses else \
+            ("ticket" if "ticket" in statuses else "ok")
+        return {"status": worst,
+                "objectives": {k: v["status"] for k, v in ev.items()}}
+
+
+# process-global engine
+ENGINE = SloEngine()
+
+
+def default_objectives() -> List[Objective]:
+    """The serving-path defaults install() registers: count latency under
+    GEOMESA_TPU_SLO_LATENCY_MS at GEOMESA_TPU_SLO_TARGET, and scheduled-
+    count availability (sheds, deadline cancellations and worker deaths
+    spend the budget) at GEOMESA_TPU_SLO_AVAIL_TARGET."""
+    return [
+        Objective(name="count_latency", kind="latency",
+                  target=float(config.SLO_TARGET.get()),
+                  timer="query.count",
+                  threshold_ms=float(config.SLO_LATENCY_MS.get())),
+        Objective(name="count_availability", kind="availability",
+                  target=float(config.SLO_AVAIL_TARGET.get()),
+                  total_counter="scheduler.queries",
+                  bad_counters=("admission.shed",
+                                "scheduler.deadline_cancelled",
+                                "scheduler.worker_deaths")),
+    ]

@@ -72,9 +72,12 @@ from geomesa_tpu.durability import faults as _faults
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.obs import attrib as _attrib
+from geomesa_tpu.obs import flight as _flight
 from geomesa_tpu.serve.resilience import deadline as _rdl
 from geomesa_tpu.serve.resilience import degrade as _degrade
 from geomesa_tpu.serve.resilience.admission import (AdmissionController,
+                                                    ShedError,
                                                     normalize_priority)
 from geomesa_tpu.serve.resilience.breaker import CircuitBreaker, retry_call
 from geomesa_tpu.serve.resilience.deadline import Deadline, DeadlineExceeded
@@ -137,6 +140,12 @@ class LruCache:
                 out = _MISS
         _metrics.inc(f"{self._prefix}.hits" if hit else f"{self._prefix}.misses")
         return out
+
+    def peek(self, key) -> bool:
+        """Membership probe WITHOUT touching hit/miss counters or LRU order
+        (the explain/analyze provenance overlay must not skew cache stats)."""
+        with self._lock:
+            return key in self._d
 
     def put(self, key, value) -> None:
         if self._cap <= 0:
@@ -207,7 +216,11 @@ class Request:
                  "planner", "delta", "generation", "epoch", "future",
                  "t_submit", "plan", "queue_wait_s", "plan_s", "scan_s",
                  "batched", "batch_size", "deadline", "priority",
-                 "cancelled", "degraded")
+                 "cancelled", "degraded",
+                 # flight-recorder dimensions (obs/flight.py wide events)
+                 "trace_id", "budget_ms", "plan_cache_hit",
+                 "cover_cache_hit", "batch_id", "rows_scanned", "shed",
+                 "breaker_open", "retries")
 
     def __init__(self, type_name, f_ir, f_key, auths, auths_key,
                  planner, delta, generation, epoch,
@@ -234,6 +247,15 @@ class Request:
         self.priority = priority
         self.cancelled = False
         self.degraded = False
+        self.trace_id: Optional[int] = None
+        self.budget_ms: Optional[float] = None
+        self.plan_cache_hit: Optional[bool] = None
+        self.cover_cache_hit: Optional[bool] = None
+        self.batch_id: Optional[int] = None
+        self.rows_scanned: Optional[int] = None
+        self.shed = False
+        self.breaker_open = False
+        self.retries = 0
 
     def result(self, timeout: Optional[float] = None) -> int:
         return self.future.result(timeout=timeout)
@@ -277,7 +299,12 @@ class QueryScheduler:
         # FIFO within a class, _STOP after all queued work
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
         self._seq = itertools.count()
+        self._batch_ids = itertools.count(1)
         self._done: "queue.Queue" = queue.Queue()
+        # flight recorder / tail sampling / kernel attribution hooks — a
+        # bare scheduler (bench, tests) is observable like a store-owned one
+        from geomesa_tpu import obs as _obs
+        _obs.install()
         # resilience: admission bounds + device-dispatch breaker + the
         # registry of every unresolved request (failed en masse if a worker
         # dies or shutdown leaves work behind)
@@ -334,8 +361,17 @@ class QueryScheduler:
         req = Request(type_name, f_ir, repr(f_ir), auths, auths_key,
                       planner, delta, gen, epoch, deadline=dl,
                       priority=normalize_priority(priority))
+        # flight-recorder envelope: the wide event fires on EVERY resolution
+        # path, so the callback attaches before any of them can run
+        caller_trace = _trace.current_trace()
+        if caller_trace is not None:
+            req.trace_id = caller_trace.trace_id
+        req.breaker_open = self.breaker.state != "closed"
+        if config.OBS_ENABLED.get():
+            req.future.add_done_callback(_flight.request_callback(req))
         _metrics.inc("scheduler.queries")
         if dl is not None:
+            req.budget_ms = round(max(0.0, dl.remaining_ms()), 3)
             _metrics.observe_value("deadline.remaining_ms",
                                    max(0.0, dl.remaining_ms()))
             if dl.expired:
@@ -353,7 +389,14 @@ class QueryScheduler:
                 _metrics.inc("scheduler.degraded")
                 req.future.set_result(approx)
                 return req
-        cls = self.admission.admit(req.priority)  # raises ShedError to shed
+        try:
+            cls = self.admission.admit(req.priority)  # ShedError sheds
+        except ShedError as e:
+            # resolve the (unreturned) future so the flight event records
+            # the shed before the raise reaches the caller
+            req.shed = True
+            self._fail(req, e)
+            raise
         self._track(req, cls)
         self._queue.put((_RANKS[cls], next(self._seq), req))
         return req
@@ -587,7 +630,9 @@ class QueryScheduler:
         plan = self.plans.get(pkey)
         if plan is not _MISS:
             req.plan = plan
+            req.plan_cache_hit = True
             return
+        req.plan_cache_hit = False
         t0 = _pc()
         planner = req.planner
         plan = planner._apply_auths(planner.plan(req.f_ir), req.auths)
@@ -612,7 +657,9 @@ class QueryScheduler:
         cached = self.covers.get(ckey)
         if cached is not _MISS:
             plan.blocks = cached
+            req.cover_cache_hit = True
             return
+        req.cover_cache_hit = False
         blocks = planner._pruned_blocks(plan)
         self.covers.put(ckey, blocks)
 
@@ -691,18 +738,35 @@ class QueryScheduler:
         lead = grp[0].plan
         kern = lead.index.kernels
         boxes = np.concatenate([r.plan.boxes_loose for r in grp], axis=0)
+        batch_id = next(self._batch_ids)
+        xfer = boxes.nbytes
         if pruned:
             nonempty = [r.plan.blocks for r in grp if len(r.plan.blocks)]
             union = np.unique(np.concatenate(nonempty)).astype(np.int32) \
                 if nonempty else np.empty(0, dtype=np.int32)
+            rows_scanned = int(len(union)) * _prune.BLOCK_SIZE
+            xfer += union.nbytes
             disp = kern.prepare_counts_multi_blocks(
                 lead.primary_kind, boxes, lead.windows, lead.residual_device,
                 union, _prune.BLOCK_SIZE)
+            kid = f"count_multi_blocks.{lead.primary_kind}"
         else:
+            _cols = kern.cols
+            rows_scanned = int(next(iter(_cols.values())).shape[0]) \
+                if _cols else 0
             disp = kern.prepare_counts_multi(
                 lead.primary_kind, boxes, lead.windows, lead.residual_device)
+            kid = f"count_multi.{lead.primary_kind}"
+        # attribution tier = the padded batch size the dispatch shipped
+        tier = max(1, 1 << max(0, (len(grp) - 1)).bit_length())
+        _attrib.record_transfer(kid, tier, xfer)
+        for r in grp:
+            r.batch_id = batch_id
+            r.rows_scanned = rows_scanned
+        attempts = [0]
 
         def _launch():
+            attempts[0] += 1
             _faults.serve_gate("sched.dispatch")
             return disp()  # async: enqueue only; the completer blocks for it
 
@@ -712,7 +776,9 @@ class QueryScheduler:
         # device path opens the breaker and subsequent traffic fails fast
         # or degrades instead of piling on
         out = retry_call(_launch, breaker=self.breaker)
-        self._done.put(("batch", out, grp, t0))
+        for r in grp:
+            r.retries = attempts[0] - 1
+        self._done.put(("batch", out, grp, t0, (kid, tier, batch_id)))
 
     # -- completer thread ---------------------------------------------------
 
@@ -724,7 +790,8 @@ class QueryScheduler:
             _faults.serve_gate("sched.complete")
             try:
                 if item[0] == "batch":
-                    self._complete_batch(item[1], item[2], item[3])
+                    self._complete_batch(item[1], item[2], item[3],
+                                         item[4] if len(item) > 4 else None)
                 else:
                     self._complete_single(item[1])
             except Exception as e:
@@ -732,12 +799,14 @@ class QueryScheduler:
                 for r in reqs:
                     self._fail(r, e)
 
-    def _complete_batch(self, out, grp: List[Request], t0: float) -> None:
+    def _complete_batch(self, out, grp: List[Request], t0: float,
+                        attrib_key=None) -> None:
         # host-side LSM-delta counts first: they overlap the in-flight
         # device round trip instead of adding to it
         extras = [len(self.binding.delta_rows(r.delta, r.f_ir, r.auths))
                   if r.delta is not None else 0 for r in grp]
         _faults.serve_gate("sched.device_wait")
+        t_wait = _pc()
         try:
             counts = np.asarray(out)  # blocks until the device batch is ready
         except Exception:
@@ -745,7 +814,20 @@ class QueryScheduler:
             # already consumed its retries; the breaker learns either way)
             self.breaker.record_failure()
             raise
+        wait_s = _pc() - t_wait
         scan_s = _pc() - t0
+        if attrib_key is not None:
+            kid, tier, batch_id = attrib_key
+            # per-kernel device attribution + the per-dispatch wide event
+            _attrib.record_dispatch(kid, tier, wait_s)
+            if config.OBS_ENABLED.get():
+                _flight.RECORDER.record({
+                    "kind": "batch", "batch_id": batch_id,
+                    "type": grp[0].type_name, "kernel": kid,
+                    "batch_size": len(grp),
+                    "duration_ms": round(scan_s * 1000, 3),
+                    "device_ms": round(wait_s * 1000, 3),
+                    "rows_scanned": grp[0].rows_scanned})
         for i, r in enumerate(grp):
             r.batched = True
             r.batch_size = len(grp)

@@ -12,7 +12,9 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|scheduler|admission|wal [--format prometheus] [-s STORE -f NAME -q ECQL]
+    geomesa-tpu debug         metrics|traces|events|slo|kernels|scheduler|admission|wal
+                              [--format prometheus] [--slow MS] [--errors]
+                              [--kind K] [-s STORE -f NAME -q ECQL]
     geomesa-tpu recover       --dir DURABILITY_DIR
     geomesa-tpu describe / list / remove-schema
 """
@@ -287,8 +289,33 @@ def cmd_debug(args):
                        if k.startswith(("scheduler.", "kernels."))},
         }
         print(json.dumps(out, indent=2, default=str))
-    else:  # traces
-        print(json.dumps(RING.recent(args.limit), indent=2))
+    elif args.what == "events":
+        # the flight recorder: one wide event per query/count/batch, with
+        # the same filters the /events route takes
+        from geomesa_tpu.obs.flight import RECORDER
+        out = {"recorder": RECORDER.stats(),
+               "events": RECORDER.recent(limit=args.limit,
+                                         slow_ms=args.slow,
+                                         errors=args.errors,
+                                         kind=args.kind,
+                                         type_name=args.feature)}
+        print(json.dumps(out, indent=2, default=str))
+    elif args.what == "slo":
+        # burn-rate runbook surface: compliance + multi-window burn rates
+        # + page/ticket state per objective
+        from geomesa_tpu.obs.slo import ENGINE
+        print(json.dumps({"slo": ENGINE.evaluate()}, indent=2, default=str))
+    elif args.what == "kernels":
+        # per-kernel device cost attribution (dispatches, device wait,
+        # transfer bytes, compiles per kernel id + batch tier)
+        from geomesa_tpu.obs import attrib
+        print(json.dumps(attrib.snapshot(), indent=2, default=str))
+    else:  # traces — filtered through the shared flight-recorder predicate
+        from geomesa_tpu.obs.flight import matches
+        traces = [t for t in RING.recent(None)
+                  if matches(t, slow_ms=args.slow, errors=args.errors,
+                             kind=args.kind)]
+        print(json.dumps(traces[: args.limit], indent=2))
 
 
 def cmd_config(args):
@@ -407,18 +434,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_recover)
 
     sp = sub.add_parser(
-        "debug", help="dump metrics, recent query traces, scheduler state, "
-                      "admission/overload state, or the WAL segment "
-                      "inspector")
-    sp.add_argument("what", choices=("metrics", "traces", "scheduler",
-                                     "admission", "wal"))
+        "debug", help="dump metrics, recent query traces, flight-recorder "
+                      "events, SLO burn rates, per-kernel attribution, "
+                      "scheduler state, admission/overload state, or the "
+                      "WAL segment inspector")
+    sp.add_argument("what", choices=("metrics", "traces", "events", "slo",
+                                     "kernels", "scheduler", "admission",
+                                     "wal"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
-    sp.add_argument("-f", "--feature", help="feature type for the warm query")
+    sp.add_argument("-f", "--feature", help="feature type for the warm query "
+                                            "(also the type filter for "
+                                            "`debug events`)")
     sp.add_argument("-q", "--cql", help="ECQL filter for the warm query")
     sp.add_argument("--format", default="json",
                     choices=("json", "prometheus"))
     sp.add_argument("--limit", type=int, default=20,
-                    help="max traces to print")
+                    help="max traces/events to print")
+    # traces/events filters (the shared flight-recorder predicate)
+    sp.add_argument("--slow", type=float, default=None, metavar="MS",
+                    help="only records at least this slow")
+    sp.add_argument("--errors", action="store_true",
+                    help="only failed/shed/cancelled records")
+    sp.add_argument("--kind", default=None,
+                    help="match record kind / trace name / a span kind "
+                         "present in the stage breakdown")
     sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("serve", help="REST/GeoJSON API over a store")

@@ -151,15 +151,18 @@ class Span:
 
 
 class QueryTrace:
-    """One query's span tree (≙ one QueryEvent, with stage attribution)."""
+    """One query's span tree (≙ one QueryEvent, with stage attribution).
+    ``error`` is the exception type name when the traced block raised —
+    the tail sampler's keep-always signal."""
 
-    __slots__ = ("trace_id", "name", "ts_ms", "root")
+    __slots__ = ("trace_id", "name", "ts_ms", "root", "error")
 
     def __init__(self, name: str, attrs: Optional[dict]):
         self.trace_id = next(_ids)
         self.name = name
         self.ts_ms = int(time.time() * 1000)
         self.root = Span(name, "trace", attrs)
+        self.error: Optional[str] = None
 
     @property
     def duration_ms(self) -> float:
@@ -188,11 +191,14 @@ class QueryTrace:
         return sum(s.self_ms for s in self.spans()) / self.root.duration_ms
 
     def to_dict(self) -> dict:
-        return {"id": self.trace_id, "name": self.name, "ts_ms": self.ts_ms,
-                "duration_ms": round(self.duration_ms, 3),
-                "stages_ms": {k: round(v, 3)
-                              for k, v in self.self_times_ms().items()},
-                "root": self.root.to_dict()}
+        out = {"id": self.trace_id, "name": self.name, "ts_ms": self.ts_ms,
+               "duration_ms": round(self.duration_ms, 3),
+               "stages_ms": {k: round(v, 3)
+                             for k, v in self.self_times_ms().items()},
+               "root": self.root.to_dict()}
+        if self.error is not None:
+            out["error"] = self.error
+        return out
 
 
 class TraceRing:
@@ -200,32 +206,65 @@ class TraceRing:
     ≙ the reference's in-memory audit trail the `_queries` surface reads)."""
 
     def __init__(self, keep: int = 256):
-        self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=keep)
 
     def append(self, t: QueryTrace) -> None:
-        with self._lock:
-            self._ring.append(t)
+        # lockless: deque appends are GIL-atomic, and this sits on the
+        # trace-close hot path; readers retry the mutated-mid-copy race
+        self._ring.append(t)
 
     def recent(self, limit: Optional[int] = None) -> List[dict]:
         """Most-recent-first trace dicts, bounded by ``limit``."""
-        with self._lock:
-            items = list(self._ring)
+        while True:
+            try:
+                items = list(self._ring)
+                break
+            except RuntimeError:  # mutated during the copy — retry
+                continue
         items.reverse()
         if limit is not None:
             items = items[: max(0, int(limit))]
         return [t.to_dict() for t in items]
 
     def clear(self) -> None:
-        with self._lock:
-            self._ring.clear()
+        self._ring.clear()
 
     def __len__(self) -> int:
-        with self._lock:
-            return len(self._ring)
+        return len(self._ring)
 
 
 RING = TraceRing()
+
+# -- observability hooks (obs/ installs these; trace.py stays import-light) --
+#
+# Close hooks fire once per ROOT trace at close (tail sampling + flight-
+# recorder derivation, obs/flight.py / obs/sampling.py); the device hook
+# fires per device_fetch with (dispatch_s, wait_s) so per-kernel attribution
+# (obs/attrib.py) can charge device time to the kernel an ambient label
+# names. Both are None/empty by default — the hot path pays one read.
+
+_close_hooks: List = []
+_device_hook = None
+
+
+def add_close_hook(fn) -> None:
+    """Register ``fn(QueryTrace)`` to run at every root-trace close (after
+    the trace landed in RING). A raising hook is dropped from that close,
+    never the query. Idempotent per function object."""
+    if fn not in _close_hooks:
+        _close_hooks.append(fn)
+
+
+def remove_close_hook(fn) -> None:
+    if fn in _close_hooks:
+        _close_hooks.remove(fn)
+
+
+def set_device_hook(fn) -> None:
+    """Install ``fn(dispatch_s, wait_s)`` called from ``device_fetch`` —
+    the per-kernel device-cost attribution slot. None uninstalls."""
+    global _device_hook
+    _device_hook = fn
 
 
 def current_trace() -> Optional[QueryTrace]:
@@ -315,6 +354,9 @@ def device_fetch(block, dispatch, *args):
     t1 = _pc()
     out = block(out)
     t2 = _pc()
+    hook = _device_hook
+    if hook is not None:
+        hook(t1 - t0, t2 - t1)
     tr = _local.trace
     if tr is not None:
         parent = _local.stack[-1]
@@ -366,10 +408,18 @@ class trace:
         dt = _pc() - self._t0
         t = self._trace
         t.root.duration_ms = dt * 1000
+        if exc and exc[0] is not None:
+            t.error = exc[0].__name__
         _local.trace = None
         _local.stack = None
         RING.append(t)
         # deferred feed: the whole span tree drains into the histograms at
-        # the next snapshot — trace close pays one list append
-        _REGISTRY.feed_tree(t.root)
+        # the next snapshot — trace close pays one list append. The trace id
+        # rides along so retained traces become bucket exemplars at drain.
+        _REGISTRY.feed_tree(t.root, trace_id=t.trace_id)
+        for hook in _close_hooks:
+            try:
+                hook(t)
+            except Exception:
+                pass  # observability must never fail the query
         return False

@@ -25,12 +25,24 @@ Routes:
   GET  /types/{t}/count?cql=           → {"count": n}  (concurrent requests
                                          coalesce through the micro-batching
                                          scheduler, serve/scheduler.py)
-  GET  /types/{t}/explain?cql=         → query plan JSON (+ dry-run trace tree)
+  GET  /types/{t}/explain?cql=&analyze=1 → query plan JSON (+ dry-run trace
+                                         tree; analyze=1 EXECUTES the plan
+                                         and annotates spans with device ms
+                                         and cache provenance)
   GET  /types/{t}/stats?stat=<dsl>     → stat sketch JSON
   POST /types/{t}/features             → ingest a GeoJSON FeatureCollection
   GET  /metrics                        → metrics snapshot (JSON)
-  GET  /metrics?format=prometheus      → Prometheus text exposition
+  GET  /metrics?format=prometheus      → Prometheus text exposition (native
+                                         _bucket lines carry exemplar trace
+                                         ids where a retained trace exists)
   GET  /traces?limit=N                 → recent query traces, newest first
+  GET  /traces?retained=1              → the tail-sampled ring (errors, slow
+                                         outliers, sampled rest)
+  GET  /events?slow_ms=&error=1&kind=&type=&limit=
+                                       → flight-recorder wide events (one
+                                         per query/count/batch), filtered
+  GET  /slo                            → SLO burn-rate evaluation (5m/30m/
+                                         1h/6h windows, page/ticket state)
   GET  /scheduler                      → scheduler state (queue depth, batch
                                          histogram, cache hit rates)
   GET  /durability                     → WAL/snapshot status (policy, seq,
@@ -135,7 +147,29 @@ class GeoJsonApi:
         if parts == ["traces"]:
             from geomesa_tpu.trace import RING
             limit = int(query.get("limit", [50])[0])
+            if query.get("retained", [None])[0] not in (None, "0", "false"):
+                # the tail-sampled ring: errors/cancel/shed/degrade always,
+                # slow outliers past the adaptive threshold, plus the
+                # probabilistic sample — what /metrics exemplars link to
+                from geomesa_tpu.obs.sampling import SAMPLER
+                return 200, {"traces": SAMPLER.recent(limit),
+                             "sampler": SAMPLER.stats()}
             return 200, {"traces": RING.recent(limit)}
+        if parts == ["events"]:
+            # flight recorder: wide events filtered by the shared predicate
+            from geomesa_tpu.obs.flight import RECORDER
+            slow = query.get("slow_ms", [None])[0]
+            return 200, {"events": RECORDER.recent(
+                limit=int(query.get("limit", [100])[0]),
+                slow_ms=float(slow) if slow is not None else None,
+                errors=query.get("error", [None])[0]
+                not in (None, "0", "false"),
+                kind=query.get("kind", [None])[0],
+                type_name=query.get("type", [None])[0]),
+                "recorder": RECORDER.stats()}
+        if parts == ["slo"]:
+            from geomesa_tpu.obs.slo import ENGINE
+            return 200, {"slo": ENGINE.evaluate()}
         if parts == ["scheduler"]:
             return 200, self.store.scheduler().stats()
         if parts == ["durability"]:
@@ -158,10 +192,16 @@ class GeoJsonApi:
                             "queue_depth": sched._queue.qsize(),
                             "admission": sched.admission.stats(),
                             "breaker": sched.breaker.stats()}
+            from geomesa_tpu.obs.slo import ENGINE as _slo_engine
+            try:
+                slo = _slo_engine.summary()
+            except Exception:
+                slo = {"status": "unknown"}
             return 200, {"status": "ok",
                          "devices": len(jax.local_devices()),
                          "types": len(self.store.get_type_names()),
                          "overload": overload,
+                         "slo": slo,
                          "durability": {
                              "enabled": d is not None,
                              "wal_policy": d.wal.policy if d else None,
@@ -214,7 +254,10 @@ class GeoJsonApi:
                     out["reason"] = n.reason
                 return 200, out
             if rest == ["explain"]:
-                out = self.store.explain(t, cql)
+                analyze = query.get("analyze", [None])[0] \
+                    not in (None, "0", "false")
+                out = self.store.explain(t, cql, analyze=analyze,
+                                         auths=auths)
                 return 200, json.loads(json.dumps(out, default=str))
             if rest == ["stats"]:
                 stat = query.get("stat", [None])[0]

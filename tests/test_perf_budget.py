@@ -245,3 +245,53 @@ def test_tracing_overhead_under_5pct():
     assert overhead < 0.05, (
         f"tracing overhead {overhead:.1%} (traced {traced * 1e6:.0f}us vs "
         f"disabled {base * 1e6:.0f}us)")
+
+
+def test_obs_flight_recorder_overhead_under_5pct():
+    """ISSUE 5 acceptance bar: with the flight recorder + tail sampling
+    enabled AT DEFAULTS (obs hooks installed, wide event per query, sampling
+    decision per trace close, kernel attribution labels), a count query's
+    cost stays <5% over observability disabled. Same interleaved-minima
+    estimator as the tracing guard — each rep times one disabled and one
+    fully-observed call back to back."""
+    from geomesa_tpu import config, obs, trace
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.features.table import FeatureTable
+    from geomesa_tpu.obs.flight import RECORDER
+    from geomesa_tpu.obs.sampling import SAMPLER
+
+    obs.install()
+    rng = np.random.default_rng(6)
+    n = 10_000
+    ds = TpuDataStore()
+    ds.create_schema("ov2", "v:Int,*geom:Point")
+    ds.load("ov2", FeatureTable.build(ds.get_schema("ov2"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "geom": (rng.uniform(-20, 20, n), rng.uniform(-20, 20, n))}))
+    planner = ds.planner("ov2")
+    q = "BBOX(geom, -5, -5, 5, 5)"
+
+    def timed():
+        t0 = time.perf_counter()
+        planner.count(q)
+        return time.perf_counter() - t0
+
+    def measure():
+        base = observed = float("inf")
+        for _ in range(400):
+            with trace.disabled():  # also mutes close hooks (no root trace)
+                base = min(base, timed())
+            observed = min(observed, timed())
+        return observed / base - 1.0, base, observed
+
+    planner.count(q)  # warm
+    # defaults on: OBS enabled, sampling/flight at their shipped rates
+    for p in (config.OBS_ENABLED, config.OBS_SAMPLE, config.OBS_SLOW_MS):
+        p.unset()
+    RECORDER.clear()
+    SAMPLER.clear()
+    overhead, base, observed = min(measure() for _ in range(3))
+    assert len(RECORDER), "flight events must actually have been recorded"
+    assert overhead < 0.05, (
+        f"obs overhead {overhead:.1%} (observed {observed * 1e6:.0f}us vs "
+        f"disabled {base * 1e6:.0f}us)")

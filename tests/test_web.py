@@ -104,8 +104,11 @@ def test_metrics_prometheus_exposition(server):
         assert r.headers["Content-Type"].startswith("text/plain")
         text = r.read().decode()
     assert "NaN" not in text
+    # sample lines may carry an OpenMetrics exemplar suffix on histogram
+    # buckets backed by a tail-retained trace (# {trace_id="N"} value)
     line_re = re.compile(
-        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$")
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+"
+        r"( # \{[^}]*\} -?[0-9.eE+-]+)?$")
     for line in text.strip().split("\n"):
         if not line.startswith("#"):
             assert line_re.match(line), line
