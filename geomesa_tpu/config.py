@@ -325,6 +325,33 @@ SLO_AVAIL_TARGET = _register(
     "Target success-fraction for the default availability SLO (sheds, "
     "deadline cancellations and worker deaths spend its budget).")
 
+# -- device profiling + perf regression watch (obs/profiling, obs/perfwatch) --
+
+PROFILING_ENABLED = _register(
+    "GEOMESA_TPU_PROFILING", True, _parse_bool,
+    "Master switch for device-level kernel profiling: per-kernel XLA "
+    "cost_analysis (flops/bytes gauges), compile telemetry, recompile "
+    "detection (kernels.recompiles + flight events), and index-build "
+    "phase progress. All costs land at compile/build time — the "
+    "steady-state dispatch path pays one wrapper call.")
+
+PERFWATCH_K = _register(
+    "GEOMESA_TPU_PERFWATCH_K", 4.0, float,
+    "Noise threshold for bench regression gating: a metric flags only "
+    "past baseline median + k*MAD (in its bad direction). CI perf-smoke "
+    "runs with the looser k=3 plus the relative floor.")
+
+PERFWATCH_MIN_REL = _register(
+    "GEOMESA_TPU_PERFWATCH_MIN_REL", 0.10, float,
+    "Relative noise floor for regression gating: deltas under this "
+    "fraction of the baseline median never flag, even when k*MAD is "
+    "smaller (few-sample baselines can have MAD ~0).")
+
+BENCH_MINI_N = _register(
+    "GEOMESA_TPU_BENCH_MINI_N", 200_000, int,
+    "Corpus size for bench.py --mini (the CI-runnable deterministic "
+    "mini-bench the perf-smoke regression gate measures).")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

@@ -36,6 +36,28 @@ LON31 = NormalizedLon(31)
 LAT31 = NormalizedLat(31)
 
 
+def memory_snapshot() -> Dict[str, int]:
+    """Live/peak HBM pressure summed over local devices, from each
+    backend's ``memory_stats()`` (absent keys are omitted — the CPU
+    backend reports nothing, TPU/GPU report live, peak and limit). The
+    device-memory gauge feed (metrics.register_device_gauges) and the
+    ``debug kernels`` header."""
+    import jax
+    out: Dict[str, int] = {}
+    for d in jax.local_devices():
+        stats = getattr(d, "memory_stats", None)
+        s = stats() if stats is not None else None
+        if not s:
+            continue
+        for src, dst in (("bytes_in_use", "bytes_in_use"),
+                         ("peak_bytes_in_use", "peak_bytes_in_use"),
+                         ("bytes_limit", "bytes_limit"),
+                         ("num_allocs", "num_allocs")):
+            if src in s:
+                out[dst] = out.get(dst, 0) + int(s[src])
+    return out
+
+
 def fp62(x, lo: float, hi: float):
     """62-bit fixed-point normalization of a coordinate, split into two int32
     planes (hi = top 31 bits, lo = bottom 31).

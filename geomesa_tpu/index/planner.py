@@ -213,6 +213,20 @@ class QueryPlanner:
             "n_boxes": 0 if plan.boxes_loose is None else len(plan.boxes_loose),
             "n_windows": 0 if plan.windows is None else len(plan.windows),
         })
+        # how the serving index was built (the GET /progress history for
+        # this type + the owning index's per-stage timings): a slow query
+        # on a freshly-built index explains against its build, not a void
+        if plan.index is not None:
+            build: Dict[str, object] = {}
+            stages = getattr(plan.index, "build_stages", None)
+            if stages:
+                build["stages"] = dict(stages)
+            from geomesa_tpu.obs.profiling import PROGRESS
+            phases = PROGRESS.recent(type_name=self.sft.name, limit=8)
+            if phases:
+                build["recent_phases"] = phases
+            if build:
+                out["build"] = build
         if analyze and t is not None:
             stages = t.self_times_ms()
             device_ms = stages.get("device_scan", 0.0) \

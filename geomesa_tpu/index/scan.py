@@ -32,6 +32,7 @@ import numpy as np
 from geomesa_tpu import trace as _trace
 from geomesa_tpu.filter import ir
 from geomesa_tpu.obs import attrib as _attrib
+from geomesa_tpu.obs import profiling as _prof
 
 
 def _fetch(dispatch, *args):
@@ -497,6 +498,11 @@ class ScanKernels:
         self.cols = device_cols
         from collections import OrderedDict
         self._jitted: "OrderedDict[tuple, Callable]" = OrderedDict()
+        # kernel_id -> signature hashes already compiled by THIS instance:
+        # the recompile detector's memory (obs/profiling.note_signature) —
+        # per-instance so two indexes compiling their own kernels never
+        # read as shape churn
+        self._sig_seen: Dict[str, set] = {}
         _KERNEL_INSTANCES.add(self)
         _register_kernel_gauge()
         warm_transfer_shapes()
@@ -755,12 +761,23 @@ class ScanKernels:
             raise ValueError(mode)
 
         jitted = jax.jit(run)
-        if _attrib.enabled():
+        kid = f"{mode}.{primary_kind}"
+        if _prof.enabled():
+            # recompile detection: a second distinct signature for this
+            # kernel id (or a re-jit of an evicted one) is shape churn —
+            # counted + flight-evented with the triggering shape. The
+            # probe then times the first invocation's XLA compile and
+            # captures the kernel's cost analysis (flops/bytes gauges).
+            _prof.note_signature(self._sig_seen, kid, key, shape={
+                "mode": mode, "primary": primary_kind,
+                "residual": residual_key, "n_boxes": n_boxes,
+                "n_windows": n_windows, "capacity": repr(capacity)})
+            jitted = _prof.kernel_probe(jitted, kid, n_boxes)
+        elif _attrib.enabled():
             # per-(kernel, tier) compile attribution: the first invocation
             # is where XLA traces + compiles, and that cost lands on the
             # kernel's labeled series instead of vanishing into one query
-            jitted = _attrib.compile_probe(
-                jitted, f"{mode}.{primary_kind}", n_boxes)
+            jitted = _attrib.compile_probe(jitted, kid, n_boxes)
         self._jitted[key] = jitted
         from geomesa_tpu import config
         # NB fresh name: the mode closures above capture _get locals (cap,
@@ -1049,8 +1066,9 @@ class ScanKernels:
                        (b.shape[0], block_size, 0, m))
         q = jnp.asarray(np.array([qx, qy], dtype=np.float32))
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        vals, idxs = _fetch(fn, self.cols, _dev(boxes), _dev(windows),
-                            rp, q, jnp.asarray(b))
+        with _attrib.kernel(f"topk_blocks.{primary_kind}", b.shape[0]):
+            vals, idxs = _fetch(fn, self.cols, _dev(boxes), _dev(windows),
+                                rp, q, jnp.asarray(b))
         return np.asarray(vals), np.asarray(idxs)
 
     def topk_nearest(self, primary_kind, boxes, windows, residual,
@@ -1065,8 +1083,9 @@ class ScanKernels:
                        0 if windows is None else windows.shape[0], m)
         q = jnp.asarray(np.array([qx, qy], dtype=np.float32))
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
-        vals, idxs = _fetch(fn, self.cols, _dev(boxes), _dev(windows),
-                            rp, q)
+        with _attrib.kernel(f"topk.{primary_kind}", m):
+            vals, idxs = _fetch(fn, self.cols, _dev(boxes), _dev(windows),
+                                rp, q)
         return np.asarray(vals), np.asarray(idxs)
 
     def select(self, primary_kind, boxes, windows, residual, capacity: int):

@@ -307,6 +307,7 @@ class BaseSpatialIndex:
         self._perm_cache: Optional[np.ndarray] = None
         self._dev_perm = None
         n = len(table)
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress
         if not self._build_native():
             keys = self._sort_keys()
             if keys is None:
@@ -314,14 +315,24 @@ class BaseSpatialIndex:
                 self.device = DeviceTable.build(table, self._perm_cache, self.period)
             elif n >= sys.modules[__name__].DEVICE_SORT_MIN_ROWS and all(
                     k.dtype == np.int32 for k in keys):
-                self._dev_perm = device_sort_perm(keys)
-                self.device = DeviceTable.build_on_device(
-                    table, self._dev_perm, self.period)
+                with _progress.phase("device_sort", rows=n,
+                                     type_name=sft.name):
+                    self._dev_perm = device_sort_perm(keys)
+                with _progress.phase("upload_gather", rows=n,
+                                     type_name=sft.name):
+                    self.device = DeviceTable.build_on_device(
+                        table, self._dev_perm, self.period)
                 self._prefetch_perm()
             else:
                 # np.lexsort sorts by LAST key first → reverse to major-first
-                self._perm_cache = np.lexsort(tuple(reversed(keys))).astype(np.int64)
-                self.device = DeviceTable.build(table, self._perm_cache, self.period)
+                with _progress.phase("host_sort", rows=n,
+                                     type_name=sft.name):
+                    self._perm_cache = np.lexsort(
+                        tuple(reversed(keys))).astype(np.int64)
+                with _progress.phase("upload_gather", rows=n,
+                                     type_name=sft.name):
+                    self.device = DeviceTable.build(
+                        table, self._perm_cache, self.period)
         import time as _time
         _t = _time.perf_counter()
         self.kernels = ScanKernels(self.device.columns)
@@ -436,8 +447,11 @@ class BaseSpatialIndex:
                 and n >= sys.modules[__name__].DEVICE_SORT_MIN_ROWS):
             return None
         import time as _time
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress
         t0 = _time.perf_counter()
-        res = _stream_encode_upload(encode_chunk, n, chunk)
+        with _progress.phase("encode_upload", rows=n,
+                             type_name=self.sft.name):
+            res = _stream_encode_upload(encode_chunk, n, chunk)
         if res is None:
             return False
         dev, host_kept = res
@@ -486,14 +500,17 @@ class BaseSpatialIndex:
         # through the host link and no host pad pass; the program is keyed
         # by n already, so device-side padding adds no compilations)
         import time as _time
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress
         t0 = _time.perf_counter()
-        dev_keys = [jax.device_put(k) for k in keys]
-        dev_cols = {k: jax.device_put(v) for k, v in upload.items()}
-        jax.block_until_ready(dev_keys + list(dev_cols.values()))
+        with _progress.phase("upload", rows=n, type_name=self.sft.name):
+            dev_keys = [jax.device_put(k) for k in keys]
+            dev_cols = {k: jax.device_put(v) for k, v in upload.items()}
+            jax.block_until_ready(dev_keys + list(dev_cols.values()))
         t1 = _time.perf_counter()
-        self._dev_perm, cols = _native_sort_gather(
-            tuple(dev_keys), dev_cols, n)
-        jax.block_until_ready(self._dev_perm)
+        with _progress.phase("sort_gather", rows=n, type_name=self.sft.name):
+            self._dev_perm, cols = _native_sort_gather(
+                tuple(dev_keys), dev_cols, n)
+            jax.block_until_ready(self._dev_perm)
         t2 = _time.perf_counter()
         # per-stage build timings (≙ the profile the reference exposes via
         # MethodProfiling around its writers); bench surfaces these so a

@@ -405,7 +405,11 @@ class MetricsRegistry:
             lines.append(f"{m} {v}")
         for name, g in sorted(gauges.items()):
             m = sane(name)
-            lines.append(f"# TYPE {m} gauge")
+            # lazily-sampled monotone process totals (process.cpu_seconds_
+            # total et al.) register as gauges but ARE counters; the
+            # _total suffix is the contract and the exposition honors it
+            lines.append(f"# TYPE {m} "
+                         f"{'counter' if name.endswith('_total') else 'gauge'}")
             lines.append(f"{m} {float(g):g}")
         for name, (h, buckets, total_s) in sorted(timers.items()):
             m = sane(name) + "_seconds"
@@ -454,14 +458,18 @@ _DEVICE_GAUGES_REGISTERED = False
 
 
 def register_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
-    """Install lazy device + host-pressure gauges: ``device.count`` and
-    ``device.bytes_in_use`` (summed ``memory_stats()`` over
-    ``jax.local_devices()`` where the backend reports them), plus
-    ``process.rss_bytes`` (host resident set), ``trace.ring_depth``
-    (recent-trace ring occupancy) and ``wal.open_segments`` (live WAL
-    segment files) — so /metrics reflects host memory and observability-
-    buffer pressure, not just device state. Idempotent; probes evaluate at
-    snapshot time and never raise through the surface."""
+    """Install lazy device + host-pressure gauges: ``device.count``,
+    ``device.bytes_in_use`` / ``device.peak_bytes_in_use`` /
+    ``device.bytes_limit`` (summed ``memory_stats()`` over
+    ``jax.local_devices()`` where the backend reports them — live AND
+    peak HBM so an OOM trajectory is visible before it lands), plus
+    ``process.rss_bytes`` (host resident set),
+    ``process.cpu_seconds_total`` (monotone user+sys CPU, exported as a
+    counter), ``trace.ring_depth`` (recent-trace ring occupancy) and
+    ``wal.open_segments`` (live WAL segment files) — so /metrics reflects
+    host memory and observability-buffer pressure, not just device state.
+    Idempotent; probes evaluate at snapshot time and never raise through
+    the surface."""
     global _DEVICE_GAUGES_REGISTERED
     reg = registry or REGISTRY
     if reg is REGISTRY and _DEVICE_GAUGES_REGISTERED:
@@ -473,16 +481,17 @@ def register_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
         import jax
         return len(jax.local_devices())
 
-    def _mem():
-        import jax
-        total, seen = 0, False
-        for d in jax.local_devices():
-            stats = getattr(d, "memory_stats", None)
-            s = stats() if stats is not None else None
-            if s and "bytes_in_use" in s:
-                total += int(s["bytes_in_use"])
-                seen = True
-        return total if seen else None
+    def _mem_key(key):
+        def probe():
+            from geomesa_tpu.index.device import memory_snapshot
+            return memory_snapshot().get(key)
+        return probe
+
+    def _cpu_seconds():
+        # user + system CPU of this process — monotone, so the gauge
+        # exports as a counter (the _total contract in to_prometheus)
+        t = os.times()
+        return round(t[0] + t[1], 3)
 
     def _rss():
         # current (not peak) resident set via /proc; ru_maxrss fallback
@@ -504,7 +513,10 @@ def register_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
         return open_segment_count()
 
     reg.set_gauge("device.count", _count)
-    reg.set_gauge("device.bytes_in_use", _mem)
+    reg.set_gauge("device.bytes_in_use", _mem_key("bytes_in_use"))
+    reg.set_gauge("device.peak_bytes_in_use", _mem_key("peak_bytes_in_use"))
+    reg.set_gauge("device.bytes_limit", _mem_key("bytes_limit"))
     reg.set_gauge("process.rss_bytes", _rss)
+    reg.set_gauge("process.cpu_seconds_total", _cpu_seconds)
     reg.set_gauge("trace.ring_depth", _ring_depth)
     reg.set_gauge("wal.open_segments", _wal_segments)

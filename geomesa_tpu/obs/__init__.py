@@ -1,5 +1,9 @@
 """Request-centric observability: flight recorder, tail-sampled traces
-with exemplars, per-kernel device cost attribution, SLO burn rates.
+with exemplars, per-kernel device cost attribution, SLO burn rates —
+plus the performance observatory (ISSUE 6): device-level kernel
+profiling with recompile detection and build-phase progress
+(obs/profiling.py) and the noise-aware bench regression gate
+(obs/perfwatch.py).
 
 Layered ON TOP of trace.py/metrics.py (which stay import-light and
 hook-based): ``install()`` wires

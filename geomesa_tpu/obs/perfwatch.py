@@ -1,0 +1,404 @@
+"""Noise-aware bench regression gating against a committed baseline store.
+
+The problem this solves (ISSUE 6): cfg4 KNN regressed 472 -> 614 ms
+between bench rounds and only a human scanning raw BENCH json blobs
+noticed. From this PR on, every bench run emits a flat machine-stable
+``BENCH_summary.json`` and ``bench.py --check`` / ``geomesa-tpu
+perfwatch`` compare it against ``perf/baselines.json`` (committed), with
+three properties an absolute-threshold gate lacks:
+
+  noise-aware   each metric's baseline is a rolling sample window with
+                median + MAD (median absolute deviation — robust to the
+                occasional loaded-runner outlier the mean is not); a
+                run flags only past ``median + k * MAD`` in the metric's
+                BAD direction, floored by a relative band
+                (PERFWATCH_MIN_REL) so few-sample baselines with MAD ~0
+                don't flag measurement jitter. An unmodified back-to-back
+                run must never flag.
+  direction-aware   ``_qps`` / ``_per_s`` / throughput metrics regress
+                DOWN, ``_ms`` / ``_s`` / bytes regress UP, and count
+                metrics (``_matched`` / ``_mass``) are exact — any drift
+                there is a correctness bug, not noise.
+  attributing   each summary carries the per-kernel attribution snapshot
+                (obs/attrib); the comparator diffs per-kernel device-wait
+                means, compile counts and recompiles between run and
+                baseline and NAMES the kernel whose cost moved — the
+                report says "cfg4_knn10_ms regressed 2.1x; culprit
+                kernel.topk_blocks.point_boxes.b8 device_wait +105%",
+                not just "something got slower".
+
+Machine normalization: baselines record a host-speed proxy (the pure-CPU
+indexed count, ``cfg0_cpu_1m_bbox_p50_ms``). When a run's proxy differs
+from the baseline's (CI runner vs the bench box), duration/throughput
+medians scale by the clamped proxy ratio before comparison, so the
+committed baselines gate loosely-but-sanely on foreign machines while
+staying tight on the machine that recorded them.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+from geomesa_tpu import config
+
+SCHEMA = 1
+# host-speed proxy metric: pure-CPU work, present in every mini run
+SPEED_PROXY = "cfg0_cpu_1m_bbox_p50_ms"
+# samples kept per metric in the rolling baseline window
+KEEP_SAMPLES = 12
+
+# -- metric directions --------------------------------------------------------
+
+_HIGHER = ("_qps", "_per_s", "_per_chip", "_mbps", "_hit_rate",
+           "_gb_per_s", "upload_mbps")
+_EXACT = ("_matched", "_mass", "_pairs", "_blocks", "_submitted")
+_LOWER = ("_ms", "_s", "_us", "_bytes", "_kb", "_pct", "_seconds",
+          "_slop", "_fraction")
+# metrics whose suffix misleads (shed rate is workload-set, not a perf
+# axis; raw sizes describe the corpus, not the code)
+_OVERRIDES = {
+    "cfg7_overload_shed_rate": "skip",
+    "n_points": "skip", "host_cores": "skip", "value": "skip",
+}
+
+
+def metric_direction(name: str) -> str:
+    """'lower' (regression = value UP), 'higher' (regression = DOWN),
+    'exact' (any drift at equal scale is a correctness flag), or 'skip'
+    (non-gated informational metric)."""
+    o = _OVERRIDES.get(name)
+    if o is not None:
+        return o
+    if name.endswith(_HIGHER) or "qps" in name or "vs_" in name:
+        return "higher"
+    if name.endswith(_EXACT):
+        return "exact"
+    if name.endswith(_LOWER):
+        return "lower"
+    return "skip"
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _mad(xs: List[float], med: Optional[float] = None) -> float:
+    if not xs:
+        return 0.0
+    m = _median(xs) if med is None else med
+    return _median([abs(x - m) for x in xs])
+
+
+# -- baseline store -----------------------------------------------------------
+
+
+def empty_baselines() -> dict:
+    return {"schema": SCHEMA, "updated_ts": None, "meta": {},
+            "metrics": {}, "kernels": {}}
+
+
+def load_baselines(path: str) -> dict:
+    with open(path) as fh:
+        b = json.load(fh)
+    if b.get("schema") != SCHEMA:
+        raise ValueError(f"baseline schema {b.get('schema')} != {SCHEMA}")
+    return b
+
+
+def save_baselines(baselines: dict, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(baselines, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def kernel_summary(attrib_snapshot: dict) -> Dict[str, dict]:
+    """Reduce an obs/attrib snapshot to the flat per-kernel numbers the
+    comparator diffs: kernel series name -> {wait_mean_ms, dispatches,
+    compiles, compile_total_ms, transfer_bytes, flops, hbm_bytes}."""
+    out: Dict[str, dict] = {}
+
+    def k(name: str) -> dict:
+        # kernel.<id>.b<tier>.<metric> -> kernel.<id>.b<tier>
+        base = name.rsplit(".", 1)[0]
+        return out.setdefault(base, {})
+
+    for name, h in (attrib_snapshot.get("timers") or {}).items():
+        if name.endswith(".device_wait"):
+            d = k(name)
+            # one device round trip = host enqueue + block-until-ready;
+            # the .dispatch series carries the enqueue side on direct
+            # paths, so the per-kernel mean folds both
+            d["wait_mean_ms"] = round(
+                d.get("wait_mean_ms", 0.0) + h.get("mean_ms", 0.0), 4)
+            d["dispatches"] = h.get("count", 0)
+        elif name.endswith(".dispatch"):
+            d = k(name)
+            d["wait_mean_ms"] = round(
+                d.get("wait_mean_ms", 0.0) + h.get("mean_ms", 0.0), 4)
+        elif name.endswith(".compile"):
+            k(name)["compile_total_ms"] = round(
+                h.get("total_s", 0.0) * 1000, 3)
+    for name, v in (attrib_snapshot.get("counters") or {}).items():
+        if name.endswith(".compiles"):
+            k(name)["compiles"] = v
+        elif name.endswith(".transfer_bytes"):
+            k(name)["transfer_bytes"] = v
+    for name, v in (attrib_snapshot.get("gauges") or {}).items():
+        if name.endswith((".flops", ".hbm_bytes")):
+            k(name)[name.rsplit(".", 1)[1]] = v
+    return {name: d for name, d in out.items() if d}
+
+
+def update_baselines(baselines: dict, summary: dict,
+                     keep: int = KEEP_SAMPLES) -> dict:
+    """Fold one run summary into the rolling baseline store: append each
+    metric's value to its sample window (bounded to ``keep``), recompute
+    median + MAD, refresh the kernel reference snapshot and meta. Returns
+    the same dict, mutated."""
+    metrics = summary.get("metrics") or {}
+    store = baselines.setdefault("metrics", {})
+    for name, value in metrics.items():
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        if metric_direction(name) == "skip":
+            continue
+        ent = store.setdefault(name, {"samples": []})
+        ent["samples"] = (ent["samples"] + [float(value)])[-keep:]
+        med = _median(ent["samples"])
+        ent["median"] = round(med, 6)
+        ent["mad"] = round(_mad(ent["samples"], med), 6)
+        ent["direction"] = metric_direction(name)
+    baselines["kernels"] = summary.get("kernels") or {}
+    baselines["meta"] = summary.get("meta") or {}
+    baselines["updated_ts"] = int(time.time())
+    baselines["runs"] = int(baselines.get("runs") or 0) + 1
+    return baselines
+
+
+# -- comparison ---------------------------------------------------------------
+
+
+def _speed_ratio(run_metrics: dict, baselines: dict) -> float:
+    """run-host / baseline-host speed ratio from the CPU proxy metric.
+    A DEADBAND treats ratios within [0.67, 1.5] as 1.0 — the proxy itself
+    is a wall measurement, and letting its run-to-run noise rescale every
+    threshold would hide same-machine regressions. Beyond the deadband
+    (a genuinely different machine, e.g. a CI runner vs the bench box)
+    the ratio applies, clamped to [0.5, 4]. 1.0 when either side lacks
+    the proxy."""
+    ent = (baselines.get("metrics") or {}).get(SPEED_PROXY)
+    now = run_metrics.get(SPEED_PROXY)
+    if not ent or not ent.get("median") or not now:
+        return 1.0
+    raw = float(now) / float(ent["median"])
+    if 0.67 <= raw <= 1.5:
+        return 1.0
+    return max(0.5, min(4.0, raw))
+
+
+def compare(summary: dict, baselines: dict,
+            k: Optional[float] = None,
+            min_rel: Optional[float] = None) -> dict:
+    """One run summary vs the baseline store -> structured report.
+
+    A metric flags as a regression only when its delta (in the bad
+    direction) exceeds BOTH ``k * MAD`` and ``min_rel * median`` past the
+    (machine-normalized) baseline median. Improvements past the same band
+    in the good direction are reported but never fail the gate. Exact
+    metrics flag on any difference when the run scale matches the
+    baseline scale (same n_points)."""
+    k = float(config.PERFWATCH_K.get() if k is None else k)
+    min_rel = float(config.PERFWATCH_MIN_REL.get()
+                    if min_rel is None else min_rel)
+    run_metrics = summary.get("metrics") or {}
+    base_metrics = baselines.get("metrics") or {}
+    ratio = _speed_ratio(run_metrics, baselines)
+    same_scale = (summary.get("meta") or {}).get("n_points") \
+        == (baselines.get("meta") or {}).get("n_points")
+
+    regressions, improvements, missing, new = [], [], [], []
+    checked = 0
+    for name, ent in sorted(base_metrics.items()):
+        direction = ent.get("direction") or metric_direction(name)
+        if direction == "skip":
+            continue
+        if name not in run_metrics \
+                or not isinstance(run_metrics[name], (int, float)):
+            missing.append(name)
+            continue
+        value = float(run_metrics[name])
+        median = float(ent.get("median") or 0.0)
+        mad = float(ent.get("mad") or 0.0)
+        checked += 1
+        if direction == "exact":
+            if same_scale and value != median:
+                regressions.append({
+                    "metric": name, "kind": "value_changed",
+                    "value": value, "baseline": median,
+                    "note": "exact metric drifted at equal scale "
+                            "(correctness, not noise)"})
+            continue
+        # machine normalization applies to measured quantities only
+        scaled = median * ratio if direction == "lower" else median / ratio
+        noise = ratio if direction == "lower" else 1.0 / ratio
+        samples = ent.get("samples") or ()
+        # the baseline's own observed spread is an empirical noise
+        # envelope: never flag a delta the baseline runs themselves
+        # exhibited (few-sample MAD underestimates loaded-host swing)
+        span = (max(samples) - min(samples)) if len(samples) >= 2 else 0.0
+        threshold = max(k * mad * noise, span * noise,
+                        min_rel * abs(scaled))
+        if name.endswith(("_ms", "_s")):
+            # measurement-resolution floor: sub-0.05 deltas on rounded
+            # duration metrics are timer quantization, not signal
+            threshold = max(threshold, 0.05)
+        delta = value - scaled if direction == "lower" else scaled - value
+        rec = {
+            "metric": name, "value": value, "baseline": median,
+            "baseline_scaled": round(scaled, 6), "mad": mad,
+            "threshold": round(threshold, 6),
+            "ratio": round(value / scaled, 3) if scaled else None,
+            "samples": len(ent.get("samples") or ()),
+        }
+        if delta > threshold:
+            rec["kind"] = "regression"
+            rec["severity"] = round(delta / threshold, 2)
+            regressions.append(rec)
+        elif -delta > threshold:
+            rec["kind"] = "improvement"
+            improvements.append(rec)
+    for name in sorted(run_metrics):
+        if name not in base_metrics \
+                and metric_direction(name) != "skip" \
+                and isinstance(run_metrics[name], (int, float)):
+            new.append(name)
+
+    regressions.sort(key=lambda r: -(r.get("severity") or math.inf))
+    report = {
+        "schema": SCHEMA,
+        "ok": not regressions,
+        "k": k, "min_rel": min_rel, "speed_ratio": round(ratio, 3),
+        "same_scale": bool(same_scale),
+        "checked": checked,
+        "regressions": regressions,
+        "improvements": improvements,
+        "missing_metrics": missing,
+        "new_metrics": new,
+        "kernels": attribute_kernels(summary.get("kernels") or {},
+                                     baselines.get("kernels") or {},
+                                     ratio),
+    }
+    return report
+
+
+def attribute_kernels(run_kernels: Dict[str, dict],
+                      base_kernels: Dict[str, dict],
+                      ratio: float = 1.0,
+                      min_rel: float = 0.25,
+                      min_abs_ms: float = 0.05) -> dict:
+    """Diff the per-kernel attribution snapshots and name the kernels
+    whose device cost moved — the 'which kernel did it' half of the
+    report. A kernel flags when its mean device wait grew > ``min_rel``
+    past the machine-normalized baseline AND by at least ``min_abs_ms``,
+    or when it compiled where the baseline did not (recompile churn)."""
+    moved: List[dict] = []
+    for name, now in sorted(run_kernels.items()):
+        base = base_kernels.get(name)
+        if base is None:
+            continue
+        w_now = now.get("wait_mean_ms")
+        w_base = base.get("wait_mean_ms")
+        if w_now is not None and w_base:
+            scaled = w_base * ratio
+            if w_now > scaled * (1 + min_rel) \
+                    and (w_now - scaled) > min_abs_ms:
+                moved.append({
+                    "kernel": name, "kind": "device_wait",
+                    "wait_mean_ms": w_now,
+                    "baseline_ms": w_base,
+                    "ratio": round(w_now / scaled, 2)})
+        c_now = now.get("compiles") or 0
+        c_base = base.get("compiles") or 0
+        if c_now > c_base:
+            moved.append({
+                "kernel": name, "kind": "compiles",
+                "compiles": c_now, "baseline": c_base,
+                "note": "compiled more than baseline — recompile/shape "
+                        "churn suspect"})
+    moved.sort(key=lambda m: -(m.get("ratio") or 2.0))
+    out = {"moved": moved}
+    if moved:
+        out["culprit"] = moved[0]["kernel"]
+    return out
+
+
+def render(report: dict) -> str:
+    """Human-readable regression report (stderr / CI log / runbook)."""
+    lines = []
+    status = "OK" if report["ok"] else "REGRESSED"
+    lines.append(
+        f"perfwatch: {status} — {len(report['regressions'])} regression(s), "
+        f"{len(report['improvements'])} improvement(s), "
+        f"{report['checked']} metric(s) checked "
+        f"(k={report['k']}, floor={report['min_rel']:.0%}, "
+        f"speed_ratio={report['speed_ratio']})")
+    for r in report["regressions"]:
+        if r.get("kind") == "value_changed":
+            lines.append(f"  REGRESSION {r['metric']}: {r['value']} != "
+                         f"baseline {r['baseline']} (exact metric)")
+        else:
+            lines.append(
+                f"  REGRESSION {r['metric']}: {r['value']:g} vs baseline "
+                f"{r['baseline']:g} (x{r['ratio']}, threshold "
+                f"{r['threshold']:g}, severity {r['severity']})")
+    culprit = (report.get("kernels") or {}).get("culprit")
+    if culprit:
+        lines.append(f"  culprit kernel: {culprit}")
+    for m in (report.get("kernels") or {}).get("moved", []):
+        if m["kind"] == "device_wait":
+            lines.append(
+                f"    {m['kernel']}: device_wait {m['wait_mean_ms']:g}ms "
+                f"vs {m['baseline_ms']:g}ms (x{m['ratio']})")
+        else:
+            lines.append(
+                f"    {m['kernel']}: {m['compiles']} compiles vs "
+                f"{m['baseline']} — {m['note']}")
+    for r in report["improvements"]:
+        lines.append(f"  improvement {r['metric']}: {r['value']:g} vs "
+                     f"{r['baseline']:g} (x{r['ratio']})")
+    if report["missing_metrics"]:
+        lines.append(f"  missing vs baseline: "
+                     f"{', '.join(report['missing_metrics'])}")
+    if report["new_metrics"]:
+        lines.append(f"  new (unbaselined): "
+                     f"{', '.join(report['new_metrics'])}")
+    return "\n".join(lines)
+
+
+def check_summary(summary: dict, baseline_path: str,
+                  k: Optional[float] = None,
+                  report_path: Optional[str] = None) -> dict:
+    """The one-call gate: load baselines, compare, optionally write the
+    report JSON. Raises FileNotFoundError when no baseline exists (the
+    bootstrap path: run with --update-baseline first)."""
+    baselines = load_baselines(baseline_path)
+    report = compare(summary, baselines, k=k)
+    if report_path:
+        d = os.path.dirname(report_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(report_path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    return report

@@ -20,16 +20,53 @@ k beyond the kernel tier cap).
 
 from __future__ import annotations
 
+import math
+import weakref
 from typing import Optional, Union
 
 import numpy as np
 
 from geomesa_tpu.filter import ir
 from geomesa_tpu.filter.parser import parse_ecql
+from geomesa_tpu.metrics import REGISTRY as _metrics
 from geomesa_tpu.process.geo import expand_bbox, haversine_m
 
 _WORLD = (-180.0, -90.0, 180.0, 90.0)
 _MAX_DEVICE_K = 2048
+
+# per-planner KNN state: the radius that last satisfied the candidate
+# target (keyed by target, so k=10 and k=500 seed independently) and the
+# last padded block tier. Each extra radius round is a full host
+# plan+cover pass (the measured cfg4 cost at 100M — see the perf watch
+# report perf/reports/cfg4_knn_regression.json), and a tier flip between
+# adjacent powers of two is a fresh XLA compile (kernels.recompiles), so
+# both memos directly buy back blocking latency. Weak: a dropped planner
+# frees its state.
+_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _memo_for(planner) -> dict:
+    m = _MEMO.get(planner)
+    if m is None:
+        m = {"radii": {}, "tier": 0}
+        _MEMO[planner] = m
+    return m
+
+
+def _stable_tier_blocks(memo: dict, blocks: np.ndarray) -> np.ndarray:
+    """Pad candidate blocks to a hysteresis-stable power-of-two tier: a
+    query whose cover straddles a pow2 boundary reuses the NEIGHBORING
+    query's (compiled) tier instead of flip-flopping between two jit
+    signatures — the recompile churn the kernels.recompiles counter made
+    visible. Padded ids are -1 (masked out by the kernel)."""
+    nb = max(8, 1 << max(0, len(blocks) - 1).bit_length())
+    tier = memo.get("tier", 0)
+    if tier and nb < tier <= 2 * nb:
+        nb = tier  # round UP to the remembered tier (<= 2x the work)
+    memo["tier"] = nb
+    out = np.full(nb, -1, dtype=np.int32)
+    out[: len(blocks)] = blocks
+    return out
 
 
 def knn(planner, x: float, y: float, k: int,
@@ -77,8 +114,26 @@ def _device_knn(planner, plan, x: float, y: float, k: int,
         return bbox if f is None or isinstance(f, ir.Include) \
             else ir.and_filters([f, bbox])
 
-    r = float(initial_radius_m)
+    memo = _memo_for(planner)
+    target = max(32 * k, 2048)
+    fkey = ("full", target)
+    uses = memo.get(fkey)
+    if uses is not None and uses < 16:
+        # last probe ended at the full-table kernel (cover declined before
+        # the candidate target — the small-table / wide-data regime):
+        # skip the radius walk entirely. Re-probe every 16th query so a
+        # grown table regains the pruned path; a stale choice is still
+        # exact, just unpruned.
+        memo[fkey] = uses + 1
+        _metrics.inc("knn.radius_memo_hits")
+        return _full_table_knn(planner, plan, index, x, y, k, m)
+    memo.pop(fkey, None)
+    seeded = memo["radii"].get(target)
+    r = float(seeded if seeded is not None else initial_radius_m)
+    first_round = True
+    prev_rows = -1
     for _ in range(40):
+        _metrics.inc("knn.plan_rounds")
         whole_world = expand_bbox(x, y, r) == _WORLD
         plan_r = planner.plan(plan.full_filter if whole_world else with_bbox(r))
         if not (plan_r.residual_host is None and plan_r.candidate_slices is None
@@ -86,20 +141,47 @@ def _device_knn(planner, plan, x: float, y: float, k: int,
             break  # composition changed the plan shape: full-table kernel
         blocks = planner._pruned_blocks(plan_r)
         if blocks is None:
+            if first_round and seeded is not None:
+                # stale memo (table shrank / cover now declines at this
+                # radius): restart the ordinary schedule, don't give up
+                # the pruned path
+                r = float(initial_radius_m)
+                seeded = None
+                first_round = False
+                continue
             break  # no cover (wide bbox / tiny table): full-table kernel
         # candidate rows are free to evaluate (host binary searches), so aim
         # well past k: a generous candidate set makes the inscribed-circle
         # guarantee pass on the FIRST dispatch almost always — each failed
         # guarantee costs a full device round trip, each extra radius step
-        # only ~5ms of host cover work
-        enough = plan_r.explain.get("candidate_rows", 0) >= max(32 * k, 2048)
+        # a full host plan+cover pass (the dominant cfg4 cost at 100M on a
+        # single-core host — which is why the growth below is density-
+        # scaled and the landing radius is memoized per planner)
+        rows = plan_r.explain.get("candidate_rows", 0)
+        enough = rows >= target
         if not (enough or whole_world):
-            r *= 8
+            # candidate rows grow ~r^2 in locally-uniform data: jump
+            # toward the radius that should hold ~1.5x the target instead
+            # of walking a blind schedule. A stagnant count means the
+            # cover's resolution hasn't moved yet — fall back to the x8
+            # step (never slower than the pre-memo schedule).
+            if rows > 0 and rows != prev_rows:
+                grow = min(max(math.sqrt(1.5 * target / rows), 2.0), 8.0)
+            else:
+                grow = 8.0
+            prev_rows = rows
+            r *= grow
+            first_round = False
             continue
+        if first_round and seeded is not None:
+            _metrics.inc("knn.radius_memo_hits")
+        memo["radii"][target] = r
         from geomesa_tpu.index import prune as _prune
+        _metrics.inc("knn.device_dispatches")
         dists, pos = index.kernels.topk_nearest_blocks(
             plan_r.primary_kind, plan_r.boxes_loose, plan_r.windows,
-            plan_r.residual_device, x, y, m, blocks, _prune.BLOCK_SIZE)
+            plan_r.residual_device, x, y, m,
+            _stable_tier_blocks(memo, blocks), _prune.BLOCK_SIZE)
         valid = np.isfinite(dists)
         kth_ok = valid.sum() >= k and float(np.sort(dists[valid])[k - 1]) <= r
         if whole_world or kth_ok:
@@ -107,9 +189,16 @@ def _device_knn(planner, plan, x: float, y: float, k: int,
         # fewer than k in radius, or the k-th may lie outside the bbox
         r = max(r * 4, float(np.sort(dists[valid])[min(valid.sum(), k) - 1])
                 * 1.001 if valid.any() else r * 4)
+        first_round = False
     else:
         return np.empty(0, dtype=np.int64), np.empty(0)
 
+    memo[fkey] = 1  # remember the full-table outcome for the neighbors
+    return _full_table_knn(planner, plan, index, x, y, k, m)
+
+
+def _full_table_knn(planner, plan, index, x, y, k, m):
+    _metrics.inc("knn.device_dispatches")
     dists, pos = index.kernels.topk_nearest(
         plan.primary_kind, plan.boxes_loose, plan.windows,
         plan.residual_device, x, y, m)

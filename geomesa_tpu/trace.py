@@ -63,7 +63,11 @@ SPAN_KINDS = ("plan", "range_decompose", "queue_wait", "scan", "device_scan",
               # cancelled at its deadline BEFORE device dispatch, a count
               # degraded to the stats estimator, a request shed by admission
               # control — the overload test asserts on these leaves
-              "cancel", "degrade", "shed")
+              "cancel", "degrade", "shed",
+              # long-running build phase (encode/upload/sort — obs/profiling
+              # PROGRESS): a traced ingest that triggers a rebuild
+              # attributes the build stages instead of one opaque span
+              "build_phase")
 
 _pc = time.perf_counter  # cached: spans sit on µs-scale hot paths
 

@@ -43,6 +43,9 @@ Routes:
                                          per query/count/batch), filtered
   GET  /slo                            → SLO burn-rate evaluation (5m/30m/
                                          1h/6h windows, page/ticket state)
+  GET  /progress                       → live + recent long-running phases
+                                         (index-build encode/upload/sort
+                                         with row throughput)
   GET  /scheduler                      → scheduler state (queue depth, batch
                                          histogram, cache hit rates)
   GET  /durability                     → WAL/snapshot status (policy, seq,
@@ -170,6 +173,11 @@ class GeoJsonApi:
         if parts == ["slo"]:
             from geomesa_tpu.obs.slo import ENGINE
             return 200, {"slo": ENGINE.evaluate()}
+        if parts == ["progress"]:
+            # long-running operation phases (index builds): live phases
+            # with running row throughput + the recent history
+            from geomesa_tpu.obs.profiling import PROGRESS
+            return 200, {"progress": PROGRESS.snapshot()}
         if parts == ["scheduler"]:
             return 200, self.store.scheduler().stats()
         if parts == ["durability"]:

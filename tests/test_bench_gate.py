@@ -1,0 +1,57 @@
+"""End-to-end bench regression gate (slow; the CI perf-smoke job runs the
+same flow against the committed baselines). Runs the mini bench twice in
+subprocesses against a freshly-bootstrapped baseline: the unmodified
+back-to-back run must pass, the kernel-handicapped run must flag with the
+culprit kernel named. Tier-1 covers the comparator deterministically in
+test_perfwatch.py — this proves the bench wiring end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(tmp_path, *extra, env_extra=None):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "GEOMESA_TPU_BENCH_CONFIGS": "0,1,4",
+                "GEOMESA_TPU_PERFWATCH_MIN_REL": "0.5"})
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mini",
+         "--baseline", str(tmp_path / "baselines.json"),
+         "--summary", str(tmp_path / "summary.json"),
+         "--report", str(tmp_path / "report.json"), *extra],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+
+
+def test_bench_gate_end_to_end(tmp_path):
+    # bootstrap: two baseline runs
+    for _ in range(2):
+        r = _run_bench(tmp_path, "--update-baseline")
+        assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    assert summary["schema"] == 1 and summary["metrics"] and \
+        summary["kernels"], "flat summary must carry metrics + kernels"
+
+    # unmodified back-to-back run: NOT flagged (noise floor respected)
+    r = _run_bench(tmp_path, "--check")
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads((tmp_path / "report.json").read_text())
+    assert report["ok"] and not report["regressions"]
+
+    # injected in-kernel 2.5x slowdown: flagged, culprit kernel named
+    r = _run_bench(tmp_path, "--check", env_extra={
+        "GEOMESA_TPU_BENCH_HANDICAP_KERNEL": "topk:2.5"})
+    assert r.returncode == 3, (r.returncode, r.stderr[-2000:])
+    report = json.loads((tmp_path / "report.json").read_text())
+    assert any(x["metric"] == "cfg4_knn10_ms" for x in report["regressions"])
+    assert "topk" in (report["kernels"].get("culprit") or ""), \
+        report["kernels"]

@@ -558,6 +558,30 @@ def test_prometheus_exposition_conformance(server):
         assert hist_count == h["count"]
 
 
+def test_prometheus_new_process_and_kernel_families(server):
+    """ISSUE 6 satellite: process.cpu_seconds_total (a monotone gauge
+    probe exported as a counter — no doubled _total suffix) and
+    kernels.recompiles (plain counter) appear in the exposition with
+    correct types, and both survive the single-pass conformance parse."""
+    base, ds = server
+    from geomesa_tpu.index.spatial import _boxes_fp62
+    kern = ds.planner("obs_t").indexes[0].kernels
+    kern.counts_multi("point_boxes", _boxes_fp62(
+        [(-5, -5, 5, 5), (-4, -4, 4, 4)]), None, None)
+    kern.counts_multi("point_boxes", _boxes_fp62(
+        [(-5, -5, 5, 5), (-4, -4, 4, 4), (-3, -3, 3, 3)]), None, None)
+    with urllib.request.urlopen(f"{base}/metrics?format=prometheus") as r:
+        text = r.read().decode()
+    types, samples = _parse_exposition(text)
+    assert types["geomesa_tpu_process_cpu_seconds_total"] == "counter"
+    assert float(samples["geomesa_tpu_process_cpu_seconds_total"][0][1]) > 0
+    assert "geomesa_tpu_process_cpu_seconds_total_total" not in types
+    assert types["geomesa_tpu_kernels_recompiles_total"] == "counter"
+    assert int(samples["geomesa_tpu_kernels_recompiles_total"][0][1]) >= 1
+    # ordinary gauges stay gauges
+    assert types["geomesa_tpu_process_rss_bytes"] == "gauge"
+
+
 # -- CLI ----------------------------------------------------------------------
 
 
@@ -572,7 +596,8 @@ def test_cli_debug_events_slo_kernels(capsys, store):
     assert "count_latency" in out["slo"]
     main(["debug", "kernels"])
     out = json.loads(capsys.readouterr().out)
-    assert "counters" in out
+    assert "counters" in out["kernels"]
+    assert "recompiles" in out and "device_memory" in out
 
 
 def test_cli_debug_traces_filters(capsys, store):

@@ -1,0 +1,209 @@
+"""Noise-aware bench regression gating (obs/perfwatch.py): MAD
+thresholding, direction inference, injected-slowdown flagging with
+kernel attribution, improvement/missing/new handling, the rolling
+baseline update path, machine normalization, and the CLI. All synthetic
+and deterministic — no benches run here (the slow end-to-end bench gate
+lives in test_bench_gate.py).
+"""
+
+import json
+
+import pytest
+
+from geomesa_tpu.obs import perfwatch as pw
+
+
+def _baselines(samples_by_metric, kernels=None, n_points=100):
+    b = pw.empty_baselines()
+    for name, samples in samples_by_metric.items():
+        med = pw._median(samples)
+        b["metrics"][name] = {
+            "samples": list(samples), "median": med,
+            "mad": pw._mad(samples, med),
+            "direction": pw.metric_direction(name)}
+    b["kernels"] = kernels or {}
+    b["meta"] = {"n_points": n_points}
+    return b
+
+
+def _summary(metrics, kernels=None, n_points=100):
+    return {"schema": pw.SCHEMA, "meta": {"n_points": n_points},
+            "metrics": metrics, "kernels": kernels or {}}
+
+
+def test_direction_inference():
+    assert pw.metric_direction("cfg1_blocking_p50_ms") == "lower"
+    assert pw.metric_direction("cfg1_index_build_s") == "lower"
+    assert pw.metric_direction("cfg1_scheduler_qps") == "higher"
+    assert pw.metric_direction("cfg6_ingest_qps_wal_batch") == "higher"
+    assert pw.metric_direction("cfg3_join_mpts_per_s_per_chip") == "higher"
+    assert pw.metric_direction("cfg1_vs_indexed_cpu_batched") == "higher"
+    assert pw.metric_direction("cfg1_matched") == "exact"
+    assert pw.metric_direction("cfg7_overload_shed_rate") == "skip"
+    assert pw.metric_direction("host_cores") == "skip"
+
+
+def test_mad_thresholding_flags_only_past_k_mad():
+    base = _baselines({"cfg4_knn10_ms": [100.0, 102.0, 98.0, 101.0, 99.0]})
+    # within noise: median 100, MAD 1, k=4 -> threshold max(4, 10% floor)
+    ok = pw.compare(_summary({"cfg4_knn10_ms": 106.0}), base, k=4.0)
+    assert ok["ok"] and not ok["regressions"]
+    # past both k*MAD and the relative floor
+    bad = pw.compare(_summary({"cfg4_knn10_ms": 130.0}), base, k=4.0)
+    assert not bad["ok"]
+    [r] = bad["regressions"]
+    assert r["metric"] == "cfg4_knn10_ms" and r["severity"] > 1
+
+
+def test_back_to_back_identical_run_not_flagged():
+    """ISSUE 6 acceptance: an unmodified re-run (values == medians) must
+    never flag — the noise floor is respected."""
+    samples = {"cfg1_blocking_p50_ms": [113.0, 110.9, 114.2],
+               "cfg1_scheduler_qps": [5330.0, 5177.0, 5401.0],
+               "cfg1_matched": [880809.0] * 3}
+    base = _baselines(samples)
+    run = {k: pw._median(v) for k, v in samples.items()}
+    report = pw.compare(_summary(run), base)
+    assert report["ok"] and not report["regressions"]
+    assert report["checked"] == 3
+
+
+def test_injected_2x_slowdown_flagged_and_attributed():
+    """The cfg4 scenario: a 2x kernel slowdown flags the wall metric AND
+    the kernel diff names the culprit."""
+    kern = "kernel.topk_blocks.point_boxes.b64"
+    base = _baselines(
+        {"cfg4_knn10_ms": [470.0, 472.0, 468.0]},
+        kernels={kern: {"wait_mean_ms": 95.0, "dispatches": 12,
+                        "compiles": 1},
+                 "kernel.count.point_boxes.b1": {
+                     "wait_mean_ms": 4.0, "dispatches": 40, "compiles": 1}})
+    run = _summary(
+        {"cfg4_knn10_ms": 940.0},
+        kernels={kern: {"wait_mean_ms": 205.0, "dispatches": 12,
+                        "compiles": 1},
+                 "kernel.count.point_boxes.b1": {
+                     "wait_mean_ms": 4.1, "dispatches": 40, "compiles": 1}})
+    report = pw.compare(run, base, k=3.0)
+    assert not report["ok"]
+    assert report["regressions"][0]["metric"] == "cfg4_knn10_ms"
+    assert report["kernels"]["culprit"] == kern
+    text = pw.render(report)
+    assert kern in text and "cfg4_knn10_ms" in text
+
+
+def test_recompile_churn_named_as_culprit():
+    kern = "kernel.topk_blocks.point_boxes.b64"
+    base = _baselines({}, kernels={kern: {"compiles": 1}})
+    report = pw.compare(
+        _summary({}, kernels={kern: {"compiles": 9}}), base)
+    assert report["kernels"]["culprit"] == kern
+    assert report["kernels"]["moved"][0]["kind"] == "compiles"
+
+
+def test_improvement_not_flagged():
+    base = _baselines({"cfg4_knn10_ms": [470.0, 472.0, 468.0],
+                       "cfg1_scheduler_qps": [5000.0, 5100.0, 4900.0]})
+    report = pw.compare(_summary({"cfg4_knn10_ms": 210.0,
+                                  "cfg1_scheduler_qps": 9000.0}), base)
+    assert report["ok"] and not report["regressions"]
+    assert {r["metric"] for r in report["improvements"]} == {
+        "cfg4_knn10_ms", "cfg1_scheduler_qps"}
+
+
+def test_qps_drop_is_a_regression():
+    base = _baselines({"cfg1_scheduler_qps": [5000.0, 5100.0, 4900.0]})
+    report = pw.compare(_summary({"cfg1_scheduler_qps": 2400.0}), base)
+    assert not report["ok"]
+    assert report["regressions"][0]["metric"] == "cfg1_scheduler_qps"
+
+
+def test_missing_and_new_metrics_handled():
+    base = _baselines({"cfg4_knn10_ms": [470.0], "cfg4_gone_ms": [10.0]})
+    report = pw.compare(
+        _summary({"cfg4_knn10_ms": 471.0, "cfg9_new_ms": 5.0}), base)
+    assert report["ok"]  # missing/new inform, they don't fail the gate
+    assert report["missing_metrics"] == ["cfg4_gone_ms"]
+    assert report["new_metrics"] == ["cfg9_new_ms"]
+
+
+def test_exact_metric_drift_flags_at_equal_scale():
+    base = _baselines({"cfg1_matched": [880809.0]})
+    bad = pw.compare(_summary({"cfg1_matched": 880810.0}), base)
+    assert not bad["ok"]
+    assert bad["regressions"][0]["kind"] == "value_changed"
+    # a different corpus scale never compares counts
+    ok = pw.compare(_summary({"cfg1_matched": 42.0}, n_points=999), base)
+    assert ok["ok"]
+
+
+def test_machine_normalization_scales_thresholds():
+    """A 2x-slower host (CPU proxy doubled) must not flag durations that
+    merely scaled with the machine."""
+    base = _baselines({pw.SPEED_PROXY: [1.5],
+                       "cfg4_knn10_ms": [470.0, 472.0, 468.0]})
+    run = _summary({pw.SPEED_PROXY: 3.0, "cfg4_knn10_ms": 900.0})
+    assert pw.compare(run, base)["ok"]
+    # but a real regression on top of the slow host still flags
+    run = _summary({pw.SPEED_PROXY: 3.0, "cfg4_knn10_ms": 2000.0})
+    assert not pw.compare(run, base)["ok"]
+
+
+def test_update_baseline_path(tmp_path):
+    path = str(tmp_path / "baselines.json")
+    b = pw.empty_baselines()
+    for v in (100.0, 104.0, 96.0, 101.0):
+        pw.update_baselines(b, _summary(
+            {"cfg4_knn10_ms": v},
+            kernels={"kernel.k.b1": {"wait_mean_ms": v / 50}}))
+    ent = b["metrics"]["cfg4_knn10_ms"]
+    assert len(ent["samples"]) == 4
+    assert ent["median"] == pytest.approx(100.5)
+    assert ent["mad"] == pytest.approx(2.0)  # median of [.5, .5, 3.5, 4.5]
+    assert ent["direction"] == "lower"
+    assert b["runs"] == 4
+    # rolling window stays bounded
+    for v in range(pw.KEEP_SAMPLES + 5):
+        pw.update_baselines(b, _summary({"cfg4_knn10_ms": 100.0 + v}))
+    assert len(b["metrics"]["cfg4_knn10_ms"]["samples"]) == pw.KEEP_SAMPLES
+    # save/load roundtrip + schema check
+    pw.save_baselines(b, path)
+    assert pw.load_baselines(path)["metrics"]["cfg4_knn10_ms"]["median"] \
+        == b["metrics"]["cfg4_knn10_ms"]["median"]
+    with open(path, "w") as fh:
+        json.dump({"schema": 99}, fh)
+    with pytest.raises(ValueError):
+        pw.load_baselines(path)
+
+
+def test_check_summary_writes_report(tmp_path):
+    bpath = str(tmp_path / "b.json")
+    rpath = str(tmp_path / "r.json")
+    pw.save_baselines(pw.update_baselines(
+        pw.empty_baselines(), _summary({"cfg4_knn10_ms": 100.0})), bpath)
+    report = pw.check_summary(_summary({"cfg4_knn10_ms": 500.0}), bpath,
+                              k=3.0, report_path=rpath)
+    assert not report["ok"]
+    with open(rpath) as fh:
+        assert json.load(fh)["regressions"][0]["metric"] == "cfg4_knn10_ms"
+
+
+def test_cli_perfwatch_check_and_update(tmp_path, capsys):
+    from geomesa_tpu.tools.cli import main
+    run = str(tmp_path / "run.json")
+    bpath = str(tmp_path / "baselines.json")
+    with open(run, "w") as fh:
+        json.dump(_summary({"cfg4_knn10_ms": 100.0}), fh)
+    main(["perfwatch", "update", "--run", run, "--baseline", bpath])
+    capsys.readouterr()
+    main(["perfwatch", "check", "--run", run, "--baseline", bpath])
+    assert "OK" in capsys.readouterr().out
+    with open(run, "w") as fh:
+        json.dump(_summary({"cfg4_knn10_ms": 900.0}), fh)
+    with pytest.raises(SystemExit) as e:
+        main(["perfwatch", "check", "--run", run, "--baseline", bpath])
+    assert e.value.code == 3
+    assert "REGRESSION cfg4_knn10_ms" in capsys.readouterr().out
+    main(["perfwatch", "show", "--baseline", bpath])
+    shown = json.loads(capsys.readouterr().out)
+    assert "cfg4_knn10_ms" in shown["metrics"]

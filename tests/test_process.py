@@ -188,3 +188,56 @@ def test_knn_host_residual_filter_falls_back(world):
     ref_d = haversine_m(data["x"], data["y"], 0.0, 0.0)
     ref = np.argsort(np.where(mask, ref_d, np.inf), kind="stable")[:8]
     assert np.array_equal(np.sort(rows), np.sort(ref))
+
+
+@pytest.fixture(scope="module")
+def dense_world():
+    """Scale/density where the range-pruned device KNN path engages (the
+    cfg4 serving regime): candidate covers exist and the 2048-row target
+    is reachable before the cover declines."""
+    rng = np.random.default_rng(3)
+    n = 1_000_000
+    x = np.clip(rng.normal(0, 10, n), -180, 180)
+    y = np.clip(rng.normal(0, 5, n), -90, 90)
+    base = np.datetime64("2024-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + rng.integers(0, 7 * 86400000, n)
+    ds = TpuDataStore()
+    ds.create_schema("dw", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
+    ds.load("dw", FeatureTable.build(ds.get_schema("dw"),
+                                     {"dtg": dtg, "geom": (x, y)}))
+    return ds.planner("dw"), x, y
+
+
+def test_knn_radius_memo_cuts_plan_rounds(dense_world):
+    """The cfg4 KNN regression fix: a warm query near a previous one does
+    ONE plan round + ONE pruned dispatch (radius memo + density-scaled
+    growth), where the cold query walks the radius schedule — each round
+    is a full host plan+cover pass, the measured 100M cost. Exactness is
+    untouched (the guarantee check still runs)."""
+    from geomesa_tpu.metrics import REGISTRY
+
+    planner, x, y = dense_world
+
+    def counters():
+        c = REGISTRY.snapshot()["counters"]
+        return (c.get("knn.plan_rounds", 0),
+                c.get("knn.device_dispatches", 0),
+                c.get("knn.radius_memo_hits", 0),
+                c.get("kernels.recompiles", 0))
+
+    c0 = counters()
+    knn(planner, 12.0, 4.0, 10)
+    c1 = counters()
+    cold_rounds = c1[0] - c0[0]
+    assert c1[1] - c0[1] == 1, "cold query must dispatch exactly once"
+    assert cold_rounds >= 2, "cold query walks the radius schedule"
+    rows, dists = knn(planner, 12.02, 4.01, 10)
+    c2 = counters()
+    assert c2[0] - c1[0] == 1, "warm neighbor query plans exactly once"
+    assert c2[1] - c1[1] == 1
+    assert c2[2] - c1[2] == 1, "radius memo hit"
+    assert c2[3] - c1[3] == 0, "tier hysteresis: no recompile churn"
+    ref_d = haversine_m(x, y, 12.02, 4.01)
+    ref = np.argsort(ref_d, kind="stable")[:10]
+    assert np.array_equal(np.sort(rows), np.sort(ref))
+    np.testing.assert_allclose(dists, ref_d[ref], rtol=1e-9)
