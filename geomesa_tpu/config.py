@@ -270,6 +270,50 @@ RETRY_WAL_FSYNC = _register(
     "propagates (transient EIO/disk-pressure absorption). 1 = no retry, "
     "the strict policy the durability tests pin.")
 
+# -- replicated serving fleet (replication/ + serve/router.py) ----------------
+
+REPL_HEARTBEAT_MS = _register(
+    "GEOMESA_TPU_REPL_HEARTBEAT_MS", 100.0, float,
+    "Primary -> follower heartbeat interval: the shipper sends its last "
+    "WAL seq at least this often even when no new frames exist, so a "
+    "follower can measure replication lag during write silence.")
+
+REPL_STALENESS_MS = _register(
+    "GEOMESA_TPU_REPL_STALENESS_MS", 1000.0, float,
+    "Bounded-staleness budget: a replica whose replication lag exceeds "
+    "this many ms is DEMOTED by the router (served only when nothing "
+    "healthier is up) and spends the replication-staleness SLO's error "
+    "budget.")
+
+REPL_ACK_EVERY = _register(
+    "GEOMESA_TPU_REPL_ACK_EVERY", 32, int,
+    "Follower acks at least every N applied frames (plus on every "
+    "heartbeat and on idle); the primary resumes a reconnecting follower "
+    "from its last acked seq.")
+
+REPL_RECONNECT_MS = _register(
+    "GEOMESA_TPU_REPL_RECONNECT_MS", 200.0, float,
+    "Follower reconnect backoff after a dropped/rejected replication "
+    "connection (a CRC-rejected shipped frame resyncs after this pause).")
+
+REPL_SLO_TARGET = _register(
+    "GEOMESA_TPU_REPL_SLO_TARGET", 0.999, float,
+    "Target fraction of staleness checks inside the bounded-staleness "
+    "budget for the replication SLO a follower registers (burn-rate "
+    "alerting via obs/slo.py rides the standard windows).")
+
+REPL_PROBE_TTL_MS = _register(
+    "GEOMESA_TPU_REPL_PROBE_TTL_MS", 250.0, float,
+    "Router health-probe cache TTL: endpoint health (overload state, "
+    "breaker, replication lag) refreshes at most this often on the "
+    "request path.")
+
+REPL_FAILOVER_BUDGET_MS = _register(
+    "GEOMESA_TPU_REPL_FAILOVER_BUDGET_MS", 5000.0, float,
+    "Deadline budget for a router-driven failover (drain + promote-by-"
+    "highest-acked-seq); the fleet drills assert promotion completes "
+    "inside it.")
+
 # -- request-centric observability (obs/) -------------------------------------
 
 OBS_ENABLED = _register(

@@ -158,6 +158,10 @@ class TpuDataStore:
         # dir with an existing layout recovers into this store first.
         self.durability = None
         self.recovery_report = None
+        # replication role object (replication/): a LogShipper when this
+        # store is a fleet primary, a Follower when it is a read replica,
+        # None standalone — /healthz and the fence checks read it
+        self.replication = None
         dur_dir = self.params.get("durability")
         if dur_dir:
             from geomesa_tpu.durability.manager import attach as _attach
@@ -186,7 +190,12 @@ class TpuDataStore:
 
     def close(self) -> None:
         """Flush + release durability resources (WAL fsync, background
-        syncer) and stop the query scheduler. Idempotent."""
+        syncer), stop the query scheduler, and stop a primary-role log
+        shipper (a Follower owns its store, not vice versa — it closes
+        itself and then this store). Idempotent."""
+        repl = self.replication
+        if repl is not None and getattr(repl, "role", "") == "primary":
+            repl.close()
         with self._lock:
             sched, self._scheduler = self._scheduler, None
         if sched is not None:
@@ -277,9 +286,13 @@ class TpuDataStore:
 
     def _append_locked(self, type_name, batch, stats_cached=None) -> None:
         # WAL first (log-then-apply): the batch as handed in — replay runs
-        # it through this same path, so write-path age-off re-applies there
-        self._wal_table("append", {"type": type_name}, table=batch,
-                        rows=len(batch))
+        # it through this same path, so write-path age-off re-applies there.
+        # The fid counter rides in the meta so a replica/recovered store
+        # continues the primary's fid sequence instead of restarting at 0.
+        self._wal_table("append", {"type": type_name, "rows": len(batch),
+                                   "counter": self._counters.get(type_name,
+                                                                 0)},
+                        table=batch, rows=len(batch))
         self._append_apply(type_name, batch, stats_cached)
 
     def _append_apply(self, type_name, batch, stats_cached=None) -> None:
@@ -356,8 +369,11 @@ class TpuDataStore:
             raise KeyError(type_name)
         with self._lock, _trace.span("ingest.upsert", kind="aggregate",
                                      type=type_name):
-            self._wal_table("upsert", {"type": type_name}, table=batch,
-                            rows=len(batch))
+            self._wal_table("upsert", {"type": type_name,
+                                       "rows": len(batch),
+                                       "counter": self._counters.get(
+                                           type_name, 0)},
+                            table=batch, rows=len(batch))
             self._upsert_locked(type_name, batch)
         self._dur_tick()
         return len(batch)

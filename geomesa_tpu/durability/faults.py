@@ -67,6 +67,17 @@ SERVE_POINTS = (
     "sched.single",        # before a fallback single execution
 )
 
+# replication-pipeline injection points (replication/), in ship-lifecycle
+# order. The fleet fault drills arm these: a delay at repl.apply is a lag
+# spike (stalled follower apply), a crash at repl.apply is a killed replica
+# mid-ship, an error at repl.ship.frame is a flaky replication link.
+REPL_POINTS = (
+    "repl.ship.frame",     # primary, immediately before sending one frame
+    "repl.ship.snapshot",  # primary, before a snapshot-catchup transfer
+    "repl.apply",          # follower, before appending+applying a frame
+    "repl.ack",            # follower, before sending an ack
+)
+
 
 _lock = threading.Lock()
 _active = False                      # fast-path gate (read without the lock)
@@ -77,11 +88,12 @@ _hits: Dict[str, int] = {}           # observability: point -> times reached
 _serve_errors: Dict[str, int] = {}   # point -> remaining injected errors
 _serve_crash: Dict[str, int] = {}    # point -> hits until InjectedCrash
 _serve_delay: Dict[str, list] = {}   # point -> [remaining, seconds]
+_repl_corrupt = 0                    # pending shipped-frame corruptions
 
 
 def reset() -> None:
     """Disarm everything (test teardown)."""
-    global _active, _fsync_errors
+    global _active, _fsync_errors, _repl_corrupt
     with _lock:
         _armed.clear()
         _hits.clear()
@@ -89,6 +101,7 @@ def reset() -> None:
         _serve_crash.clear()
         _serve_delay.clear()
         _fsync_errors = 0
+        _repl_corrupt = 0
         _active = False
 
 
@@ -179,9 +192,9 @@ def hits() -> Dict[str, int]:
 
 
 def _check_serve_point(point: str) -> None:
-    if point not in SERVE_POINTS:
-        raise ValueError(f"unknown serve point {point!r} "
-                         f"(have {list(SERVE_POINTS)})")
+    if point not in SERVE_POINTS and point not in REPL_POINTS:
+        raise ValueError(f"unknown serve/repl point {point!r} "
+                         f"(have {list(SERVE_POINTS + REPL_POINTS)})")
 
 
 def arm_serve_error(point: str, n: int = 1) -> None:
@@ -248,3 +261,29 @@ def serve_gate(point: str) -> None:
         _time.sleep(sleep_s)
     if exc is not None:
         raise exc
+
+
+def arm_repl_corrupt(n: int = 1) -> None:
+    """Corrupt the next ``n`` shipped WAL frames in flight (one flipped
+    byte mid-frame) — the torn-shipped-frame drill. The receiver must
+    reject the frame on CRC and resynchronize from its acked seq."""
+    global _active, _repl_corrupt
+    with _lock:
+        _repl_corrupt = int(n)
+        _active = True
+
+
+def repl_corrupt(frame: bytes) -> bytes:
+    """Shipper-side hook: returns ``frame`` unchanged, or a copy with one
+    byte flipped when a corruption is armed and due."""
+    global _repl_corrupt
+    if not _active:
+        return frame
+    with _lock:
+        if _repl_corrupt <= 0:
+            return frame
+        _repl_corrupt -= 1
+        _hits["repl.corrupt"] = _hits.get("repl.corrupt", 0) + 1
+    b = bytearray(frame)
+    b[len(b) // 2] ^= 0xFF
+    return bytes(b)

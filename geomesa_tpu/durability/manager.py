@@ -72,6 +72,11 @@ class DurabilityManager:
                                  interval_ms=interval_ms,
                                  start_seq=start_seq)
         self.replaying = False
+        # replication role fence: a read-only replica refuses direct
+        # mutations (only the follower's apply loop, which flips
+        # ``replaying``, may change state); a fenced ex-primary refuses
+        # everything once a higher fencing epoch was witnessed
+        self.read_only = False
         self.snapshot_seq = int(snapshot_seq)
         self._snapshot_rows = int(snapshot_rows
                                   or config.SNAPSHOT_ROWS.get())
@@ -96,6 +101,7 @@ class DurabilityManager:
     def log_json(self, kind: str, meta: dict, rows: int = 0) -> Optional[int]:
         if self.replaying or self.closed:
             return None
+        self._fence_check()
         from geomesa_tpu.durability.wal import encode_json
         return self._log(kind, encode_json(meta), rows)
 
@@ -103,8 +109,26 @@ class DurabilityManager:
                   rows: int = 0) -> Optional[int]:
         if self.replaying or self.closed:
             return None
+        self._fence_check()
         from geomesa_tpu.durability.wal import encode_table
         return self._log(kind, encode_table(meta, table, arrays), rows)
+
+    def _fence_check(self) -> None:
+        """Refuse the mutation BEFORE it reaches the log or memory: on a
+        read-only replica, and on a primary whose fencing epoch was
+        superseded (the split-brain loser) — mutators log-then-apply, so
+        raising here vetoes the whole operation atomically."""
+        from geomesa_tpu.replication.fence import FencedError
+        if self.read_only:
+            raise FencedError(
+                "store is a read-only replica (mutations must go to the "
+                "primary; promote() lifts the restriction)")
+        repl = getattr(self.store, "replication", None)
+        if repl is not None and getattr(repl, "fenced", False):
+            raise FencedError(
+                f"fencing epoch {repl.epoch} superseded by "
+                f"{repl.fenced_by}: this node lost primaryship and can "
+                f"no longer accept writes")
 
     def _log(self, kind: str, payload: bytes, rows: int) -> int:
         seq = self.wal.append(kind, payload)

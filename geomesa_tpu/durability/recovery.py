@@ -70,12 +70,18 @@ def _apply_record(store, kind: str, payload: bytes) -> None:
     from geomesa_tpu.filter import ir
 
     if kind in ("append", "upsert"):
-        t = _wal.peek_meta(payload)["type"]
+        meta = _wal.peek_meta(payload)
+        t = meta["type"]
         _, table, _ = _wal.decode_table(payload, sft=store.schemas[t])
         if kind == "append":
             store._append(t, table)
         else:
             store.upsert(t, table)
+        # continue the primary's fid sequence (records logged before this
+        # meta field existed simply leave the counter alone)
+        if "counter" in meta:
+            store._counters[t] = max(store._counters.get(t, 0),
+                                     int(meta["counter"]))
     elif kind == "remove":
         meta = _wal.decode_json(payload)
         store.remove_features(meta["type"],

@@ -294,9 +294,192 @@ def iter_records(directory: str, name: str = "wal",
             return
 
 
+_TORN_ERRORS = ("torn frame header", "torn frame payload", "bad crc",
+                "truncated segment header")
+
+
+def contiguity(directory: str, name: str = "wal") -> dict:
+    """Whole-log contiguity diagnosis: where (if anywhere) the global
+    sequence breaks, and what kind of break it is. Shippers and recovery
+    both need the distinction a silent stop-at-first-error hides:
+
+      torn_tail        the LAST segment ends in a cut/corrupt frame — a
+                       mid-write crash; everything before it is intact and
+                       nothing recoverable is lost
+      missing_segment  records exist PAST the break (a deleted/corrupt
+                       middle segment, or a first_seq jump between
+                       segments) — later records can never be ordered and
+                       ``unreachable_records`` of them would be dropped
+
+    ``first_gap_seq`` is the first sequence number that should exist but
+    cannot be read (None when the log is contiguous to its end)."""
+    out = {"first_seq": None, "last_contiguous_seq": None,
+           "first_gap_seq": None, "gap_kind": None, "gap_error": None,
+           "unreachable_records": 0, "unreachable_segments": 0}
+    segs = segments(directory, name)
+    expect: Optional[int] = None
+    unreachable_from: Optional[int] = None  # index of first stranded segment
+    for i, seg in enumerate(segs):
+        first = segment_first_seq(seg)
+        if out["first_seq"] is None:
+            out["first_seq"] = first
+        if expect is not None and first > expect:
+            # a whole segment's worth of seqs is missing between i-1 and i
+            out["first_gap_seq"] = expect
+            out["gap_kind"] = "missing_segment"
+            out["gap_error"] = (f"segment starting at {first} follows "
+                                f"last readable seq {expect - 1}")
+            unreachable_from = i
+            break
+        records, _end, error = scan_segment(seg)
+        if records:
+            out["last_contiguous_seq"] = records[-1][0]
+            expect = records[-1][0] + 1
+        elif expect is None:
+            expect = first
+        if error is not None:
+            out["first_gap_seq"] = expect
+            out["gap_error"] = error
+            # a break in the FINAL segment is the ordinary torn tail a
+            # crash leaves; a break with segments after it strands them
+            out["gap_kind"] = ("torn_tail" if i == len(segs) - 1
+                               and error in _TORN_ERRORS
+                               else "missing_segment")
+            unreachable_from = i + 1
+            break
+    if unreachable_from is not None:
+        for later in segs[unreachable_from:]:
+            out["unreachable_segments"] += 1
+            out["unreachable_records"] += len(scan_segment(later)[0])
+    return out
+
+
+def read_raw_frames(path: str, offset: int = 0, after_seq: int = 0):
+    """Raw CRC-verified frames from one segment starting at byte
+    ``offset`` (0 = start, past the header): yields ``(seq, kind_name,
+    frame_bytes, end_offset)`` where ``frame_bytes`` is the exact on-disk
+    ``crc|len|seq|kind|payload`` encoding — a shipper forwards it verbatim
+    so the receiver re-verifies the SAME crc. Stops (without raising) at
+    the first torn/corrupt frame; the caller may retry from the returned
+    end_offset once more bytes exist (a torn live head is just a frame
+    still being written)."""
+    with open(path, "rb") as fh:
+        if offset <= _HEADER.size:
+            head = fh.read(_HEADER.size)
+            if len(head) < _HEADER.size or head[:4] != _MAGIC:
+                return
+            offset = _HEADER.size
+        fh.seek(offset)
+        while True:
+            hdr = fh.read(_FRAME.size)
+            if len(hdr) < _FRAME.size:
+                return
+            crc, length, seq, kind = _FRAME.unpack(hdr)
+            payload = fh.read(length)
+            if len(payload) < length:
+                return
+            if zlib.crc32(_CRC_PART.pack(length, seq, kind) + payload) != crc:
+                return
+            offset += _FRAME.size + length
+            if seq > after_seq:
+                yield (seq, KIND_NAMES.get(kind, f"kind{kind}"),
+                       hdr + payload, offset)
+
+
+def verify_frame(frame: bytes):
+    """Validate one raw frame's structure + CRC; returns
+    ``(seq, kind_name, payload)`` or raises ValueError — the follower-side
+    receipt check for shipped frames (runs BEFORE any duplicate-skip, so a
+    corrupted frame can never masquerade as an already-held record)."""
+    if len(frame) < _FRAME.size:
+        raise ValueError("short frame")
+    crc, length, seq, kind = _FRAME.unpack_from(frame)
+    if len(frame) != _FRAME.size + length:
+        raise ValueError(f"frame length mismatch ({len(frame)} != "
+                         f"{_FRAME.size + length})")
+    payload = frame[_FRAME.size:]
+    if zlib.crc32(_CRC_PART.pack(length, seq, kind) + payload) != crc:
+        raise ValueError(f"bad frame crc at seq {seq}")
+    return seq, KIND_NAMES.get(kind, f"kind{kind}"), payload
+
+
+class WalTailer:
+    """Incremental raw-frame reader for the log shipper: tracks (segment,
+    byte offset, next expected seq) so each ``poll()`` reads only NEW
+    frames instead of rescanning the log. Follows size-based rotation; a
+    torn live head (a frame mid-write) simply ends the poll and retries at
+    the same offset next time. Raises FileNotFoundError when the needed
+    segment was garbage-collected out from under the tail (the follower
+    is then too far behind and must snapshot-catchup)."""
+
+    def __init__(self, directory: str, name: str = "wal",
+                 after_seq: int = 0):
+        self.dir = directory
+        self.name = name
+        self.next_seq = int(after_seq) + 1
+        self._seg: Optional[str] = None
+        self._off = 0
+
+    def _locate(self) -> Optional[str]:
+        """Segment that should contain ``next_seq`` (newest first_seq <=
+        next_seq); None when the log has nothing at or before it yet."""
+        best = None
+        for seg in segments(self.dir, self.name):
+            if segment_first_seq(seg) <= self.next_seq:
+                best = seg
+        if best is None and segments(self.dir, self.name):
+            raise FileNotFoundError(
+                f"wal segment containing seq {self.next_seq} was "
+                f"garbage-collected")
+        return best
+
+    def poll(self, limit: Optional[int] = None):
+        """All newly readable ``(seq, kind_name, frame_bytes)`` in order
+        (up to ``limit``)."""
+        out = []
+        while True:
+            if self._seg is None:
+                self._seg = self._locate()
+                self._off = 0
+                if self._seg is None:
+                    return out
+            if not os.path.exists(self._seg):
+                raise FileNotFoundError(self._seg)
+            advanced = False
+            for seq, kind, frame, end in read_raw_frames(
+                    self._seg, self._off, after_seq=self.next_seq - 1):
+                self._off = end
+                advanced = True
+                if seq != self.next_seq:
+                    # pre-existing intra-segment gap: unreachable past here
+                    return out
+                out.append((seq, kind, frame))
+                self.next_seq = seq + 1
+                if limit is not None and len(out) >= limit:
+                    return out
+            if not advanced and self._off == 0:
+                # skipped records before next_seq count as progress too
+                recs = list(read_raw_frames(self._seg, 0, after_seq=0))
+                if recs:
+                    self._off = recs[-1][3]
+            # rotation: a successor segment owns next_seq now
+            succ = None
+            for seg in segments(self.dir, self.name):
+                if seg != self._seg and \
+                        segment_first_seq(seg) == self.next_seq:
+                    succ = seg
+                    break
+            if succ is not None:
+                self._seg, self._off = succ, 0
+                continue
+            return out
+
+
 def inspect(directory: str, name: str = "wal") -> dict:
     """Debug dump for the CLI ``debug wal`` inspector: per-segment record
-    listing (seq, kind, bytes), torn-tail diagnostics."""
+    listing (seq, kind, bytes), torn-tail diagnostics, and the whole-log
+    contiguity diagnosis (first_gap_seq + torn-tail vs missing-segment
+    classification)."""
     out: dict = {"dir": directory, "name": name, "segments": []}
     for seg in segments(directory, name):
         records, valid_end, error = scan_segment(seg)
@@ -313,6 +496,7 @@ def inspect(directory: str, name: str = "wal") -> dict:
                     {"error": error, "valid_end": valid_end,
                      "trailing_bytes": size - valid_end},
         })
+    out["contiguity"] = contiguity(directory, name)
     return out
 
 
@@ -357,9 +541,16 @@ class WriteAheadLog:
         self.interval_s = (interval_ms if interval_ms is not None
                            else config.WAL_INTERVAL_MS.get()) / 1000.0
         os.makedirs(directory, exist_ok=True)
+        # a pre-existing break in the on-disk log (recovery normally cleans
+        # one up first, but a follower/shipper-facing WAL may still carry
+        # it): diagnosed once at open — live appends can never create one
+        self._initial_gap = (contiguity(directory, name)
+                             if segments(directory, name) else None)
         self._lock = threading.RLock()
         self._sync_cond = threading.Condition()
         self._sync_leader = False
+        self._tail_cond = threading.Condition()
+        self._tail_waiters = 0
         self._next_seq = int(start_seq)
         self._last_seq = int(start_seq) - 1
         self._synced_seq = self._last_seq
@@ -389,6 +580,7 @@ class WriteAheadLog:
         return max(0, self._written_bytes - self._synced_bytes)
 
     def stats(self) -> dict:
+        gap = self._initial_gap or {}
         return {
             "policy": self.policy,
             "last_seq": self._last_seq,
@@ -397,6 +589,11 @@ class WriteAheadLog:
             "fsyncs": self._n_fsyncs,
             "segments": len(segments(self.dir, self.name)),
             "segment_bytes": self._seg_size,
+            # explicit contiguity break (None = contiguous): shippers and
+            # recovery distinguish "torn tail" from "missing segment"
+            # instead of silently dropping everything past the break
+            "first_gap_seq": gap.get("first_gap_seq"),
+            "gap_kind": gap.get("gap_kind"),
         }
 
     # -- writing -------------------------------------------------------------
@@ -444,10 +641,87 @@ class WriteAheadLog:
         if _trace.enabled():
             _trace.record("wal.append", "wal_append",
                           time.perf_counter() - t0)
+        if self._tail_waiters:
+            with self._tail_cond:
+                self._tail_cond.notify_all()
         if need_rotate:
             self.rotate()
         faults.crash_point("wal.append.after")
         return seq
+
+    def append_frame(self, frame: bytes) -> int:
+        """Append one pre-framed record (``crc|len|seq|kind|payload``)
+        verbatim — the follower-side ingestion of a shipped frame. The
+        frame's CRC is re-verified and its seq must be exactly the next
+        expected (shipped logs stay byte-identical to the primary's,
+        modulo segment boundaries). Durability policy applies as for
+        ``append``."""
+        if len(frame) < _FRAME.size:
+            raise ValueError("short frame")
+        crc, length, seq, kind = _FRAME.unpack_from(frame)
+        if len(frame) != _FRAME.size + length:
+            raise ValueError(
+                f"frame length mismatch ({len(frame)} != "
+                f"{_FRAME.size + length})")
+        if zlib.crc32(_CRC_PART.pack(length, seq, kind)
+                      + frame[_FRAME.size:]) != crc:
+            raise ValueError(f"bad frame crc at seq {seq}")
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        with self._lock:
+            if self._closed:
+                raise ValueError("WAL is closed")
+            if seq != self._next_seq:
+                raise ValueError(
+                    f"non-contiguous frame seq {seq} (expect "
+                    f"{self._next_seq})")
+            self._fh.write(frame)
+            self._next_seq = seq + 1
+            self._last_seq = seq
+            self._seg_size += len(frame)
+            self._written_bytes += len(frame)
+            need_rotate = self._seg_size >= self.segment_bytes
+        _metrics.inc("wal.records")
+        _metrics.observe_value("wal.append_bytes", len(frame))
+        if self.policy == "always":
+            self._group_sync(seq)
+        elif self.policy == "batch":
+            self._ensure_syncer()
+        if self._tail_waiters:
+            with self._tail_cond:
+                self._tail_cond.notify_all()
+        if need_rotate:
+            self.rotate()
+        return seq
+
+    def flush_to_os(self) -> None:
+        """Push the userspace write buffer to the OS page cache (no fsync)
+        so on-disk readers — the log shipper's tail — observe every
+        appended frame immediately, regardless of fsync policy."""
+        with self._lock:
+            if self._fh is not None and not self._closed:
+                self._fh.flush()
+
+    def wait_for_seq(self, seq: int, timeout: Optional[float] = None) -> bool:
+        """Block until a record with sequence >= ``seq`` has been appended
+        (True) or ``timeout`` seconds pass (False). The shipper's idle
+        wait: appends wake it immediately; the capped internal wait bounds
+        the cost of any missed notify."""
+        if self._last_seq >= seq:
+            return True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._tail_cond:
+            self._tail_waiters += 1
+            try:
+                while self._last_seq < seq and not self._closed:
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._tail_cond.wait(0.1 if remaining is None
+                                         else min(remaining, 0.1))
+            finally:
+                self._tail_waiters -= 1
+        return self._last_seq >= seq
 
     def append_json(self, kind: str, meta: dict) -> int:
         return self.append(kind, encode_json(meta))

@@ -226,3 +226,15 @@ def default_objectives() -> List[Objective]:
                                 "scheduler.deadline_cancelled",
                                 "scheduler.worker_deaths")),
     ]
+
+
+def replication_objective() -> Objective:
+    """Bounded-staleness SLO a read replica registers (replication/
+    follower.py): every heartbeat/ack scores a staleness check, and a
+    check with replication lag over GEOMESA_TPU_REPL_STALENESS_MS spends
+    the budget — so a persistently lagging replica pages through exactly
+    the same burn-rate machinery as a latency breach."""
+    return Objective(name="replication_staleness", kind="availability",
+                     target=float(config.REPL_SLO_TARGET.get()),
+                     total_counter="replication.staleness_checks",
+                     bad_counters=("replication.staleness_exceeded",))

@@ -1,0 +1,22 @@
+"""Replicated serving fleet: primary → follower WAL shipping, read
+replicas, fencing epochs, and deterministic fleet fault drills.
+
+≙ the availability layer the reference gets for free from its key-value
+backends (HBase/Accumulo/Bigtable replicate regions and fail scans over to
+healthy tablet servers — PAPER.md layer map): the CRC-framed,
+contiguous-global-seq WAL from durability/ becomes the replication log, a
+Follower applies shipped records through the recovery replay paths into
+its own durable store, and serve/router.py spreads reads across the fleet
+with health-, overload- and lag-aware balancing.
+
+  shipper.LogShipper   primary-side WAL tailing + snapshot catch-up server
+  follower.Follower    read replica: verify → local-log → apply → ack
+  fence                fencing epochs (split-brain write prevention)
+  protocol             the length-prefixed socket transport
+  drills               deterministic fleet fault drills (replica kill,
+                       lag spike, torn shipped frame, partition fencing)
+"""
+
+from geomesa_tpu.replication.fence import FencedError  # noqa: F401
+from geomesa_tpu.replication.follower import Follower  # noqa: F401
+from geomesa_tpu.replication.shipper import LogShipper  # noqa: F401

@@ -1,0 +1,337 @@
+"""Deterministic fleet fault drills.
+
+≙ the chaos-under-control discipline production storage fleets run (kill a
+tablet server mid-compaction, partition a rack, watch nothing break) —
+but executed deterministically on the durability/faults.py registry
+instead of racing real chaos: each drill builds a miniature fleet under a
+scratch directory, injects exactly one failure at a registered point, and
+asserts the recovery invariant the architecture promises. The four drills
+map 1:1 onto the failure modes the replication design must survive:
+
+  replica_kill   a follower dies mid-ship (InjectedCrash at repl.apply);
+                 a restart on the same directory converges to a
+                 byte-identical table state with ZERO acknowledged
+                 primary writes lost
+  lag_spike      a stalled apply loop ages the replica past the bounded-
+                 staleness budget; the router demotes it (still serving
+                 fresh reads from the primary), then restores it once it
+                 catches up
+  torn_frame     a shipped frame is corrupted in flight; the follower
+                 rejects it on CRC, resynchronizes from its acked seq,
+                 and converges with nothing lost or doubled
+  partition      two would-be primaries after a split; the fencing epoch
+                 makes every stale-epoch write impossible to replicate
+                 and demotes the loser the moment the partition heals
+
+Each drill returns a structured report and scores
+``drill.<name>.runs`` / ``drill.<name>.passed`` counters (surfaced by
+``geomesa-tpu debug replication``); tests assert ``report["ok"]``."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.durability import faults
+from geomesa_tpu.durability import wal as _wal
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+SPEC = "name:String,v:Int,dtg:Date,*geom:Point"
+_DTG0 = int(np.datetime64("2024-01-01T06:00:00", "ms").astype(np.int64))
+
+
+def make_batch(sft, i: int, n: int = 40):
+    """Deterministic feature batch ``i`` (drills and the fleet tests share
+    the generator so oracle comparisons are exact)."""
+    from geomesa_tpu.features.table import FeatureTable
+    rng = np.random.default_rng(1000 + i)
+    data = {"name": rng.choice(["a", "b", "c"], n).astype(object),
+            "v": (rng.integers(0, 100, n) + i).astype(np.int32),
+            "dtg": _DTG0 + rng.integers(0, 3_600_000, n),
+            "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))}
+    return FeatureTable.build(sft, data,
+                              fids=[f"b{i}_{j}" for j in range(n)])
+
+
+def fingerprint(store) -> dict:
+    """type -> sha256 of the merged (main ∪ delta) columnar table, using
+    the WAL's deterministic codec — byte-identical state, not just equal
+    counts."""
+    from geomesa_tpu.features.table import FeatureTable
+    out = {}
+    with store._lock:
+        views = {}
+        for t in store.get_type_names():
+            tbl = store.tables.get(t)
+            delta = store.deltas.get(t)
+            if tbl is not None and delta is not None:
+                tbl = FeatureTable.concat([tbl, delta])
+            elif tbl is None:
+                tbl = delta
+            views[t] = tbl
+    for t, tbl in views.items():
+        if tbl is None:
+            out[t] = "empty"
+            continue
+        payload = _wal.encode_table({"rows": len(tbl)}, tbl)
+        out[t] = hashlib.sha256(payload).hexdigest()
+    return out
+
+
+def _mk_primary(path: str):
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.replication.shipper import LogShipper
+    store = TpuDataStore.open(path, params={"wal.fsync": "off"})
+    store.create_schema("t", SPEC)
+    store.load("t", make_batch(store.schemas["t"], 0))
+    return store, LogShipper(store)
+
+
+def _score(name: str, report: dict) -> dict:
+    report["name"] = name
+    _metrics.inc(f"drill.{name}.runs")
+    if report.get("ok"):
+        _metrics.inc(f"drill.{name}.passed")
+    return report
+
+
+def _wait(predicate, timeout_s: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+# -- the drills ---------------------------------------------------------------
+
+
+def drill_replica_kill(base_dir: str) -> dict:
+    """Kill the follower's apply loop mid-ship, restart it on the same
+    directory, and require byte-identical convergence: zero acknowledged
+    primary writes lost."""
+    from geomesa_tpu.replication.follower import Follower
+    faults.reset()
+    primary = shipper = f1 = f2 = None
+    report: dict = {"ok": False}
+    try:
+        primary, shipper = _mk_primary(os.path.join(base_dir, "primary"))
+        rdir = os.path.join(base_dir, "replica")
+        f1 = Follower(rdir, shipper.address, follower_id="r1")
+        if not f1.wait_for_seq(primary.durability.wal.last_seq):
+            report["error"] = "initial sync never completed"
+            return report
+        # die on the 2nd applied frame of the incoming burst
+        faults.arm_serve_crash("repl.apply", at=2)
+        for i in range(1, 5):  # 4 acknowledged batches while the kill arms
+            primary.load("t", make_batch(primary.schemas["t"], i))
+        primary.remove_features("t", "v < 5")
+        acked_seq = primary.durability.wal.last_seq
+        report["killed"] = _wait(lambda: f1.dead, 10.0)
+        faults.reset()
+        f2 = Follower(rdir, shipper.address, follower_id="r1")
+        report["converged"] = f2.wait_for_seq(acked_seq, timeout=15.0)
+        want, got = fingerprint(primary), fingerprint(f2.store)
+        report["fingerprint_equal"] = want == got
+        report["acked_seq"] = acked_seq
+        report["replica_seq"] = f2.applied_seq
+        report["zero_acked_lost"] = f2.applied_seq >= acked_seq and \
+            want == got
+        report["ok"] = bool(report["killed"] and report["converged"]
+                            and report["zero_acked_lost"])
+        return report
+    finally:
+        faults.reset()
+        for x in (f1, f2):
+            if x is not None:
+                try:
+                    x.close()
+                except Exception:
+                    pass
+        if primary is not None:
+            primary.close()
+        _score("replica_kill", report)
+
+
+def drill_lag_spike(base_dir: str) -> dict:
+    """Stall the follower's apply loop past the bounded-staleness budget:
+    the router must demote it (reads keep flowing, fresh, from the
+    primary) and restore it once it catches up."""
+    from geomesa_tpu.replication.follower import Follower
+    from geomesa_tpu.serve.router import LocalEndpoint, ReplicaRouter
+    faults.reset()
+    primary = shipper = f = None
+    report: dict = {"ok": False}
+    staleness = config.REPL_STALENESS_MS
+    old_staleness = staleness._override
+    try:
+        staleness.set(400.0)
+        primary, shipper = _mk_primary(os.path.join(base_dir, "primary"))
+        f = Follower(os.path.join(base_dir, "replica"), shipper.address,
+                     follower_id="r1")
+        f.wait_for_seq(primary.durability.wal.last_seq)
+        router = ReplicaRouter([LocalEndpoint("primary", primary),
+                                LocalEndpoint("r1", f)])
+        router.probe_all(force=True)
+        report["healthy_before"] = \
+            router.stats()["endpoints"]["r1"]["state"] == "healthy"
+        # one apply stalls 1.2s: the whole consume loop (heartbeats
+        # included) freezes, so provable freshness ages past the budget
+        faults.arm_serve_delay("repl.apply", seconds=1.2, n=1)
+        primary.load("t", make_batch(primary.schemas["t"], 1))
+        report["demoted_during_spike"] = _wait(
+            lambda: (router.probe_all(force=True) or True)
+            and router.stats()["endpoints"]["r1"]["state"] == "demoted",
+            timeout_s=3.0)
+        fresh = primary.count("t")
+        routed = router.count("t")  # must come from the primary, fresh
+        report["fresh_read_during_spike"] = routed == fresh
+        faults.reset()
+        report["caught_up"] = f.wait_for_seq(
+            primary.durability.wal.last_seq, timeout=10.0)
+        report["recovered_healthy"] = _wait(
+            lambda: (router.probe_all(force=True) or True)
+            and router.stats()["endpoints"]["r1"]["state"] == "healthy",
+            timeout_s=5.0)
+        report["ok"] = all(report.get(k) for k in
+                           ("healthy_before", "demoted_during_spike",
+                            "fresh_read_during_spike", "caught_up",
+                            "recovered_healthy"))
+        return report
+    finally:
+        faults.reset()
+        if old_staleness is None:
+            staleness.unset()
+        else:
+            staleness.set(old_staleness)
+        if f is not None:
+            f.close()
+        if primary is not None:
+            primary.close()
+        _score("lag_spike", report)
+
+
+def drill_torn_frame(base_dir: str) -> dict:
+    """Corrupt one shipped frame in flight: the follower must reject it
+    on CRC, resync from its acked seq, and converge with nothing lost or
+    doubled."""
+    from geomesa_tpu.replication.follower import Follower
+    faults.reset()
+    primary = shipper = f = None
+    report: dict = {"ok": False}
+    try:
+        primary, shipper = _mk_primary(os.path.join(base_dir, "primary"))
+        f = Follower(os.path.join(base_dir, "replica"), shipper.address,
+                     follower_id="r1")
+        f.wait_for_seq(primary.durability.wal.last_seq)
+        faults.arm_repl_corrupt(1)
+        for i in range(1, 3):
+            primary.load("t", make_batch(primary.schemas["t"], i))
+        report["rejected"] = _wait(lambda: f.crc_rejects >= 1, 10.0)
+        report["converged"] = f.wait_for_seq(
+            primary.durability.wal.last_seq, timeout=10.0)
+        report["fingerprint_equal"] = \
+            fingerprint(primary) == fingerprint(f.store)
+        report["crc_rejects"] = f.crc_rejects
+        report["ok"] = all(report.get(k) for k in
+                           ("rejected", "converged", "fingerprint_equal"))
+        return report
+    finally:
+        faults.reset()
+        if f is not None:
+            f.close()
+        if primary is not None:
+            primary.close()
+        _score("torn_frame", report)
+
+
+def drill_partition(base_dir: str) -> dict:
+    """Split-brain: a partition leaves two would-be primaries. The
+    follower that has witnessed the winner's fencing epoch must reject
+    every frame the loser ships (frame-level check), which demotes the
+    loser — whose subsequent local writes then raise FencedError. No
+    write under a stale epoch is ever applied anywhere."""
+    from geomesa_tpu.replication.fence import FencedError
+    from geomesa_tpu.replication.follower import Follower
+    faults.reset()
+    a = ship_a = b = c = ship_b = None
+    report: dict = {"ok": False}
+    try:
+        a, ship_a = _mk_primary(os.path.join(base_dir, "a"))
+        b = Follower(os.path.join(base_dir, "b"), ship_a.address,
+                     follower_id="b")
+        c = Follower(os.path.join(base_dir, "c"), ship_a.address,
+                     follower_id="c")
+        for x in (b, c):
+            x.wait_for_seq(a.durability.wal.last_seq)
+        base_fids = set(a.tables["t"].fids)
+        # PARTITION: b loses sight of a and is promoted (epoch 2)
+        ship_b = b.promote()
+        b.store.load("t", make_batch(b.store.schemas["t"], 1))  # winner w2
+        # c (still attached to a) learns the new epoch — the healed side
+        # of the partition hears from the new primary first
+        c._adopt_epoch(ship_b.epoch)
+        report["epochs"] = {"a": ship_a.epoch, "b": ship_b.epoch,
+                            "c": c.epoch}
+        # the stale primary keeps writing (it does not know it lost) ...
+        a.load("t", make_batch(a.schemas["t"], 2))               # loser w3
+        # ... and its shipped frame is rejected at c's epoch check, which
+        # fences a the moment the FENCE answer lands
+        report["stale_frame_rejected"] = _wait(
+            lambda: c.fenced_rejects >= 1, 10.0)
+        report["loser_fenced"] = _wait(lambda: ship_a.fenced, 10.0)
+        try:
+            a.load("t", make_batch(a.schemas["t"], 3))
+            report["loser_write_refused"] = False
+        except FencedError:
+            report["loser_write_refused"] = True
+        # no stale-epoch write ever landed on c: its fids are exactly the
+        # pre-partition set (it never saw the winner's w2 either — it was
+        # attached to the loser — but it must NEVER hold the loser's w3)
+        w3_fids = {f"b2_{j}" for j in range(40)}
+        c_fids = set() if c.store.tables.get("t") is None \
+            else set(c.store.tables["t"].fids) | (
+                set(c.store.deltas["t"].fids)
+                if c.store.deltas.get("t") is not None else set())
+        report["no_stale_write_applied"] = not (w3_fids & c_fids) and \
+            c_fids == base_fids
+        report["ok"] = all(report.get(k) for k in
+                           ("stale_frame_rejected", "loser_fenced",
+                            "loser_write_refused",
+                            "no_stale_write_applied"))
+        return report
+    finally:
+        faults.reset()
+        for x in (c,):
+            if x is not None:
+                x.close()
+        if b is not None:
+            b.close(keep_store=True)
+            b.store.close()   # closes ship_b (primary role)
+        if a is not None:
+            a.close()
+        _score("partition", report)
+
+
+DRILLS = {"replica_kill": drill_replica_kill,
+          "lag_spike": drill_lag_spike,
+          "torn_frame": drill_torn_frame,
+          "partition": drill_partition}
+
+
+def run_all(base_dir: str, only: Optional[list] = None) -> dict:
+    """Run every drill (each under its own subdirectory); returns
+    name -> report plus a rollup."""
+    out = {}
+    for name, fn in DRILLS.items():
+        if only and name not in only:
+            continue
+        out[name] = fn(os.path.join(base_dir, name))
+    out["ok"] = all(r.get("ok") for k, r in out.items() if k != "ok")
+    return out

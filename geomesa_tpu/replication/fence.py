@@ -1,0 +1,65 @@
+"""Fencing epochs: the split-brain guard for the replicated fleet.
+
+≙ the reference stores' tablet/region fencing (Accumulo's ZooKeeper locks,
+HBase's region epochs): at any moment exactly one node may act as primary,
+and that right is named by a monotonically increasing **fencing epoch**
+persisted next to the durability layout. Every shipped message carries the
+sender's epoch; a receiver that has witnessed a higher epoch rejects the
+message and answers with the higher epoch, which demotes the stale
+would-be primary — so after a partition heals, the loser's writes can
+never propagate, and (via the DurabilityManager fence check) the loser
+cannot even ack new local writes once it learns it lost.
+
+Promotion = ``bump_epoch`` on the winner: strictly greater than anything
+it has seen, fsync-durable before the new primary ships a single frame.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from geomesa_tpu.durability import rotation
+
+FENCE_FILE = "replication.json"
+
+
+class FencedError(Exception):
+    """A mutation was refused by the fencing check: either this node's
+    primary role was superseded by a higher epoch (split-brain loser), or
+    the node is a read-only replica."""
+
+
+def load_epoch(directory: str) -> int:
+    """The highest fencing epoch this node has durably witnessed (0 when
+    none was ever recorded)."""
+    try:
+        with open(os.path.join(directory, FENCE_FILE)) as fh:
+            return int(json.load(fh).get("epoch", 0))
+    except (OSError, ValueError):
+        return 0
+
+
+def save_epoch(directory: str, epoch: int) -> int:
+    """Durably record ``epoch`` if it is higher than what is on disk
+    (tmp + atomic rename + fsync); returns the resulting on-disk epoch.
+    Never moves backwards — a torn adoption must not un-witness an epoch."""
+    os.makedirs(directory, exist_ok=True)
+    current = load_epoch(directory)
+    if epoch <= current:
+        return current
+    tmp = os.path.join(directory, f".tmp-{FENCE_FILE}")
+    with open(tmp, "w") as fh:
+        json.dump({"epoch": int(epoch)}, fh)
+        rotation.fsync_file(fh)
+    os.replace(tmp, os.path.join(directory, FENCE_FILE))
+    rotation.fsync_dir(directory)
+    return int(epoch)
+
+
+def bump_epoch(directory: str, at_least: int = 0) -> int:
+    """Claim a NEW epoch strictly above both the on-disk record and
+    ``at_least`` (the highest epoch the promoting node saw in traffic) —
+    the promotion step."""
+    new = max(load_epoch(directory), int(at_least)) + 1
+    return save_epoch(directory, new)

@@ -1,0 +1,130 @@
+"""Length-prefixed replication transport: the wire between primary and
+followers.
+
+Deliberately minimal — one TCP socket per follower, full duplex (the
+primary's session thread sends, a paired reader thread consumes acks), and
+every message is::
+
+    u32 payload_len | u8 type | payload
+
+Control messages (HELLO/HEARTBEAT/ACK/FENCE/SNAP_*) carry JSON payloads;
+FRAME carries ``u64 epoch`` followed by the **verbatim on-disk WAL frame**
+(``crc|len|seq|kind|payload``) — the shipper forwards bytes it CRC-verified
+off disk, and the follower re-verifies the same CRC on receipt before
+appending the identical bytes to its own log. Snapshot catch-up ships the
+installed snapshot directory file-by-file (SNAP_FILE payload:
+``u16 name_len | name | bytes``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+_HDR = struct.Struct("<IB")      # payload length, message type
+_EPOCH = struct.Struct("<Q")     # FRAME epoch prefix
+_NAME = struct.Struct("<H")      # SNAP_FILE name length prefix
+
+# a single message never legitimately exceeds this (largest: one snapshot
+# npz); a bigger length prefix means a corrupt/hostile stream
+MAX_MSG_BYTES = 1 << 31
+
+HELLO = 1        # follower -> primary: {id, acked_seq, epoch}
+FRAME = 2        # primary -> follower: u64 epoch | raw WAL frame
+SNAP_BEGIN = 3   # primary -> follower: {wal_seq, epoch, files}
+SNAP_FILE = 4    # primary -> follower: u16 name_len | name | bytes
+SNAP_END = 5     # primary -> follower: {wal_seq}
+HEARTBEAT = 6    # primary -> follower: {last_seq, ts_ms, epoch}
+ACK = 7          # follower -> primary: {id, acked_seq, applied_seq, ts_ms}
+FENCE = 8        # either direction: {epoch} — sender witnessed a higher
+                 # fencing epoch than the peer's; peer must demote
+
+NAMES = {HELLO: "hello", FRAME: "frame", SNAP_BEGIN: "snap_begin",
+         SNAP_FILE: "snap_file", SNAP_END: "snap_end",
+         HEARTBEAT: "heartbeat", ACK: "ack", FENCE: "fence"}
+
+
+class ProtocolError(Exception):
+    """Malformed message on the replication socket."""
+
+
+def send_msg(sock: socket.socket, mtype: int, payload: bytes = b"") -> None:
+    sock.sendall(_HDR.pack(len(payload), mtype) + payload)
+
+
+def send_json(sock: socket.socket, mtype: int, obj: dict) -> None:
+    send_msg(sock, mtype, json.dumps(obj, separators=(",", ":")).encode())
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """``n`` bytes or None on clean EOF; raises on a mid-message EOF."""
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            if got == 0:
+                return None
+            raise ProtocolError(f"connection closed mid-message "
+                                f"({got}/{n} bytes)")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    """(type, payload) or None on clean EOF."""
+    hdr = recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    length, mtype = _HDR.unpack(hdr)
+    if length > MAX_MSG_BYTES:
+        raise ProtocolError(f"message length {length} over cap")
+    payload = recv_exact(sock, length) if length else b""
+    if length and payload is None:
+        raise ProtocolError("connection closed before payload")
+    return mtype, payload
+
+
+def parse_json(payload: bytes) -> dict:
+    try:
+        return json.loads(payload.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"bad json payload: {e}")
+
+
+def pack_frame(epoch: int, frame: bytes) -> bytes:
+    return _EPOCH.pack(epoch) + frame
+
+
+def unpack_frame(payload: bytes) -> Tuple[int, bytes]:
+    if len(payload) <= _EPOCH.size:
+        raise ProtocolError("short frame message")
+    return _EPOCH.unpack_from(payload)[0], payload[_EPOCH.size:]
+
+
+def pack_file(name: str, data: bytes) -> bytes:
+    nb = name.encode()
+    return _NAME.pack(len(nb)) + nb + data
+
+
+def unpack_file(payload: bytes) -> Tuple[str, bytes]:
+    if len(payload) < _NAME.size:
+        raise ProtocolError("short file message")
+    (nlen,) = _NAME.unpack_from(payload)
+    name = payload[_NAME.size:_NAME.size + nlen].decode()
+    if not name or "/" in name or "\\" in name or ".." in name:
+        raise ProtocolError(f"unsafe snapshot file name {name!r}")
+    return name, payload[_NAME.size + nlen:]
+
+
+def parse_addr(addr) -> Tuple[str, int]:
+    """'host:port' (or a (host, port) pair) -> (host, port)."""
+    if isinstance(addr, (tuple, list)):
+        return str(addr[0]), int(addr[1])
+    host, _, port = str(addr).rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad address {addr!r} (want host:port)")
+    return host, int(port)

@@ -65,6 +65,7 @@ class AdmissionController:
         self._in_flight: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._admitted: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._shed: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self._draining = False
         _metrics.set_gauge("admission.in_flight.interactive",
                            lambda: self._in_flight["interactive"])
         _metrics.set_gauge("admission.in_flight.batch",
@@ -84,6 +85,17 @@ class AdmissionController:
         exactly one ``release`` (the scheduler wires it to the request
         future's done-callback, covering every resolution path)."""
         p = normalize_priority(priority)
+        if self._draining:
+            # rolling restart / failover drain: shed EVERYTHING (even with
+            # admission disabled) so in-flight work settles and a promote
+            # can measure a quiesced node
+            with self._lock:
+                self._shed[p] += 1
+                n = self._in_flight[p]
+            _metrics.inc("admission.shed")
+            _metrics.inc(f"admission.shed.{p}")
+            raise ShedError(p, n, 0,
+                            float(config.ADMIT_RETRY_AFTER_S.get()))
         if not config.ADMIT_ENABLED.get():
             with self._lock:
                 self._in_flight[p] += 1
@@ -112,10 +124,27 @@ class AdmissionController:
             self._in_flight[priority] = max(
                 0, self._in_flight[priority] - 1)
 
+    def drain(self, draining: bool = True) -> None:
+        """Enter (or leave) drain mode: every new request sheds with 429 +
+        Retry-After while already-admitted work completes — the rolling-
+        restart / pre-failover quiesce step."""
+        self._draining = bool(draining)
+        _metrics.inc("admission.drains" if draining
+                     else "admission.undrains")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def in_flight_total(self) -> int:
+        with self._lock:
+            return sum(self._in_flight.values())
+
     def stats(self) -> dict:
         with self._lock:
             return {
                 "enabled": bool(config.ADMIT_ENABLED.get()),
+                "draining": self._draining,
                 "in_flight": dict(self._in_flight),
                 "limits": {p: self._limit(p) for p in PRIORITIES},
                 "admitted": dict(self._admitted),

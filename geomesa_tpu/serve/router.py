@@ -1,0 +1,392 @@
+"""ReplicaRouter: health-, overload- and lag-aware query routing across a
+replicated serving fleet.
+
+≙ the reference's reliance on the key-value store's client: an HBase/
+Accumulo scan transparently retries against whichever tablet server holds
+a healthy replica of the range. Here the router is explicit: it holds one
+Endpoint per fleet node (in-process store/Follower objects, or remote
+nodes addressed by their REST base URL), probes each node's `/healthz`
+surface (overload section, breaker state, replication lag, fencing), and
+spreads reads:
+
+  healthy   in the rotation — round-robin across primary + fresh replicas
+  demoted   out of the rotation but NOT dropped: a stale (lag over the
+            bounded-staleness budget), breaker-open, unhealthy-scheduler
+            or draining node still serves when nothing healthier is up —
+            availability beats freshness at the bottom of the ladder
+  down      probe/transport failure: skipped until a later probe revives
+
+Reads that need read-your-writes freshness pin to the primary
+(``freshness="strong"``); bounded reads accept any non-demoted node.
+Failover = ``promote()``: drain the old primary via admission control,
+pick the replica with the highest applied seq, and promote it under a new
+fencing epoch."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+HEALTHY, DEMOTED, DOWN = "healthy", "demoted", "down"
+
+
+class EndpointDown(Exception):
+    """Transport/probe failure against one endpoint."""
+
+
+class EndpointOverloaded(Exception):
+    """The endpoint shed the request (429) or failed fast (503)."""
+
+
+class NoEndpointAvailable(Exception):
+    """Every endpoint in the fleet is down."""
+
+
+class Endpoint:
+    """One fleet node. Subclasses implement the transport."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.last_probe: Optional[dict] = None
+        self.last_probe_ts = 0.0
+        self.failures = 0
+
+    # -- transport hooks ------------------------------------------------------
+
+    def _probe(self) -> dict:
+        raise NotImplementedError
+
+    def count(self, type_name: str, cql: str = "INCLUDE",
+              auths: Optional[list] = None,
+              deadline_ms: Optional[float] = None,
+              priority: str = "interactive") -> int:
+        raise NotImplementedError
+
+    def promote(self, port: int = 0) -> dict:
+        raise NotImplementedError
+
+    def drain(self) -> None:
+        raise NotImplementedError
+
+    # -- probing --------------------------------------------------------------
+
+    def probe(self, ttl_s: Optional[float] = None,
+              clock=time.monotonic) -> Optional[dict]:
+        """Cached health probe; None when the node is unreachable."""
+        if ttl_s is None:
+            ttl_s = float(config.REPL_PROBE_TTL_MS.get()) / 1000.0
+        now = clock()
+        if self.last_probe_ts and now - self.last_probe_ts < ttl_s:
+            return self.last_probe
+        try:
+            p = self._probe()
+            self.failures = 0
+        except Exception:
+            p = None
+            self.failures += 1
+        self.last_probe = p
+        self.last_probe_ts = now
+        return p
+
+    def classify(self, staleness_ms: Optional[float] = None) -> str:
+        p = self.probe()
+        if p is None:
+            return DOWN
+        if staleness_ms is None:
+            staleness_ms = float(config.REPL_STALENESS_MS.get())
+        if p.get("fenced") or p.get("draining") or p.get("breaker_open") \
+                or not p.get("scheduler_ok", True) \
+                or (p.get("lag_ms") or 0.0) > staleness_ms:
+            return DEMOTED
+        return HEALTHY
+
+    @property
+    def role(self) -> str:
+        return (self.last_probe or {}).get("role", "unknown")
+
+
+def _health_from_parts(role: str, repl_stats: Optional[dict],
+                       sched) -> dict:
+    """Canonical probe dict from a node's replication stats + live
+    scheduler (the same fields HttpEndpoint extracts from /healthz)."""
+    out = {"ok": True, "role": role, "fenced": False, "lag_ms": 0.0,
+           "lag_seqs": 0, "applied_seq": None, "epoch": None,
+           "scheduler_ok": True, "breaker_open": False, "queue_depth": 0,
+           "draining": False}
+    if repl_stats:
+        out["role"] = repl_stats.get("role", role)
+        out["fenced"] = bool(repl_stats.get("fenced"))
+        out["lag_ms"] = float(repl_stats.get("lag_ms") or 0.0)
+        out["lag_seqs"] = int(repl_stats.get("lag_seqs") or 0)
+        out["applied_seq"] = repl_stats.get("applied_seq",
+                                            repl_stats.get("last_seq"))
+        out["epoch"] = repl_stats.get("epoch")
+        if repl_stats.get("dead"):
+            raise EndpointDown("replica apply loop is dead")
+    if sched is not None:
+        out["scheduler_ok"] = sched.healthy()
+        out["breaker_open"] = sched.breaker.state != "closed"
+        out["queue_depth"] = sched._queue.qsize()
+        out["draining"] = sched.admission.draining
+    return out
+
+
+class LocalEndpoint(Endpoint):
+    """In-process node: a TpuDataStore, or a replication role object
+    (Follower / a store carrying a LogShipper)."""
+
+    def __init__(self, name: str, target):
+        super().__init__(name)
+        self.target = target
+
+    @property
+    def store(self):
+        # a Follower proxies to its live store (which it may swap across a
+        # snapshot install); a plain store is itself
+        return getattr(self.target, "store", self.target)
+
+    def _probe(self) -> dict:
+        store = self.store
+        if store.durability is not None and store.durability.closed:
+            raise EndpointDown("store is closed")
+        repl = getattr(store, "replication", None)
+        repl_stats = repl.stats() if repl is not None else None
+        role = repl_stats["role"] if repl_stats else "standalone"
+        sched = getattr(store, "_scheduler", None)  # live only, never spawn
+        return _health_from_parts(role, repl_stats, sched)
+
+    def count(self, type_name, cql="INCLUDE", auths=None, deadline_ms=None,
+              priority="interactive") -> int:
+        from geomesa_tpu.serve.resilience.admission import ShedError
+        from geomesa_tpu.serve.resilience.breaker import CircuitOpenError
+        try:
+            return self.store.count_coalesced(
+                type_name, cql, auths=auths, deadline_ms=deadline_ms,
+                priority=priority)
+        except (ShedError, CircuitOpenError) as e:
+            raise EndpointOverloaded(str(e))
+        except ValueError as e:
+            # a closed store surfaces as ValueError("WAL is closed") etc.
+            if "closed" in str(e):
+                raise EndpointDown(str(e))
+            raise
+
+    def promote(self, port: int = 0) -> dict:
+        shipper = self.target.promote(port=port)
+        self.target = self.store  # the Follower role object is done
+        return {"role": "primary", "epoch": shipper.epoch,
+                "address": shipper.address}
+
+    def drain(self) -> None:
+        self.store.scheduler().admission.drain(True)
+
+
+class HttpEndpoint(Endpoint):
+    """Remote node addressed by its REST base URL (web/server.py)."""
+
+    def __init__(self, name: str, base_url: str, timeout_s: float = 5.0):
+        super().__init__(name)
+        self.base = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def _request(self, path: str, method: str = "GET") -> dict:
+        req = urllib.request.Request(self.base + path, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code in (429, 503):
+                raise EndpointOverloaded(f"{self.name}: HTTP {e.code}")
+            raise EndpointDown(f"{self.name}: HTTP {e.code}")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise EndpointDown(f"{self.name}: {e}")
+
+    def _probe(self) -> dict:
+        hz = self._request("/healthz")
+        repl = hz.get("replication") or None
+        overload = hz.get("overload", {})
+        out = _health_from_parts("standalone", repl, None)
+        if overload.get("scheduler") not in (None, "idle", "ok"):
+            out["scheduler_ok"] = False
+        out["queue_depth"] = int(overload.get("queue_depth", 0))
+        breaker = overload.get("breaker") or {}
+        out["breaker_open"] = breaker.get("state", "closed") != "closed"
+        admission = overload.get("admission") or {}
+        out["draining"] = bool(admission.get("draining"))
+        return out
+
+    def count(self, type_name, cql="INCLUDE", auths=None, deadline_ms=None,
+              priority="interactive") -> int:
+        q = {"cql": cql, "priority": priority}
+        if auths:
+            q["auths"] = ",".join(auths)
+        if deadline_ms:
+            q["deadline_ms"] = str(deadline_ms)
+        out = self._request(f"/types/{type_name}/count?"
+                            + urllib.parse.urlencode(q))
+        return int(out["count"])
+
+    def promote(self, port: int = 0) -> dict:
+        return self._request(f"/replication/promote?port={int(port)}",
+                             method="POST")
+
+    def drain(self) -> None:
+        self._request("/replication/drain", method="POST")
+
+
+class ReplicaRouter:
+    """Spread queries across primary + replicas; fail over reads around
+    sick nodes; orchestrate promote-by-highest-acked-seq failover."""
+
+    def __init__(self, endpoints: List[Endpoint],
+                 staleness_ms: Optional[float] = None):
+        self.endpoints: Dict[str, Endpoint] = {e.name: e for e in endpoints}
+        self._staleness_ms = staleness_ms
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._n_requests = 0
+        self._n_failovers = 0
+        self._n_promotions = 0
+
+    # -- selection ------------------------------------------------------------
+
+    def _staleness(self) -> float:
+        return float(self._staleness_ms
+                     if self._staleness_ms is not None
+                     else config.REPL_STALENESS_MS.get())
+
+    def probe_all(self, force: bool = False) -> Dict[str, Optional[dict]]:
+        out = {}
+        for name, ep in self.endpoints.items():
+            if force:
+                ep.last_probe_ts = 0.0
+            out[name] = ep.probe()
+        return out
+
+    def _primary(self) -> Optional[Endpoint]:
+        for ep in self.endpoints.values():
+            p = ep.probe()
+            if p is not None and p.get("role") == "primary" \
+                    and not p.get("fenced"):
+                return ep
+        return None
+
+    def candidates(self, freshness: str = "bounded") -> List[Endpoint]:
+        """Ordered endpoints to try. strong → the primary only (read-your-
+        writes); bounded → healthy nodes in rotation, then demoted nodes
+        (stale replicas are demoted, never dropped), down nodes skipped."""
+        if freshness == "strong":
+            prim = self._primary()
+            if prim is None:
+                raise NoEndpointAvailable("no live primary for a strong "
+                                          "read")
+            return [prim]
+        staleness = self._staleness()
+        healthy, demoted = [], []
+        for ep in self.endpoints.values():
+            c = ep.classify(staleness)
+            if c == HEALTHY:
+                healthy.append(ep)
+            elif c == DEMOTED:
+                demoted.append(ep)
+        with self._lock:
+            self._rr += 1
+            rot = self._rr
+        healthy = healthy[rot % len(healthy):] + healthy[:rot % len(healthy)] \
+            if healthy else []
+        out = healthy + demoted
+        if not out:
+            raise NoEndpointAvailable("every endpoint is down")
+        return out
+
+    # -- serving --------------------------------------------------------------
+
+    def count(self, type_name: str, cql: str = "INCLUDE",
+              auths: Optional[list] = None,
+              deadline_ms: Optional[float] = None,
+              priority: str = "interactive",
+              freshness: str = "bounded") -> int:
+        """Route one count; fails over across candidates on transport
+        errors and overload sheds. Raises the last error when every
+        candidate refuses."""
+        self._n_requests += 1
+        _metrics.inc("router.requests")
+        last: Optional[Exception] = None
+        for i, ep in enumerate(self.candidates(freshness)):
+            try:
+                n = ep.count(type_name, cql, auths=auths,
+                             deadline_ms=deadline_ms, priority=priority)
+                _metrics.inc(f"router.served.{ep.name}")
+                if i > 0:
+                    self._n_failovers += 1
+                    _metrics.inc("router.read_failovers")
+                return n
+            except (EndpointDown, EndpointOverloaded) as e:
+                # transport death invalidates the cached probe immediately
+                if isinstance(e, EndpointDown):
+                    ep.last_probe = None
+                    ep.failures += 1
+                _metrics.inc("router.endpoint_errors")
+                last = e
+        raise last if last is not None else NoEndpointAvailable(
+            "no candidate endpoints")
+
+    # -- failover -------------------------------------------------------------
+
+    def promote(self, port: int = 0) -> dict:
+        """Failover: drain the old primary (when reachable), promote the
+        replica with the highest applied seq under a fresh fencing epoch,
+        and report whether the whole operation landed inside the
+        configured failover deadline budget."""
+        t0 = time.monotonic()
+        self.probe_all(force=True)
+        old = self._primary()
+        if old is not None:
+            try:
+                old.drain()
+            except Exception:
+                pass  # a dead primary cannot be drained — that's the point
+        replicas = [(ep.last_probe.get("applied_seq") or 0, name, ep)
+                    for name, ep in self.endpoints.items()
+                    if ep.last_probe is not None
+                    and ep.last_probe.get("role") == "replica"]
+        if not replicas:
+            raise NoEndpointAvailable("no live replica to promote")
+        replicas.sort(reverse=True)
+        seq, name, winner = replicas[0]
+        result = winner.promote(port=port)
+        self.probe_all(force=True)
+        dur_ms = (time.monotonic() - t0) * 1000.0
+        budget = float(config.REPL_FAILOVER_BUDGET_MS.get())
+        self._n_promotions += 1
+        _metrics.inc("router.promotions")
+        return {"promoted": name, "acked_seq": seq, "result": result,
+                "old_primary": old.name if old is not None else None,
+                "duration_ms": round(dur_ms, 1),
+                "budget_ms": budget,
+                "within_budget": dur_ms <= budget}
+
+    # -- surfaces -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        staleness = self._staleness()
+        return {
+            "staleness_ms": staleness,
+            "requests": self._n_requests,
+            "read_failovers": self._n_failovers,
+            "promotions": self._n_promotions,
+            "endpoints": {
+                name: {"state": ep.classify(staleness),
+                       "role": ep.role,
+                       "failures": ep.failures,
+                       "probe": ep.last_probe}
+                for name, ep in self.endpoints.items()},
+        }

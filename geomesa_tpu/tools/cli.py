@@ -12,9 +12,11 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|events|slo|kernels|scheduler|admission|wal
+    geomesa-tpu debug         metrics|traces|events|slo|kernels|scheduler|admission|wal|replication
                               [--format prometheus] [--slow MS] [--errors]
-                              [--kind K] [-s STORE -f NAME -q ECQL]
+                              [--kind K] [--addr HOST:PORT] [-s STORE -f NAME -q ECQL]
+    geomesa-tpu serve         -s STORE [--durable] [--ship-port P] [--port W]
+    geomesa-tpu replica       --dir DIR --follow HOST:PORT [--port W] [--id ID]
     geomesa-tpu perfwatch     check|update|show [--run BENCH_summary.json]
                               [--baseline perf/baselines.json] [--k 3]
                               [--report out.json]
@@ -303,6 +305,31 @@ def cmd_debug(args):
                                          kind=args.kind,
                                          type_name=args.feature)}
         print(json.dumps(out, indent=2, default=str))
+    elif args.what == "replication":
+        # fleet runbook surface: role/lag/ship state (from a RUNNING node
+        # via --addr, since replication state lives in the serving
+        # process), plus this process's replication/router/drill counters
+        out = {}
+        if args.addr:
+            import urllib.request
+            base = args.addr if args.addr.startswith("http") \
+                else f"http://{args.addr}"
+            for path, key in (("/replication", "replication"),
+                              ("/healthz", "healthz")):
+                try:
+                    with urllib.request.urlopen(base + path,
+                                                timeout=5) as r:
+                        out[key] = json.loads(r.read().decode())
+                except OSError as e:
+                    out[key] = {"error": str(e)}
+        snap = REGISTRY.snapshot_prefixed("replication.", "router.",
+                                          "drill.")
+        out["metrics"] = {k: v for k, v in snap.items() if v}
+        gauges = REGISTRY.snapshot()["gauges"]
+        out["lag"] = {k: gauges[k] for k in
+                      ("replication.lag_seqs", "replication.lag_ms",
+                       "replication.followers") if k in gauges}
+        print(json.dumps(out, indent=2, default=str))
     elif args.what == "slo":
         # burn-rate runbook surface: compliance + multi-window burn rates
         # + page/ticket state per objective
@@ -374,9 +401,47 @@ def cmd_config(args):
 
 def cmd_serve(args):
     from geomesa_tpu.web import serve
-    store = _load(args.store, must_exist=True)
-    print(f"Serving {args.store} on http://{args.host}:{args.port}")
+    if args.durable:
+        # a durable store dir (WAL + snapshots): recovery runs on open and
+        # every mutation is logged — the shape a replicated fleet requires
+        from geomesa_tpu.datastore import TpuDataStore
+        store = TpuDataStore.open(args.store)
+    else:
+        store = _load(args.store, must_exist=True)
+    if args.ship_port is not None:
+        from geomesa_tpu.replication.shipper import LogShipper
+        shipper = LogShipper(store, host=args.host, port=args.ship_port)
+        print(json.dumps({"shipping": shipper.address,
+                          "epoch": shipper.epoch}), flush=True)
+    print(f"Serving {args.store} on http://{args.host}:{args.port}",
+          flush=True)
     serve(store, host=args.host, port=args.port)
+
+
+def cmd_replica(args):
+    """Run a read replica: open (or create) the local durable copy at
+    --dir, follow the primary's log shipper at --follow host:port, and
+    optionally serve the read-only REST API on --port. Runs until
+    interrupted; `POST /replication/promote` (or a router failover) turns
+    it into a primary in place."""
+    import time as _time
+
+    from geomesa_tpu.replication.follower import Follower
+    from geomesa_tpu.web import serve
+    f = Follower(args.dir, args.follow, follower_id=args.id)
+    print(json.dumps({"replica": f.id, "dir": args.dir,
+                      "following": args.follow}), flush=True)
+    try:
+        if args.port:
+            print(f"Serving replica on http://{args.host}:{args.port}",
+                  flush=True)
+            serve(f, host=args.host, port=args.port)
+        else:
+            while not f.dead:
+                _time.sleep(0.5)
+            raise SystemExit("replica apply loop died")
+    finally:
+        f.close()
 
 
 def cmd_remove_schema(args):
@@ -487,7 +552,7 @@ def build_parser() -> argparse.ArgumentParser:
                       "WAL segment inspector")
     sp.add_argument("what", choices=("metrics", "traces", "events", "slo",
                                      "kernels", "scheduler", "admission",
-                                     "wal"))
+                                     "wal", "replication"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "
@@ -505,6 +570,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--kind", default=None,
                     help="match record kind / trace name / a span kind "
                          "present in the stage breakdown")
+    sp.add_argument("--addr", default=None, metavar="HOST:PORT",
+                    help="for `debug replication`: query a RUNNING node's "
+                         "/replication + /healthz instead of (only) this "
+                         "process's counters")
     sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser(
@@ -527,7 +596,31 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-s", "--store", required=True)
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8765)
+    sp.add_argument("--durable", action="store_true",
+                    help="treat -s as a durability dir (WAL + snapshots): "
+                         "recover on open, log every mutation — required "
+                         "for --ship-port")
+    sp.add_argument("--ship-port", type=int, default=None, metavar="PORT",
+                    help="also start the replication log shipper on this "
+                         "port (0 = ephemeral); followers connect with "
+                         "`geomesa-tpu replica --follow host:port`")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "replica",
+        help="run a read replica: follow a primary's log shipper, apply "
+             "shipped WAL frames into a local durable copy, optionally "
+             "serve the read-only REST API")
+    sp.add_argument("--dir", required=True,
+                    help="local durable store directory for this replica")
+    sp.add_argument("--follow", required=True, metavar="HOST:PORT",
+                    help="the primary's log-shipper address")
+    sp.add_argument("--id", default=None, help="stable follower id "
+                    "(default: the directory basename)")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0,
+                    help="serve the read-only REST API here (0 = no HTTP)")
+    sp.set_defaults(fn=cmd_replica)
 
     return p
 

@@ -50,9 +50,18 @@ Routes:
                                          histogram, cache hit rates)
   GET  /durability                     → WAL/snapshot status (policy, seq,
                                          unsynced bytes, last-snapshot age)
-  GET  /healthz                        → liveness + device count + durability
-                                         and recovery/replay state
+  GET  /replication                    → fleet role + fencing epoch +
+                                         follower acked/lag state
+  POST /replication/drain?off=         → admission drain (rolling restart /
+                                         pre-failover quiesce)
+  POST /replication/promote?port=      → promote this replica to primary
+                                         under a fresh fencing epoch
+  GET  /healthz                        → liveness + device count + durability,
+                                         recovery/replay and replication state
   GET  /config                         → system-property listing
+
+Mutating routes on a read-only replica (or a fenced ex-primary) return 403
+with ``{"kind": "fenced"}``.
 """
 
 from __future__ import annotations
@@ -68,10 +77,17 @@ import numpy as np
 
 
 class GeoJsonApi:
-    """Transport-agnostic request handler core."""
+    """Transport-agnostic request handler core. ``store`` may be a
+    TpuDataStore OR a replication Follower — a replica node serves the
+    same read API over whatever store the follower currently holds (it
+    swaps stores across a snapshot catch-up)."""
 
     def __init__(self, store):
-        self.store = store
+        self._target = store
+
+    @property
+    def store(self):
+        return getattr(self._target, "store", self._target)
 
     @staticmethod
     def _request_deadline(query: dict, headers) -> Optional[object]:
@@ -109,6 +125,7 @@ class GeoJsonApi:
                headers=None) -> Tuple[int, object]:
         from geomesa_tpu import trace as _trace
         from geomesa_tpu.index.guards import QueryGuardError, QueryTimeout
+        from geomesa_tpu.replication.fence import FencedError
         from geomesa_tpu.serve.resilience import deadline as _rdl
         from geomesa_tpu.serve.resilience.breaker import CircuitOpenError
         from geomesa_tpu.serve.resilience.admission import ShedError
@@ -127,6 +144,8 @@ class GeoJsonApi:
                          "retry_after_s": e.retry_after_s}
         except QueryTimeout as e:     # deadline exceeded / planner timeout
             return 504, {"error": str(e), "kind": "deadline"}
+        except FencedError as e:      # read-only replica / fenced ex-primary
+            return 403, {"error": str(e), "kind": "fenced"}
         except QueryGuardError as e:  # an interceptor vetoed the query
             return 400, {"error": str(e), "kind": "guard"}
         except (KeyError, ValueError, TypeError, IndexError,
@@ -185,6 +204,8 @@ class GeoJsonApi:
             if d is None:
                 return 200, {"enabled": False}
             return 200, d.status()
+        if parts and parts[0] == "replication":
+            return self._route_replication(parts[1:], method, query)
         if parts == ["healthz"]:
             import jax
             report = getattr(self.store, "recovery_report", None)
@@ -205,14 +226,19 @@ class GeoJsonApi:
                 slo = _slo_engine.summary()
             except Exception:
                 slo = {"status": "unknown"}
+            repl = getattr(self.store, "replication", None)
             return 200, {"status": "ok",
                          "devices": len(jax.local_devices()),
                          "types": len(self.store.get_type_names()),
                          "overload": overload,
                          "slo": slo,
+                         "replication": repl.stats() if repl is not None
+                         else {"role": "standalone"},
                          "durability": {
                              "enabled": d is not None,
                              "wal_policy": d.wal.policy if d else None,
+                             "wal_seq": d.wal.last_seq if d else None,
+                             "synced_seq": d.wal.synced_seq if d else None,
                              "unsynced_bytes": d.wal.unsynced_bytes
                              if d else None},
                          "recovery": report.to_dict() if report is not None
@@ -291,6 +317,38 @@ class GeoJsonApi:
                 n = self._ingest_geojson(t, fc)
                 return 200, {"ingested": n}
         return 404, {"error": f"no route {method} {path}"}
+
+    def _route_replication(self, rest, method, query):
+        """Fleet control surface.
+
+          GET  /replication          role + epoch + follower/lag state
+          POST /replication/drain    admission drain (rolling restart /
+                                     pre-failover quiesce); ?off=1 undoes
+          POST /replication/promote  promote THIS node (a Follower-backed
+                                     replica) to primary under a fresh
+                                     fencing epoch; ?port= picks the new
+                                     shipper port (0 = ephemeral)
+        """
+        repl = getattr(self.store, "replication", None)
+        if not rest:
+            if repl is None:
+                return 200, {"role": "standalone"}
+            return 200, repl.stats()
+        if rest == ["drain"] and method == "POST":
+            off = query.get("off", [None])[0] not in (None, "0", "false")
+            self.store.scheduler().admission.drain(not off)
+            return 200, {"draining": not off}
+        if rest == ["promote"] and method == "POST":
+            target = self._target
+            if not hasattr(target, "promote"):
+                return 400, {"error": "this node is not a promotable "
+                                      "replica", "kind": "bad_request"}
+            port = int(query.get("port", [0])[0])
+            shipper = target.promote(port=port)
+            return 200, {"role": "primary", "epoch": shipper.epoch,
+                         "address": shipper.address}
+        return 404, {"error": f"no route {method} /replication/"
+                              f"{'/'.join(rest)}"}
 
     def _ingest_geojson(self, t: str, fc: dict) -> int:
         feats = fc.get("features", [])

@@ -243,6 +243,55 @@ def test_wal_fsync_failure_injection(tmp_path):
     assert err is None and len(recs) == 3
 
 
+def test_wal_gap_reporting_distinguishes_torn_from_missing(tmp_path):
+    """stats()/inspect() report a contiguous-seq break explicitly
+    (first_gap_seq) and classify it: a cut in the FINAL segment is a torn
+    tail (a crash; nothing recoverable lost), a break with records after
+    it is a missing segment (they can never be ordered) — shippers and
+    recovery need the distinction instead of a silent stop."""
+    from geomesa_tpu.durability.wal import contiguity
+    # torn tail: truncate the last segment mid-frame
+    d1 = str(tmp_path / "torn")
+    w = WriteAheadLog(d1, fsync="off")
+    for i in range(4):
+        w.append_json("remove", {"type": "t", "fids": [f"f{i}"]})
+    w.close()
+    seg = segments(d1)[0]
+    with open(seg, "rb+") as fh:
+        fh.truncate(os.path.getsize(seg) - 5)
+    info = inspect(d1)
+    assert info["contiguity"]["gap_kind"] == "torn_tail"
+    assert info["contiguity"]["first_gap_seq"] == 4
+    assert info["contiguity"]["last_contiguous_seq"] == 3
+    assert info["contiguity"]["unreachable_records"] == 0
+    # missing segment: delete a middle segment; later records stranded
+    d2 = str(tmp_path / "gap")
+    w = WriteAheadLog(d2, fsync="off", segment_bytes=256)
+    for i in range(12):
+        w.append_json("remove", {"type": "t", "fids": [f"fid-{i:04d}"]})
+    w.close()
+    segs = segments(d2)
+    assert len(segs) >= 3
+    lost_first = next(s for s, _, _, _ in scan_segment(segs[1])[0])
+    os.remove(segs[1])
+    c = contiguity(d2)
+    assert c["gap_kind"] == "missing_segment"
+    assert c["first_gap_seq"] == lost_first
+    assert c["unreachable_records"] > 0
+    assert c["unreachable_segments"] == len(segs) - 2
+    # a WAL reopened over the damaged layout carries the diagnosis in
+    # stats(); a clean live log reports no gap
+    w2 = WriteAheadLog(d2, fsync="off", start_seq=100)
+    st = w2.stats()
+    assert st["first_gap_seq"] == lost_first
+    assert st["gap_kind"] == "missing_segment"
+    w2.close()
+    w3 = WriteAheadLog(str(tmp_path / "clean"), fsync="off")
+    w3.append_json("remove", {"type": "t", "fids": ["a"]})
+    assert w3.stats()["first_gap_seq"] is None
+    w3.close()
+
+
 # -- torn tails ---------------------------------------------------------------
 
 

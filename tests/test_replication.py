@@ -1,0 +1,630 @@
+"""Replicated serving fleet tests (ISSUE 7 acceptance suite).
+
+In-process fleets over real localhost sockets: WAL shipping + live
+tailing, snapshot catch-up, the kill-at-every-shipped-record-boundary
+convergence property (byte-identical vs the primary oracle), fencing /
+split-brain, the health- and lag-aware router, failover, the four fault
+drills, and the web/CLI/SLO surfaces. The multi-process qps + failover
+bench (2 replica server processes, ≥1.8x single-node read qps, promote
+under the failover deadline budget) is marked slow and runs in the CI
+``fleet`` job."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.durability import faults
+from geomesa_tpu.durability import wal as _wal
+from geomesa_tpu.replication import FencedError, Follower, LogShipper
+from geomesa_tpu.replication import drills
+from geomesa_tpu.replication.drills import SPEC, fingerprint, make_batch
+from geomesa_tpu.serve.router import (HttpEndpoint, LocalEndpoint,
+                                      NoEndpointAvailable, ReplicaRouter)
+
+BBOX_Q = ("BBOX(geom, -5, -5, 8, 8) AND "
+          "dtg DURING 2024-01-01T00:00:00Z/2024-01-02T00:00:00Z")
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _primary(tmp_path, name="primary", batches=1):
+    store = TpuDataStore.open(str(tmp_path / name),
+                              params={"wal.fsync": "off"})
+    store.create_schema("t", SPEC)
+    for i in range(batches):
+        store.load("t", make_batch(store.schemas["t"], i))
+    return store, LogShipper(store)
+
+
+# -- WAL shipping primitives --------------------------------------------------
+
+
+def test_wal_raw_tail_and_append_frame_byte_identical(tmp_path):
+    """A WAL rebuilt from tailed raw frames is record-identical (same
+    seqs, kinds, payload bytes) to the source, across segment rotation."""
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    w = _wal.WriteAheadLog(src, fsync="off", segment_bytes=400)
+    for i in range(15):
+        w.append_json("remove", {"type": "t", "fids": [f"fid-{i:04d}"]})
+    w.flush_to_os()
+    t = _wal.WalTailer(src)
+    frames = t.poll()
+    assert [f[0] for f in frames] == list(range(1, 16))
+    w2 = _wal.WriteAheadLog(dst, fsync="off", segment_bytes=400)
+    for _seq, _kind, frame in frames:
+        w2.append_frame(frame)
+    # incremental: later appends picked up from the saved offset
+    for i in range(3):
+        w.append_json("remove", {"type": "t", "fids": [f"x{i}"]})
+    w.flush_to_os()
+    more = t.poll()
+    assert [f[0] for f in more] == [16, 17, 18]
+    for _seq, _kind, frame in more:
+        w2.append_frame(frame)
+    w.close()
+    w2.close()
+    recs = lambda d: [(seq, kind, payload)  # noqa: E731
+                      for seg in _wal.segments(d)
+                      for seq, kind, payload, _ in _wal.scan_segment(seg)[0]]
+    assert recs(src) == recs(dst)
+
+
+def test_append_frame_rejects_corrupt_and_gap(tmp_path):
+    w = _wal.WriteAheadLog(str(tmp_path / "w"), fsync="off")
+    w.append_json("remove", {"fids": ["a"]})
+    w.flush_to_os()
+    (seq, _k, frame) = next(iter(_wal.WalTailer(w.dir).poll()))
+    bad = bytearray(frame)
+    bad[len(bad) // 2] ^= 0xFF
+    w2 = _wal.WriteAheadLog(str(tmp_path / "w2"), fsync="off")
+    with pytest.raises(ValueError, match="crc"):
+        w2.append_frame(bytes(bad))
+    with pytest.raises(ValueError, match="non-contiguous"):
+        # seq 1 expected; shipping seq 1 twice must also fail loudly
+        w2.append_frame(frame)
+        w2.append_frame(frame)
+    w.close()
+    w2.close()
+
+
+def test_ship_basic_and_live_tail(tmp_path):
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address, follower_id="r1")
+    try:
+        assert f.wait_for_seq(p.durability.wal.last_seq)
+        assert f.store.count("t") == p.count("t")
+        # live mutations of every shape ship through
+        p.load("t", make_batch(p.schemas["t"], 1))
+        p.remove_features("t", "v < 5")
+        p.update_features("t", "v > 90", {"name": "hot"})
+        p.upsert("t", make_batch(p.schemas["t"], 1))
+        assert f.wait_for_seq(p.durability.wal.last_seq)
+        assert fingerprint(p) == fingerprint(f.store)
+        assert f.store.count("t", BBOX_Q) == p.count("t", BBOX_Q)
+        # shipper tracks the follower's acked seq
+        st = ship.stats()["followers"]["r1"]
+        assert st["connected"] and st["acked_seq"] >= f.applied_seq - 1
+        assert ship.stats()["epoch"] == 1
+    finally:
+        f.close()
+        p.close()
+
+
+def test_generations_bump_on_replica_like_primary(tmp_path):
+    """Shipped applies go through the ordinary mutation paths, so the
+    replica's serving caches invalidate exactly as the primary's do."""
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address)
+    try:
+        f.wait_for_seq(p.durability.wal.last_seq)
+        sched = f.store.scheduler()
+        n1 = sched.count("t", BBOX_Q)
+        assert sched.count("t", BBOX_Q) == n1
+        assert sched.plans.stats()["hits"] >= 1
+        g_before = f.store.generation("t")
+        p.load("t", make_batch(p.schemas["t"], 7))
+        assert f.wait_for_seq(p.durability.wal.last_seq)
+        assert f.store.generation("t") > g_before
+        n2 = sched.count("t", BBOX_Q)
+        assert n2 == p.count("t", BBOX_Q)  # not the stale cached plan
+    finally:
+        f.close()
+        p.close()
+
+
+def test_snapshot_catchup_when_wal_gced(tmp_path):
+    p, ship = _primary(tmp_path, batches=3)
+    assert p.durability.snapshot()
+    p.load("t", make_batch(p.schemas["t"], 8))
+    # precondition: the log no longer contains seq 1
+    oldest = _wal.segment_first_seq(
+        _wal.segments(os.path.join(str(tmp_path / "primary"), "wal"))[0])
+    assert oldest > 1
+    f = Follower(str(tmp_path / "replica"), ship.address)
+    try:
+        assert f.wait_for_seq(p.durability.wal.last_seq)
+        assert f.snapshot_installs == 1
+        assert fingerprint(p) == fingerprint(f.store)
+        assert ship.stats()["followers"][f.id]["snapshots_shipped"] == 1
+    finally:
+        f.close()
+        p.close()
+
+
+# -- the kill-at-every-boundary convergence property ---------------------------
+
+
+def test_follower_killed_at_every_boundary_converges(tmp_path):
+    """Property: a follower killed at the k-th shipped-record boundary and
+    restarted on the same directory converges to byte-identical table
+    state vs the primary oracle, for every k in the shipped burst (the
+    replication twin of test_durability's kill-at-every-crash-point)."""
+    p, ship = _primary(tmp_path)
+    base_seq = p.durability.wal.last_seq
+    # one warm follower proves the burst ships; then per-k cold runs
+    ops = [
+        lambda s: s.load("t", make_batch(s.schemas["t"], 1)),
+        lambda s: s.remove_features("t", "v < 5"),
+        lambda s: s.load("t", make_batch(s.schemas["t"], 2)),
+        lambda s: s.update_features("t", "v > 90", {"name": "hot"}),
+        lambda s: s.upsert("t", make_batch(s.schemas["t"], 2)),
+        lambda s: s.age_off("t", now_ms=drills._DTG0 + 7_200_000),
+    ]
+    for op in ops:
+        op(p)
+    final_seq = p.durability.wal.last_seq
+    n_frames = final_seq  # follower applies from seq 1
+    want = fingerprint(p)
+    try:
+        for k in range(1, n_frames + 1):
+            rdir = str(tmp_path / f"replica-{k}")
+            faults.arm_serve_crash("repl.apply", at=k)
+            f1 = Follower(rdir, ship.address, follower_id=f"r{k}")
+            deadline = time.monotonic() + 10
+            while not f1.dead and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert f1.dead, f"k={k}: follower never died"
+            assert f1.applied_seq < final_seq
+            faults.reset()
+            f2 = Follower(rdir, ship.address, follower_id=f"r{k}")
+            assert f2.wait_for_seq(final_seq, timeout=15), f"k={k}"
+            assert fingerprint(f2.store) == want, f"k={k}: state differs"
+            f1.close()
+            f2.close()
+        assert base_seq < final_seq  # the burst was non-trivial
+    finally:
+        faults.reset()
+        p.close()
+
+
+# -- fault drills --------------------------------------------------------------
+
+
+def test_drill_replica_kill(tmp_path):
+    rep = drills.drill_replica_kill(str(tmp_path))
+    assert rep["ok"], rep
+    assert rep["zero_acked_lost"] and rep["fingerprint_equal"]
+
+
+def test_drill_lag_spike(tmp_path):
+    rep = drills.drill_lag_spike(str(tmp_path))
+    assert rep["ok"], rep
+    assert rep["demoted_during_spike"] and rep["recovered_healthy"]
+
+
+def test_drill_torn_frame(tmp_path):
+    rep = drills.drill_torn_frame(str(tmp_path))
+    assert rep["ok"], rep
+    assert rep["crc_rejects"] >= 1
+
+
+def test_drill_partition_fencing(tmp_path):
+    rep = drills.drill_partition(str(tmp_path))
+    assert rep["ok"], rep
+    assert rep["loser_write_refused"] and rep["no_stale_write_applied"]
+    assert rep["epochs"]["b"] > rep["epochs"]["a"]
+
+
+def test_drill_counters_scored(tmp_path):
+    from geomesa_tpu.metrics import REGISTRY
+    before = REGISTRY.snapshot()["counters"].get(
+        "drill.torn_frame.passed", 0)
+    assert drills.drill_torn_frame(str(tmp_path))["ok"]
+    after = REGISTRY.snapshot()["counters"].get("drill.torn_frame.passed", 0)
+    assert after == before + 1
+
+
+# -- router --------------------------------------------------------------------
+
+
+def test_router_spreads_and_strong_pins(tmp_path):
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address, follower_id="r1")
+    try:
+        f.wait_for_seq(p.durability.wal.last_seq)
+        router = ReplicaRouter([LocalEndpoint("primary", p),
+                                LocalEndpoint("r1", f)])
+        want = p.count("t")
+        assert all(router.count("t") == want for _ in range(8))
+        served = router.stats()
+        states = {k: v["state"] for k, v in served["endpoints"].items()}
+        assert states == {"primary": "healthy", "r1": "healthy"}
+        from geomesa_tpu.metrics import REGISTRY
+        c = REGISTRY.snapshot()["counters"]
+        # round-robin rotation actually spread the reads
+        assert c.get("router.served.primary", 0) > 0
+        assert c.get("router.served.r1", 0) > 0
+        # strong freshness pins to the primary
+        before = c.get("router.served.r1", 0)
+        for _ in range(4):
+            assert router.count("t", freshness="strong") == want
+        c2 = REGISTRY.snapshot()["counters"]
+        assert c2.get("router.served.r1", 0) == before
+    finally:
+        f.close()
+        p.close()
+
+
+def test_router_stale_replica_demoted_not_dropped(tmp_path):
+    """A replica past the staleness budget is demoted — but still serves
+    bounded reads when nothing healthier exists; strong reads fail."""
+    old = config.REPL_STALENESS_MS._override
+    config.REPL_STALENESS_MS.set(200.0)
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address, follower_id="r1")
+    try:
+        f.wait_for_seq(p.durability.wal.last_seq)
+        want = p.count("t")
+        router = ReplicaRouter([LocalEndpoint("primary", p),
+                                LocalEndpoint("r1", f)])
+        # stall the apply loop, then kill the primary: only the STALE
+        # replica remains
+        faults.arm_serve_delay("repl.apply", seconds=2.0, n=1)
+        p.load("t", make_batch(p.schemas["t"], 1))
+        time.sleep(0.6)
+        p.close()
+        router.probe_all(force=True)
+        states = {k: v["state"]
+                  for k, v in router.stats()["endpoints"].items()}
+        assert states["primary"] == "down"
+        assert states["r1"] == "demoted"
+        # bounded read: served (stale), not refused
+        assert router.count("t") == want
+        with pytest.raises(NoEndpointAvailable):
+            router.count("t", freshness="strong")
+    finally:
+        faults.reset()
+        f.close()
+        p.close()
+    config.REPL_STALENESS_MS.unset()
+    if old is not None:
+        config.REPL_STALENESS_MS.set(old)
+
+
+def test_router_failover_promotes_highest_acked(tmp_path):
+    p, ship = _primary(tmp_path)
+    f1 = Follower(str(tmp_path / "r1"), ship.address, follower_id="r1")
+    f2 = Follower(str(tmp_path / "r2"), ship.address, follower_id="r2")
+    try:
+        last = p.durability.wal.last_seq
+        f1.wait_for_seq(last)
+        f2.wait_for_seq(last)
+        # r2 falls behind: kill its apply loop, then more primary writes
+        faults.arm_serve_crash("repl.apply", at=1)
+        p.load("t", make_batch(p.schemas["t"], 1))
+        deadline = time.monotonic() + 10
+        while not f2.dead and not f1.dead and time.monotonic() < deadline:
+            time.sleep(0.005)
+        faults.reset()
+        survivor, casualty = (f1, f2) if f2.dead else (f2, f1)
+        survivor.wait_for_seq(p.durability.wal.last_seq)
+        want = p.count("t")
+        router = ReplicaRouter([
+            LocalEndpoint("primary", p),
+            LocalEndpoint("r1", f1), LocalEndpoint("r2", f2)])
+        p.close()  # primary dies
+        rep = router.promote()
+        assert rep["within_budget"], rep
+        # the survivor (highest applied seq) won
+        assert rep["promoted"] == survivor.id
+        assert survivor.store.replication.role == "primary"
+        # the new primary accepts writes; reads keep flowing
+        survivor.store.load(
+            "t", make_batch(survivor.store.schemas["t"], 9))
+        assert router.count("t", freshness="strong") == want + 40
+    finally:
+        faults.reset()
+        f1.close()
+        f2.close()
+        p.close()
+
+
+def test_router_drain_sheds_on_primary(tmp_path):
+    p, _ship = _primary(tmp_path)
+    try:
+        from geomesa_tpu.serve.resilience.admission import ShedError
+        ep = LocalEndpoint("primary", p)
+        ep.drain()
+        with pytest.raises(ShedError):
+            p.scheduler().count("t")
+        assert p.scheduler().admission.stats()["draining"]
+        p.scheduler().admission.drain(False)
+        assert p.count("t") == 40
+    finally:
+        p.close()
+
+
+# -- surfaces ------------------------------------------------------------------
+
+
+def test_healthz_and_replication_routes(tmp_path):
+    from geomesa_tpu.web.server import GeoJsonApi
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address, follower_id="r1")
+    try:
+        f.wait_for_seq(p.durability.wal.last_seq)
+        code, hz = GeoJsonApi(p).handle("GET", "/healthz", {})
+        assert code == 200
+        repl = hz["replication"]
+        assert repl["role"] == "primary" and repl["epoch"] == 1
+        assert "r1" in repl["followers"]
+        assert repl["followers"]["r1"]["acked_seq"] >= 1
+        assert hz["durability"]["synced_seq"] is not None
+        assert hz["durability"]["wal_seq"] == p.durability.wal.last_seq
+        # replica-side: the api serves THROUGH the follower object
+        api = GeoJsonApi(f)
+        code, hz = api.handle("GET", "/healthz", {})
+        assert hz["replication"]["role"] == "replica"
+        assert hz["replication"]["lag_seqs"] == 0
+        code, out = api.handle("GET", "/replication", {})
+        assert code == 200 and out["primary"] == ship.address
+        # standalone store reports standalone
+        plain = TpuDataStore()
+        code, hz = GeoJsonApi(plain).handle("GET", "/healthz", {})
+        assert hz["replication"] == {"role": "standalone"}
+    finally:
+        f.close()
+        p.close()
+
+
+def test_replica_web_is_read_only_and_promotable(tmp_path):
+    from geomesa_tpu.web.server import GeoJsonApi
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address, follower_id="r1")
+    try:
+        f.wait_for_seq(p.durability.wal.last_seq)
+        api = GeoJsonApi(f)
+        body = json.dumps({"features": [{
+            "id": "x1", "geometry": {"type": "Point", "coordinates": [1, 2]},
+            "properties": {"name": "a", "v": 1,
+                           "dtg": "2024-01-01T06:00:00"}}]}).encode()
+        code, out = api.handle("POST", "/types/t/features", {}, body)
+        assert code == 403 and out["kind"] == "fenced"
+        # reads fine
+        code, out = api.handle("GET", "/types/t/count", {})
+        assert code == 200 and out["count"] == p.count("t")
+        # direct mutation refused too
+        with pytest.raises(FencedError):
+            f.store.load("t", make_batch(f.store.schemas["t"], 3))
+        # promote via the control route, then writes succeed
+        code, out = api.handle("POST", "/replication/promote",
+                               {"port": ["0"]})
+        assert code == 200 and out["role"] == "primary"
+        assert out["epoch"] == 2
+        code, out = api.handle("POST", "/types/t/features", {}, body)
+        assert code == 200 and out["ingested"] == 1
+    finally:
+        f.close(keep_store=True)
+        f.store.close()
+        p.close()
+
+
+def test_replication_slo_objective_and_gauges(tmp_path):
+    from geomesa_tpu.metrics import REGISTRY
+    from geomesa_tpu.obs.slo import ENGINE
+    p, ship = _primary(tmp_path)
+    f = Follower(str(tmp_path / "replica"), ship.address)
+    try:
+        f.wait_for_seq(p.durability.wal.last_seq)
+        assert any(o.name == "replication_staleness"
+                   for o in ENGINE.objectives())
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            c = REGISTRY.snapshot()["counters"]
+            if c.get("replication.staleness_checks", 0) >= 2:
+                break
+            time.sleep(0.02)
+        snap = REGISTRY.snapshot()
+        assert snap["counters"].get("replication.staleness_checks", 0) >= 2
+        assert snap["gauges"].get("replication.lag_seqs") == 0
+        assert snap["gauges"].get("replication.followers", 0) >= 1
+        ev = ENGINE.evaluate()
+        assert "replication_staleness" in ev
+    finally:
+        f.close()
+        p.close()
+
+
+def test_cli_debug_replication(tmp_path, capsys):
+    from geomesa_tpu.tools.cli import main
+    # score a drill so the counters section has content
+    assert drills.drill_torn_frame(str(tmp_path))["ok"]
+    main(["debug", "replication"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["metrics"]["counters"].get("drill.torn_frame.passed", 0) >= 1
+    assert out["metrics"]["counters"].get("replication.applied_records",
+                                          0) >= 1
+    assert "replication.lag_seqs" in out["lag"]
+
+
+def test_cli_debug_wal_reports_gap(tmp_path, capsys):
+    from geomesa_tpu.tools.cli import main
+    d = str(tmp_path / "store")
+    store = TpuDataStore.open(d, params={"wal.fsync": "off",
+                                         "wal.segment_bytes": 400})
+    store.create_schema("t", SPEC)
+    for i in range(6):
+        store.load("t", make_batch(store.schemas["t"], i, n=10))
+    store.close()
+    segs = _wal.segments(os.path.join(d, "wal"))
+    assert len(segs) >= 3
+    os.remove(segs[1])  # strand everything past the hole
+    main(["debug", "wal", "-s", d])
+    out = json.loads(capsys.readouterr().out)
+    cont = out["contiguity"]
+    assert cont["gap_kind"] == "missing_segment"
+    assert cont["first_gap_seq"] is not None
+    assert cont["unreachable_records"] > 0
+
+
+# -- multi-process fleet (CI `fleet` job) --------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http(port, path="/healthz", timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=2) as r:
+                return json.loads(r.read().decode())
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"port {port} never became healthy")
+
+
+def _spawn_cli(*args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 device per serving process is plenty
+    return subprocess.Popen(
+        [sys.executable, "-m", "geomesa_tpu.tools.cli", *args],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+
+
+@pytest.mark.slow
+def test_multiprocess_fleet_scales_reads_and_fails_over(tmp_path):
+    """The acceptance bench: a primary serving process shipping to two
+    replica server processes over localhost sockets; a router over the two
+    replicas serves >= 1.8x the single-replica read qps (separate
+    processes = real parallelism), and a primary-kill failover promotes
+    a replica inside the failover deadline budget."""
+    pdir = str(tmp_path / "primary")
+    store = TpuDataStore.open(pdir, params={"wal.fsync": "off"})
+    store.create_schema("t", SPEC)
+    for i in range(4):
+        store.load("t", make_batch(store.schemas["t"], i, n=30_000))
+    want = store.count("t")
+    want_bbox = store.count("t", BBOX_Q)
+    store.close()
+    # measurement fairness: don't let router health probes (an extra
+    # /healthz per TTL expiry) eat into the measured windows
+    config.REPL_PROBE_TTL_MS.set(10_000.0)
+
+    ship_port, web_p = _free_port(), _free_port()
+    web_r1, web_r2 = _free_port(), _free_port()
+    procs = [_spawn_cli("serve", "-s", pdir, "--durable",
+                        "--ship-port", str(ship_port),
+                        "--port", str(web_p))]
+    try:
+        _wait_http(web_p, timeout=120)
+        for rdir, port, rid in ((str(tmp_path / "r1"), web_r1, "r1"),
+                                (str(tmp_path / "r2"), web_r2, "r2")):
+            procs.append(_spawn_cli(
+                "replica", "--dir", rdir,
+                "--follow", f"127.0.0.1:{ship_port}",
+                "--port", str(port), "--id", rid))
+        for port in (web_r1, web_r2):
+            _wait_http(port, timeout=120)
+        # replicas converged: applied everything the primary's WAL holds
+        # (lag_seqs alone is 0 before the first heartbeat arrives)
+        primary_seq = _wait_http(web_p)["durability"]["wal_seq"]
+        assert primary_seq >= 5
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            applied = [_wait_http(p)["replication"]["applied_seq"]
+                       for p in (web_r1, web_r2)]
+            if all(a >= primary_seq for a in applied):
+                break
+            time.sleep(0.5)
+        assert all(a >= primary_seq for a in applied), \
+            f"replicas never converged: {applied} < {primary_seq}"
+
+        ep1 = HttpEndpoint("r1", f"http://127.0.0.1:{web_r1}")
+        ep2 = HttpEndpoint("r2", f"http://127.0.0.1:{web_r2}")
+        for ep in (ep1, ep2):  # warm the serving path on both
+            assert ep.count("t", BBOX_Q) == want_bbox
+
+        def qps(router, n=240, threads=12):
+            router.probe_all(force=True)
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(threads) as pool:
+                res = list(pool.map(
+                    lambda _: router.count("t", BBOX_Q), range(n)))
+            dt = time.perf_counter() - t0
+            assert all(r == want_bbox for r in res)
+            return n / dt
+
+        single = max(qps(ReplicaRouter([ep1])) for _ in range(3))
+        fleet = max(qps(ReplicaRouter([ep1, ep2])) for _ in range(3))
+        ratio = fleet / single
+        print(f"single={single:.0f} qps fleet={fleet:.0f} qps "
+              f"ratio={ratio:.2f}")
+        assert ratio >= 1.8, f"2 replicas served only {ratio:.2f}x"
+
+        # primary-kill failover under the deadline budget
+        procs[0].kill()
+        procs[0].wait(timeout=30)
+        router = ReplicaRouter([
+            HttpEndpoint("primary", f"http://127.0.0.1:{web_p}"), ep1, ep2])
+        rep = router.promote()
+        assert rep["within_budget"], rep
+        assert rep["promoted"] in ("r1", "r2")
+        new_web = web_r1 if rep["promoted"] == "r1" else web_r2
+        hz = _wait_http(new_web)
+        assert hz["replication"]["role"] == "primary"
+        assert hz["replication"]["epoch"] >= 2
+        # the promoted node accepts a write; bounded reads keep flowing
+        body = json.dumps({"features": [{
+            "id": "post-failover",
+            "geometry": {"type": "Point", "coordinates": [0.5, 0.5]},
+            "properties": {"name": "a", "v": 1,
+                           "dtg": "2024-01-01T06:00:00"}}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{new_web}/types/t/features", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read().decode())["ingested"] == 1
+        assert router.count("t", freshness="strong") == want + 1
+    finally:
+        config.REPL_PROBE_TTL_MS.unset()
+        for proc in procs:
+            proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
