@@ -964,6 +964,7 @@ def main(args=None) -> int:
     print(json.dumps(out))
 
     # -- flat machine-stable summary + the regression gate ------------------
+    from geomesa_tpu import trace as _trace_mod
     from geomesa_tpu.obs import attrib as _attrib
     from geomesa_tpu.obs import perfwatch as _pw
     metrics = {k: v for k, v in detail.items()
@@ -984,6 +985,11 @@ def main(args=None) -> int:
             "mini": bool(args.mini),
             "configs": sorted(configs),
             "handicaps": dict(_HANDICAPS) or None,
+            # fleet attribution: which node produced this run, in which
+            # role — perfwatch baselines and federated scrapes are
+            # comparable per node, not just per machine class
+            "node_id": _trace_mod.node_id(),
+            "role": _trace_mod.node_role(),
         },
         "metrics": metrics,
         "kernels": _pw.kernel_summary(_attrib.snapshot()),

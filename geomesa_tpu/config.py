@@ -396,6 +396,43 @@ BENCH_MINI_N = _register(
     "Corpus size for bench.py --mini (the CI-runnable deterministic "
     "mini-bench the perf-smoke regression gate measures).")
 
+# -- fleet-wide observability (obs/federation.py + trace propagation) ---------
+
+NODE_ID = _register(
+    "GEOMESA_TPU_NODE_ID", "", str,
+    "Stable node identity for fleet observability (the `node` label on "
+    "federated metrics, the node dimension on traces/flight events, the "
+    "/healthz + BENCH_summary attribution). Empty = derived "
+    "hostname-pid-suffix, unique per process incarnation.")
+
+FED_PROPAGATE = _register(
+    "GEOMESA_TPU_FED_PROPAGATE", True, _parse_bool,
+    "Master switch for cross-process trace propagation: the router "
+    "injects X-Trace-Id/X-Span-Id/X-Trace-Node/X-Trace-Sampled on "
+    "proxied queries and the web layer opens the request trace as a "
+    "child of the remote parent. Off: every process traces in "
+    "isolation (the pre-fleet behavior).")
+
+FED_TTL_MS = _register(
+    "GEOMESA_TPU_FED_TTL_MS", 1000.0, float,
+    "Metrics-federation scrape cache TTL: the federator re-scrapes each "
+    "node's /healthz + /metrics?format=state at most this often; reads "
+    "inside the window serve the cached merge.")
+
+FED_TIMEOUT_S = _register(
+    "GEOMESA_TPU_FED_TIMEOUT_S", 2.0, float,
+    "Per-node scrape timeout for the metrics federator; a node that "
+    "cannot answer inside it is reported down in /fleet rather than "
+    "stalling the whole merged surface.")
+
+REPL_TRACE_EVERY = _register(
+    "GEOMESA_TPU_REPL_TRACE_EVERY", 64, int,
+    "Replication-pipeline exemplar cadence: every Nth applied frame on "
+    "a follower runs under a retained root trace whose id rides the ack "
+    "back to the primary and lands as the exemplar on the fleet "
+    "repl.e2e histogram (fleet p99 -> exemplar -> remote apply trace). "
+    "0 disables the traced applies (timers still populate).")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

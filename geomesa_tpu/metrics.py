@@ -50,6 +50,14 @@ def bucket_index(seconds: float) -> int:
     return min(i, _N_BUCKETS - 1)
 
 
+def sanitize_metric_name(name: str) -> str:
+    """Dotted registry name -> prometheus metric name (shared by the
+    process exposition and the federated fleet exposition, so the same
+    series keeps the same name in both)."""
+    return "geomesa_tpu_" + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name)
+
+
 class Histogram:
     """Log-scale fixed-bucket duration histogram (count/total/max +
     percentiles). Not internally locked — the registry lock covers it."""
@@ -170,6 +178,19 @@ class MetricsRegistry:
         value histogram — batch sizes, cover cardinalities, queue depths."""
         with self._lock:
             self._values[name].observe(value)
+
+    def observe_exemplar(self, name: str, seconds: float,
+                         trace_ref: str) -> None:
+        """Record one duration AND pin ``trace_ref`` as the bucket's
+        exemplar. Unlike drain-time exemplars (integer local trace ids
+        re-checked against tail retention), a PINNED exemplar is a string
+        reference to a trace on another node (e.g. a follower's apply
+        trace riding a replication ack) — the local retention filter
+        cannot vouch for it, so it is kept as-is until overwritten."""
+        with self._lock:
+            self._timers[name].observe(seconds)
+            self._exemplars.setdefault(name, {})[
+                bucket_index(seconds)] = (str(trace_ref), seconds)
 
     def feed_tree(self, root, trace_id: Optional[int] = None) -> None:
         """Defer a whole span tree (an object with ``walk()`` yielding nodes
@@ -325,6 +346,51 @@ class MetricsRegistry:
                           if k.startswith(prefixes)}
                 for section, values in snap.items()}
 
+    def export_state(self) -> dict:
+        """Bucket-exact registry state for metrics federation (the
+        ``/metrics?format=state`` payload): counters, gauge values, and
+        every timer/value histogram as (count, total, max, sparse
+        buckets). Every process shares ONE fixed log-bucket geometry
+        (BUCKET_BOUNDS), so a federator can merge histograms across
+        nodes LOSSLESSLY by summing bucket counts — fleet percentiles
+        are exactly what one process observing everything would report."""
+        self._pre_drain()
+        gauges = self._gauge_values()
+
+        def hist_state(h: Histogram) -> dict:
+            return {"count": h.count, "total": h.total_s, "max": h.max_s,
+                    "buckets": {str(i): c for i, c in enumerate(h.buckets)
+                                if c}}
+
+        with self._lock:
+            pairs = self._drain_locked()
+            reporters = list(self._reporters) if pairs else None
+            flt = self._exemplar_filter
+            exemplars = {}
+            for name, by_bucket in self._exemplars.items():
+                kept = {}
+                for bi, (tid, sec) in by_bucket.items():
+                    try:
+                        if isinstance(tid, str) or flt is None or flt(tid):
+                            kept[str(bi)] = [tid, sec]
+                    except Exception:
+                        pass
+                if kept:
+                    exemplars[name] = kept
+            out = {"bucket_geometry": [_N_BUCKETS, _BUCKET_MIN_S,
+                                       _BUCKET_FACTOR],
+                   "counters": dict(self._counters),
+                   "gauges": gauges,
+                   "timers": {k: hist_state(h)
+                              for k, h in self._timers.items()},
+                   "values": {k: hist_state(h)
+                              for k, h in self._values.items()},
+                   "exemplars": exemplars}
+        if pairs:
+            for name, seconds in pairs:
+                self._report(reporters, "timer", name, seconds)
+        return out
+
     def _export_locked_state(self):
         """One consistent view for the exposition: (counters, timer
         summaries+buckets, value summaries+buckets, exemplars) captured
@@ -346,9 +412,11 @@ class MetricsRegistry:
                 kept = {}
                 for bi, (tid, sec) in by_bucket.items():
                     # re-check retention at emission: a trace evicted from
-                    # the tail-sampled ring must not leave a dangling link
+                    # the tail-sampled ring must not leave a dangling link.
+                    # String refs are PINNED cross-node exemplars
+                    # (observe_exemplar) the local filter cannot judge.
                     try:
-                        if flt is None or flt(tid):
+                        if isinstance(tid, str) or flt is None or flt(tid):
                             kept[bi] = (tid, sec)
                     except Exception:
                         pass
@@ -392,10 +460,7 @@ class MetricsRegistry:
         on buckets where a tail-retained trace exists. Never emits NaN
         (empty timers emit count/sum only); every family name carries
         exactly one # TYPE line."""
-        def sane(name: str) -> str:
-            return "geomesa_tpu_" + "".join(
-                c if c.isalnum() or c == "_" else "_" for c in name)
-
+        sane = sanitize_metric_name
         counters, gauges, timers, values, exemplars = \
             self._export_locked_state()
         lines: List[str] = []

@@ -1,0 +1,504 @@
+"""Fleet-wide observability plane: metrics federation + trace stitching.
+
+PR 7 made the runtime horizontal (router, read replicas, WAL shipping);
+this module makes it OBSERVABLE as one system instead of N disconnected
+processes — the "refine close to the data, observe far from it" failure
+mode the reference avoids by serializing per-layer audit/stat transforms
+back to the client (PAPER.md), and Dapper avoids with propagated trace
+context (PAPERS.md).
+
+Three pieces:
+
+  Federator   scrapes each fleet node's ``/healthz`` and bucket-exact
+              ``/metrics?format=state`` on a TTL, merges counters by
+              summation and the fixed-geometry log-bucket histograms
+              EXACTLY (every process shares metrics.BUCKET_BOUNDS, so
+              summing bucket counts is lossless — fleet percentiles are
+              what one process observing everything would report), and
+              exposes: ``GET /fleet`` (per-node health/lag/seq/overload),
+              ``GET /fleet/metrics`` (Prometheus: per-node counter/gauge
+              samples under a ``node`` label, merged histogram families),
+              and fleet-level SLO burn rates (the Federator quacks like a
+              MetricsRegistry — ``timer_good_total``/``snapshot`` — so the
+              UNMODIFIED SloEngine evaluates objectives over merged
+              good/total: "count latency" is judged across the fleet).
+
+  stitch()    reassembles ONE cross-process trace tree from per-node
+              halves that share a propagated global id (trace.py's
+              inject_headers/extract_headers): the remote child's root
+              attaches under the parent span that made the hop, with the
+              per-hop NETWORK time made explicit (parent span wall time
+              minus remote root wall time = wire + serialization).
+
+  collect_trace()  fetches every node's ``GET /traces?id=<gid>`` halves
+              (plus this process's rings) for the stitcher — the engine
+              behind ``debug trace --fleet`` and the router's
+              ``GET /traces?id=``.
+
+Import discipline (obs/__init__ rule): config/metrics/trace/obs.* only —
+never planner/scheduler/datastore layers.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
+from geomesa_tpu.metrics import (BUCKET_BOUNDS, Histogram,
+                                 REGISTRY as _metrics, MetricsRegistry,
+                                 sanitize_metric_name)
+
+
+def _label(v: str) -> str:
+    """A well-formed prometheus label value (escape per exposition spec)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+class NodeScrape:
+    """One node's latest scrape result."""
+
+    __slots__ = ("name", "ok", "error", "healthz", "state", "ts")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ok = False
+        self.error: Optional[str] = None
+        self.healthz: Optional[dict] = None
+        self.state: Optional[dict] = None
+        self.ts = 0.0
+
+    @property
+    def node_id(self) -> str:
+        hz = self.healthz or {}
+        node = hz.get("node") or {}
+        return str(node.get("id") or self.name)
+
+    @property
+    def role(self) -> str:
+        hz = self.healthz or {}
+        node = hz.get("node") or {}
+        role = node.get("role")
+        if not role:
+            repl = hz.get("replication") or {}
+            role = repl.get("role", "standalone")
+        return str(role)
+
+
+def _local_fetch() -> Tuple[dict, dict]:
+    """The in-process node's (healthz-lite, state) — the router federates
+    its own router.* counters without scraping itself over HTTP."""
+    hz = {"status": "ok",
+          "node": {"id": _trace.node_id(), "role": _trace.node_role()}}
+    return hz, _metrics.export_state()
+
+
+class Federator:
+    """TTL-cached scrape + exact merge over a fixed set of fleet nodes.
+
+    ``nodes`` maps node name -> target: a base URL string
+    (``http://host:port`` or ``host:port``) scraped over HTTP, or None
+    for THIS process (read directly from the local registry)."""
+
+    def __init__(self, nodes: Dict[str, Optional[str]],
+                 ttl_ms: Optional[float] = None,
+                 timeout_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self.nodes: Dict[str, Optional[str]] = {}
+        for name, target in nodes.items():
+            if isinstance(target, str) and target \
+                    and not target.startswith("http"):
+                target = f"http://{target}"
+            self.nodes[name] = target.rstrip("/") if target else None
+        self._ttl_ms = ttl_ms
+        self._timeout_s = timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._scrapes: Dict[str, NodeScrape] = {}
+        self._last_refresh = 0.0
+        # fleet SLOs ride the unmodified burn-rate engine: the Federator
+        # itself implements the registry surface the engine reads
+        # (timer_good_total + snapshot()["counters"]) over MERGED state
+        from geomesa_tpu.obs import slo as _slo
+        self.engine = _slo.SloEngine(registry=self, clock=clock)
+        for obj in _slo.default_objectives():
+            self.engine.add(obj)
+        self.engine.add(_slo.replication_objective())
+
+    # -- scraping -------------------------------------------------------------
+
+    def _timeout(self) -> float:
+        return float(self._timeout_s if self._timeout_s is not None
+                     else config.FED_TIMEOUT_S.get())
+
+    def _fetch_json(self, base: str, path: str) -> dict:
+        with urllib.request.urlopen(base + path,
+                                    timeout=self._timeout()) as r:
+            return json.loads(r.read().decode())
+
+    def _scrape(self, name: str, target: Optional[str]) -> NodeScrape:
+        s = NodeScrape(name)
+        s.ts = self._clock()
+        try:
+            if target is None:
+                s.healthz, s.state = _local_fetch()
+            else:
+                s.healthz = self._fetch_json(target, "/healthz")
+                body = self._fetch_json(target, "/metrics?format=state")
+                s.state = body.get("state", body)
+                # healthz node attribution wins; state meta is the backup
+                if "node" not in s.healthz and "node" in body:
+                    s.healthz["node"] = body["node"]
+            s.ok = True
+            _metrics.inc("federation.scrapes")
+        except Exception as e:
+            s.error = str(e)
+            _metrics.inc("federation.scrape_errors")
+        return s
+
+    def refresh(self, force: bool = False) -> Dict[str, NodeScrape]:
+        """Scrape every node unless the cached merge is inside the TTL."""
+        ttl_s = float(self._ttl_ms if self._ttl_ms is not None
+                      else config.FED_TTL_MS.get()) / 1000.0
+        now = self._clock()
+        with self._lock:
+            if not force and self._scrapes \
+                    and now - self._last_refresh < ttl_s:
+                return dict(self._scrapes)
+        scrapes = {name: self._scrape(name, target)
+                   for name, target in self.nodes.items()}
+        with self._lock:
+            self._scrapes = scrapes
+            self._last_refresh = now
+            return dict(scrapes)
+
+    def _states(self) -> List[NodeScrape]:
+        return [s for s in self.refresh().values() if s.ok and s.state]
+
+    # -- exact merge ----------------------------------------------------------
+
+    def merged_counters(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self._states():
+            for k, v in (s.state.get("counters") or {}).items():
+                out[k] = out.get(k, 0) + int(v)
+        return out
+
+    @staticmethod
+    def _fold(h: Histogram, hs: dict) -> None:
+        h.count += int(hs.get("count", 0))
+        h.total_s += float(hs.get("total", 0.0))
+        h.max_s = max(h.max_s, float(hs.get("max", 0.0)))
+        for i, c in (hs.get("buckets") or {}).items():
+            h.buckets[int(i)] += int(c)
+
+    def _merged_hists(self, section: str) \
+            -> Dict[str, Tuple[Histogram, Dict[int, tuple]]]:
+        """name -> (exactly-merged Histogram, exemplars by bucket). An
+        integer exemplar ref from node N rewrites to N's global trace id
+        (``<node>-<local id>``) so a fleet reader can fetch it."""
+        out: Dict[str, Tuple[Histogram, Dict[int, tuple]]] = {}
+        for s in self._states():
+            exemplars = s.state.get("exemplars") or {}
+            for name, hs in (s.state.get(section) or {}).items():
+                if name not in out:
+                    out[name] = (Histogram(), {})
+                h, ex = out[name]
+                self._fold(h, hs)
+                if section == "timers":
+                    for bi, ref in (exemplars.get(name) or {}).items():
+                        tid, sec = ref[0], float(ref[1])
+                        if not isinstance(tid, str):
+                            tid = f"{s.node_id}-{tid}"
+                        ex[int(bi)] = (tid, sec)
+        return out
+
+    # -- the registry surface the SLO engine reads ----------------------------
+
+    def timer_good_total(self, name: str, threshold_s: float):
+        """Merged (good, total) for one timer across the fleet — the
+        fleet-latency-SLO feed (same bucket-resolution semantics as
+        MetricsRegistry.timer_good_total, merged losslessly)."""
+        good = total = 0
+        for s in self._states():
+            hs = (s.state.get("timers") or {}).get(name)
+            if not hs:
+                continue
+            total += int(hs.get("count", 0))
+            for i, c in (hs.get("buckets") or {}).items():
+                if BUCKET_BOUNDS[int(i)] <= threshold_s:
+                    good += int(c)
+        return good, total
+
+    def snapshot(self) -> dict:
+        """Registry-shaped view of the merged fleet (counters merged by
+        summation; the availability-SLO feed)."""
+        return {"counters": self.merged_counters()}
+
+    # -- surfaces -------------------------------------------------------------
+
+    def slo(self) -> dict:
+        """Fleet-level burn rates over MERGED good/total samples — 'count
+        latency' judged across the fleet, not per node."""
+        return self.engine.evaluate()
+
+    def fleet(self) -> dict:
+        """The single pane of glass: per-node health, role, replication
+        lag, wal/synced seq, overload (admission/breaker/queue), fenced
+        and draining state — plus the fleet SLO rollup."""
+        nodes = {}
+        for name, s in sorted(self.refresh().items()):
+            if not s.ok:
+                nodes[name] = {"ok": False, "error": s.error}
+                continue
+            hz = s.healthz or {}
+            repl = hz.get("replication") or {}
+            over = hz.get("overload") or {}
+            dur = hz.get("durability") or {}
+            nodes[name] = {
+                "ok": True,
+                "node_id": s.node_id,
+                "role": s.role,
+                "status": hz.get("status"),
+                "fenced": bool(repl.get("fenced")),
+                "lag_ms": repl.get("lag_ms"),
+                "lag_seqs": repl.get("lag_seqs"),
+                "applied_seq": repl.get("applied_seq",
+                                        repl.get("last_seq")),
+                "epoch": repl.get("epoch"),
+                "wal_seq": dur.get("wal_seq"),
+                "synced_seq": dur.get("synced_seq"),
+                "scheduler": over.get("scheduler"),
+                "queue_depth": over.get("queue_depth"),
+                "admission": over.get("admission"),
+                "breaker": (over.get("breaker") or {}).get("state"),
+                "draining": bool((over.get("admission") or {})
+                                 .get("draining")),
+                "slo": (hz.get("slo") or {}).get("status"),
+            }
+        return {"nodes": nodes,
+                "slo": self.slo(),
+                "repl_e2e_ms": self._repl_e2e_summary()}
+
+    def _repl_e2e_summary(self) -> Optional[dict]:
+        merged = self._merged_hists("timers")
+        pair = merged.get("repl.e2e")
+        if pair is None or pair[0].count == 0:
+            return None
+        h, ex = pair
+        out = h.to_dict()
+        out["exemplars"] = {str(BUCKET_BOUNDS[bi]): tid
+                            for bi, (tid, _sec) in sorted(ex.items())}
+        return out
+
+    def to_prometheus(self) -> str:
+        """Federated exposition: counter/gauge families carry one sample
+        PER NODE under a ``node`` label; timer/value histograms are
+        merged fleet-wide (summary quantiles + native cumulative
+        ``_bucket`` lines, exemplars rewritten to fetchable global trace
+        ids). One # TYPE line per family across all nodes."""
+        scrapes = [s for s in self.refresh().values() if s.ok and s.state]
+        lines: List[str] = []
+        # counters: one family, one labeled sample per node
+        families: Dict[str, List[tuple]] = {}
+        for s in scrapes:
+            for name, v in (s.state.get("counters") or {}).items():
+                families.setdefault(name, []).append((s.node_id, v))
+        for name in sorted(families):
+            m = sanitize_metric_name(name) + "_total"
+            lines.append(f"# TYPE {m} counter")
+            for nid, v in sorted(families[name]):
+                lines.append(f'{m}{{node="{_label(nid)}"}} {v}')
+        # gauges: same, honoring the monotone *_total-exports-as-counter
+        # contract the per-process exposition applies
+        families = {}
+        for s in scrapes:
+            for name, v in (s.state.get("gauges") or {}).items():
+                try:
+                    families.setdefault(name, []).append((s.node_id,
+                                                          float(v)))
+                except (TypeError, ValueError):
+                    continue
+        for name in sorted(families):
+            m = sanitize_metric_name(name)
+            kind = "counter" if name.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {m} {kind}")
+            for nid, v in sorted(families[name]):
+                lines.append(f'{m}{{node="{_label(nid)}"}} {v:g}')
+        # histograms: merged exactly (same buckets on every node)
+        for section, suffix in (("timers", "_seconds"), ("values", "")):
+            merged = self._merged_hists(section)
+            for name in sorted(merged):
+                h, ex = merged[name]
+                m = sanitize_metric_name(name) + suffix
+                summ = h.to_dict()
+                lines.append(f"# TYPE {m} summary")
+                if h.count:
+                    for q, key in ((0.5, "p50_ms"), (0.9, "p90_ms"),
+                                   (0.99, "p99_ms")):
+                        lines.append(f'{m}{{quantile="{q}"}} '
+                                     f'{summ[key] / 1000:.9g}')
+                lines.append(f"{m}_count {h.count}")
+                lines.append(f"{m}_sum {h.total_s:.9g}")
+                mh = m + "_hist"
+                lines.append(f"# TYPE {mh} histogram")
+                MetricsRegistry._bucket_lines(lines, mh, h.buckets,
+                                              h.count, h.total_s,
+                                              ex or None)
+        return "\n".join(lines) + "\n"
+
+
+# -- trace stitching ----------------------------------------------------------
+
+
+def _index_spans(span: dict, node: Optional[str],
+                 index: Dict[int, tuple]) -> None:
+    sid = span.get("span_id")
+    if sid is not None:
+        index[int(sid)] = (span, node)
+    for c in span.get("children") or ():
+        _index_spans(c, node, index)
+
+
+def stitch(traces: List[dict]) -> Optional[dict]:
+    """Assemble ONE cross-process tree from per-node trace halves sharing
+    a global id. The half with no remote parent is the root; every other
+    half attaches under the span its ``parent.span`` names, wrapped in a
+    synthetic ``remote`` span whose ``network_ms`` makes the hop cost
+    explicit (parent span wall time minus remote root wall time)."""
+    if not traces:
+        return None
+    roots = [t for t in traces if not t.get("parent")]
+    root = roots[0] if roots else min(
+        traces, key=lambda t: t.get("ts_ms", 0))
+    tree = copy.deepcopy(root.get("root") or {})
+    tree["node"] = root.get("node")
+    tree["role"] = root.get("role")
+    index: Dict[int, tuple] = {}
+    _index_spans(tree, root.get("node"), index)
+    hops = []
+    rest = sorted((t for t in traces if t is not root),
+                  key=lambda t: t.get("ts_ms", 0))
+    for ch in rest:
+        parent = (ch.get("parent") or {})
+        pspan, pnode = index.get(int(parent.get("span") or 0),
+                                 (None, None))
+        child_tree = copy.deepcopy(ch.get("root") or {})
+        child_tree["node"] = ch.get("node")
+        child_tree["role"] = ch.get("role")
+        net = None
+        if pspan is not None:
+            net = round(max(0.0, float(pspan.get("duration_ms", 0.0))
+                            - float(ch.get("duration_ms", 0.0))), 3)
+        remote = {"name": f"remote:{ch.get('node')}", "kind": "remote",
+                  "node": ch.get("node"), "role": ch.get("role"),
+                  "duration_ms": ch.get("duration_ms"),
+                  "network_ms": net,
+                  "children": [child_tree]}
+        target = pspan if pspan is not None else tree
+        target.setdefault("children", []).append(remote)
+        _index_spans(child_tree, ch.get("node"), index)
+        hops.append({"from": pnode or root.get("node"),
+                     "to": ch.get("node"), "network_ms": net,
+                     "remote_ms": ch.get("duration_ms")})
+    return {"global_id": root.get("global_id"), "name": root.get("name"),
+            "duration_ms": root.get("duration_ms"),
+            "nodes": [root.get("node")] + [t.get("node") for t in rest],
+            "hops": hops, "spans": tree}
+
+
+def render_stitched(st: Optional[dict]) -> str:
+    """ASCII tree of a stitched trace — ``debug trace --fleet`` output."""
+    if st is None:
+        return "(no trace halves found)"
+    lines = [f"trace {st.get('global_id')} [{st.get('name')}] "
+             f"{st.get('duration_ms')}ms across {st.get('nodes')}"]
+
+    def walk(span: dict, depth: int) -> None:
+        pad = "  " * depth
+        extra = ""
+        if span.get("kind") == "remote":
+            extra = (f"  node={span.get('node')}"
+                     f" network={span.get('network_ms')}ms")
+        elif span.get("node"):
+            extra = f"  node={span.get('node')} ({span.get('role')})"
+        lines.append(f"{pad}{span.get('name')} "
+                     f"[{span.get('kind')}] {span.get('duration_ms')}ms"
+                     f"{extra}")
+        for c in span.get("children") or ():
+            walk(c, depth + 1)
+
+    walk(st.get("spans") or {}, 1)
+    return "\n".join(lines)
+
+
+def local_traces_by_id(gid: str) -> List[dict]:
+    """This process's halves of a (global or local) trace id, searched
+    across the recent ring AND the tail-sampled ring."""
+    from geomesa_tpu.obs.sampling import SAMPLER
+    gid = str(gid)
+    seen, out = set(), []
+    for t in _trace.RING.recent(None) + SAMPLER.recent(None):
+        if t.get("global_id") == gid or str(t.get("id")) == gid:
+            key = (t.get("node"), t.get("id"))
+            if key not in seen:
+                seen.add(key)
+                out.append(t)
+    return out
+
+
+def fetch_traces(base_url: str, gid: str,
+                 timeout_s: Optional[float] = None) -> List[dict]:
+    """One node's halves of a global trace via ``GET /traces?id=``."""
+    base = base_url if base_url.startswith("http") \
+        else f"http://{base_url}"
+    url = f"{base.rstrip('/')}/traces?id={urllib.parse.quote(str(gid))}"
+    t = float(timeout_s if timeout_s is not None
+              else config.FED_TIMEOUT_S.get())
+    with urllib.request.urlopen(url, timeout=t) as r:
+        return json.loads(r.read().decode()).get("traces", [])
+
+
+def collect_trace(gid: str, nodes: Dict[str, Optional[str]]) -> List[dict]:
+    """Every reachable node's halves of ``gid`` (local process included
+    for None targets), deduplicated by (node, local id)."""
+    seen, out = set(), []
+    for name, target in nodes.items():
+        try:
+            halves = local_traces_by_id(gid) if target is None \
+                else fetch_traces(target, gid)
+        except Exception:
+            _metrics.inc("federation.trace_fetch_errors")
+            continue
+        for t in halves:
+            key = (t.get("node"), t.get("id"))
+            if key not in seen:
+                seen.add(key)
+                out.append(t)
+    return out
+
+
+# -- process-global federator (the /fleet surface's backing) ------------------
+
+FEDERATOR: Optional[Federator] = None
+
+
+def configure(nodes: Dict[str, Optional[str]],
+              ttl_ms: Optional[float] = None) -> Federator:
+    """Install the process-global federator backing ``GET /fleet`` /
+    ``GET /fleet/metrics`` on this node's web surface (the router/primary
+    is the natural host; any node can federate)."""
+    global FEDERATOR
+    FEDERATOR = Federator(nodes, ttl_ms=ttl_ms)
+    return FEDERATOR
+
+
+def federator() -> Optional[Federator]:
+    return FEDERATOR

@@ -264,10 +264,15 @@ def event_from_request(req, fut) -> dict:
     def ms(seconds):
         return None if seconds is None else round(seconds * 1000.0, 3)
 
+    from geomesa_tpu import trace as _trace
     return {
         "kind": "count.scheduled",
         "type": req.type_name,
         "trace_id": req.trace_id,
+        "trace_gid": req.trace_gid,
+        "node_id": _trace.node_id(),
+        "role": _trace.node_role(),
+        "parent_span": req.parent_span,
         "plan_hash": plan_hash(req.type_name, req.f_key, req.auths_key),
         "duration_ms": round(
             (_time.perf_counter() - req.t_submit) * 1000.0, 3),
@@ -315,16 +320,23 @@ def event_from_trace(t, retained: bool = False,
     paths: direct counts, feature queries, explain). ``stages`` is an
     optional precomputed per-kind self-time breakdown (the close hook
     shares one span walk between sampling and this)."""
+    from geomesa_tpu import trace as _trace
     if stages is None:
         stages = t.self_times_ms()
     device_ms = stages.get("device_scan", 0.0) + stages.get("device_wait", 0.0)
     attrs = t.root.attrs or {}
     f = attrs.get("filter")
+    parent = getattr(t, "parent", None)
     ev = {
         "ts_ms": t.ts_ms,
         "kind": t.name,
         "type": attrs.get("type"),
         "trace_id": t.trace_id,
+        "trace_gid": t.global_id,
+        "node_id": _trace.node_id(),
+        "role": _trace.node_role(),
+        "parent_span": parent.span_id if parent is not None else None,
+        "parent_node": parent.node if parent is not None else None,
         "retained": bool(retained),
         "duration_ms": round(t.duration_ms, 3),
         "device_ms": round(device_ms, 3),

@@ -128,6 +128,11 @@ class TailSampler:
                 reason = "error"
             elif not _KEEP_KINDS.isdisjoint(kinds):
                 reason = "outcome"
+            elif getattr(t, "sampled_hint", False):
+                # the propagated cross-process decision (trace.py): when
+                # the upstream hop keeps its half, every downstream half
+                # is kept too — a stitched fleet trace is never partial
+                reason = "propagated"
             elif dur_ms >= self._slow_threshold_ms():
                 reason = "slow"
             elif self._rng.random() < float(config.OBS_SAMPLE.get()):

@@ -82,6 +82,15 @@ class Follower:
         self._lock = threading.Lock()
         self._snap_tmp: Optional[str] = None
         self._snap_meta: Optional[dict] = None
+        # replication-pipeline telemetry: the newest applied ship stamp
+        # (echoed in acks for the primary's e2e timing), the retained
+        # apply-trace id riding along as the e2e exemplar, and the
+        # traced-apply cadence countdown
+        self._last_ship_ts = 0.0
+        self._last_apply_trace: Optional[str] = None
+        self._until_traced_apply = 1
+        from geomesa_tpu import trace as _trace
+        _trace.set_node_role("replica")
         _metrics.set_gauge("replication.lag_seqs", lambda: self.lag_seqs)
         _metrics.set_gauge("replication.lag_ms",
                            lambda: round(self.lag_ms, 1))
@@ -204,10 +213,10 @@ class Follower:
                 return
             mtype, payload = m
             if mtype == _p.FRAME:
-                epoch, frame = _p.unpack_frame(payload)
+                epoch, ship_ts, frame = _p.unpack_frame(payload)
                 if not self._epoch_ok(sock, epoch):
                     return
-                seq = self._apply_frame(frame)
+                seq = self._apply_frame(frame, ship_ts=ship_ts)
                 if seq is not None and seq - last_acked >= ack_every:
                     self._ack(sock)
                     last_acked = seq
@@ -262,10 +271,16 @@ class Follower:
 
     # -- applying -------------------------------------------------------------
 
-    def _apply_frame(self, frame: bytes) -> Optional[int]:
+    def _apply_frame(self, frame: bytes,
+                     ship_ts: float = 0.0) -> Optional[int]:
         """Verify, locally log, then apply one shipped frame; returns its
-        seq (None when it was an already-held duplicate)."""
+        seq (None when it was an already-held duplicate). Every
+        REPL_TRACE_EVERY-th apply runs under a RETAINED root trace whose
+        global id rides the next ack back to the primary as the
+        ``repl.e2e`` exemplar — the fleet p99 links to a concrete remote
+        apply a reader can pull up."""
         faults.serve_gate("repl.apply")
+        from geomesa_tpu import trace as _trace
         try:
             seq, kind_name, payload = _wal.verify_frame(frame)
         except ValueError as e:
@@ -277,7 +292,27 @@ class Follower:
             wal.append_frame(frame)
         except ValueError as e:
             self._reject_crc(str(e))
-        self._apply_record(kind_name, payload)
+        traced = False
+        every = int(config.REPL_TRACE_EVERY.get())
+        if every > 0 and _trace.enabled():
+            self._until_traced_apply -= 1
+            traced = self._until_traced_apply <= 0
+        if traced:
+            self._until_traced_apply = every
+            with _trace.trace("repl.apply", seq=seq,
+                              kind=kind_name) as t:
+                if t is not None:
+                    t.sampled_hint = True  # pin it in the tail ring
+                    self._last_apply_trace = t.global_id
+                self._apply_record(kind_name, payload)
+        else:
+            self._apply_record(kind_name, payload)
+        if ship_ts:
+            # per-hop ship→apply latency (shared wall clock): the
+            # follower half of the replication-pipeline breakdown
+            self._last_ship_ts = max(self._last_ship_ts, ship_ts)
+            _metrics.observe("repl.ship_to_apply",
+                             max(0.0, time.time() - ship_ts))
         self.applied_seq = seq
         self.applied_records += 1
         self._acked_seq = wal.last_seq
@@ -330,10 +365,18 @@ class Follower:
         faults.serve_gate("repl.ack")
         wal = self.store.durability.wal
         self._acked_seq = wal.last_seq
-        _p.send_json(sock, _p.ACK,
-                     {"id": self.id, "acked_seq": wal.last_seq,
-                      "applied_seq": self.applied_seq,
-                      "ts_ms": time.time() * 1000.0})
+        ack = {"id": self.id, "acked_seq": wal.last_seq,
+               "applied_seq": self.applied_seq,
+               "ts_ms": time.time() * 1000.0}
+        if self._last_ship_ts:
+            # echo the newest applied ship stamp (+ the retained apply
+            # trace, once) so the primary times ship→apply→ack and pins
+            # the repl.e2e exemplar to a fetchable remote trace
+            ack["ship_ts"] = self._last_ship_ts
+            if self._last_apply_trace is not None:
+                ack["apply_trace"] = self._last_apply_trace
+                self._last_apply_trace = None
+        _p.send_json(sock, _p.ACK, ack)
         _metrics.inc("replication.acks_sent")
         self._staleness_tick()
 

@@ -8,11 +8,16 @@ every message is::
     u32 payload_len | u8 type | payload
 
 Control messages (HELLO/HEARTBEAT/ACK/FENCE/SNAP_*) carry JSON payloads;
-FRAME carries ``u64 epoch`` followed by the **verbatim on-disk WAL frame**
-(``crc|len|seq|kind|payload``) — the shipper forwards bytes it CRC-verified
-off disk, and the follower re-verifies the same CRC on receipt before
-appending the identical bytes to its own log. Snapshot catch-up ships the
-installed snapshot directory file-by-file (SNAP_FILE payload:
+FRAME carries ``u64 epoch | f64 ship_ts`` followed by the **verbatim
+on-disk WAL frame** (``crc|len|seq|kind|payload``) — the shipper forwards
+bytes it CRC-verified off disk, and the follower re-verifies the same CRC
+on receipt before appending the identical bytes to its own log.
+``ship_ts`` (wall-clock seconds at send) is the replication-pipeline
+telemetry stamp: the follower scores ship→apply latency against it and
+echoes the latest one in its ACKs, so the primary times the full
+ship→apply→ack pipeline (the fleet ``repl.e2e`` histogram) without any
+clock coordination beyond what the hosts already share. Snapshot catch-up
+ships the installed snapshot directory file-by-file (SNAP_FILE payload:
 ``u16 name_len | name | bytes``).
 """
 
@@ -24,7 +29,7 @@ import struct
 from typing import Optional, Tuple
 
 _HDR = struct.Struct("<IB")      # payload length, message type
-_EPOCH = struct.Struct("<Q")     # FRAME epoch prefix
+_FRAMEH = struct.Struct("<Qd")   # FRAME prefix: epoch, ship wall-clock s
 _NAME = struct.Struct("<H")      # SNAP_FILE name length prefix
 
 # a single message never legitimately exceeds this (largest: one snapshot
@@ -95,14 +100,16 @@ def parse_json(payload: bytes) -> dict:
         raise ProtocolError(f"bad json payload: {e}")
 
 
-def pack_frame(epoch: int, frame: bytes) -> bytes:
-    return _EPOCH.pack(epoch) + frame
+def pack_frame(epoch: int, frame: bytes, ship_ts: float = 0.0) -> bytes:
+    return _FRAMEH.pack(epoch, ship_ts) + frame
 
 
-def unpack_frame(payload: bytes) -> Tuple[int, bytes]:
-    if len(payload) <= _EPOCH.size:
+def unpack_frame(payload: bytes) -> Tuple[int, float, bytes]:
+    """(epoch, ship_ts, frame) — ship_ts 0.0 means unstamped."""
+    if len(payload) <= _FRAMEH.size:
         raise ProtocolError("short frame message")
-    return _EPOCH.unpack_from(payload)[0], payload[_EPOCH.size:]
+    epoch, ship_ts = _FRAMEH.unpack_from(payload)
+    return epoch, ship_ts, payload[_FRAMEH.size:]
 
 
 def pack_file(name: str, data: bytes) -> bytes:

@@ -68,6 +68,8 @@ class LogShipper:
             target=self._accept_loop, name="geomesa-repl-ship", daemon=True)
         self._accept_thread.start()
         store.replication = self
+        from geomesa_tpu import trace as _trace
+        _trace.set_node_role("primary")
         _metrics.set_gauge("replication.followers",
                            lambda: len([f for f in self.followers.values()
                                         if f.get("connected")]))
@@ -211,12 +213,33 @@ class LogShipper:
                                 int(ack.get("applied_seq", 0)))
                             st["last_ack"] = time.monotonic()
                     _metrics.inc("replication.acks_received")
+                    self._score_pipeline(fid, ack)
                 elif mtype == _p.FENCE:
                     self._fence_self(int(_p.parse_json(payload)
                                          .get("epoch", 0)))
                     return
         except (OSError, _p.ProtocolError):
             return
+
+    def _score_pipeline(self, fid: str, ack: dict) -> None:
+        """Replication-pipeline telemetry from one ACK: the follower
+        echoes the newest ship stamp it applied (``ship_ts``) plus its
+        measured apply latency, so the primary observes the full
+        ship→apply→ack pipeline on ITS clock pair: ``repl.ship_to_ack``
+        (wire + apply + ack wire) and the end-to-end ``repl.e2e`` — the
+        histogram the fleet surface reads, exemplar-linked to the
+        follower's retained apply trace when one rode along."""
+        ship_ts = ack.get("ship_ts")
+        if not ship_ts:
+            return
+        e2e_s = max(0.0, time.time() - float(ship_ts))
+        _metrics.observe("repl.ship_to_ack", e2e_s)
+        apply_trace = ack.get("apply_trace")
+        if apply_trace:
+            # fleet p99 -> this exemplar -> the follower's apply trace
+            _metrics.observe_exemplar("repl.e2e", e2e_s, str(apply_trace))
+        else:
+            _metrics.observe("repl.e2e", e2e_s)
 
     # -- shipping ------------------------------------------------------------
 
@@ -246,7 +269,11 @@ class LogShipper:
             for seq, _kind, frame in frames:
                 faults.serve_gate("repl.ship.frame")
                 frame = faults.repl_corrupt(frame)
-                _p.send_msg(conn, _p.FRAME, _p.pack_frame(self.epoch, frame))
+                # ship-time stamp: the pipeline-latency anchor the
+                # follower scores apply latency against and echoes in acks
+                _p.send_msg(conn, _p.FRAME,
+                            _p.pack_frame(self.epoch, frame,
+                                          ship_ts=time.time()))
                 sent = seq
                 _metrics.inc("replication.shipped_frames")
                 _metrics.inc("replication.shipped_bytes", len(frame))

@@ -43,7 +43,31 @@ class EndpointDown(Exception):
 
 
 class EndpointOverloaded(Exception):
-    """The endpoint shed the request (429) or failed fast (503)."""
+    """The endpoint shed the request (429) or failed fast (503). Carries
+    the replica's structured error envelope so the router hop can replay
+    it VERBATIM: ``status``, the raw response ``body`` bytes, and the
+    ``retry_after`` header value (None for local endpoints, which carry
+    the structured fields instead)."""
+
+    def __init__(self, msg: str, status: int = 503,
+                 body: Optional[bytes] = None,
+                 retry_after: Optional[str] = None,
+                 envelope: Optional[dict] = None):
+        super().__init__(msg)
+        self.status = int(status)
+        self.body = body
+        self.retry_after = retry_after
+        self.envelope = envelope or {}
+
+
+class EndpointDeadline(EndpointOverloaded):
+    """The endpoint reported deadline-exceeded (504). TERMINAL for the
+    routed request: the deadline is request-global, so retrying another
+    replica would spend device time on an answer nobody can use."""
+
+    def __init__(self, msg: str, body: Optional[bytes] = None,
+                 envelope: Optional[dict] = None):
+        super().__init__(msg, status=504, body=body, envelope=envelope)
 
 
 class NoEndpointAvailable(Exception):
@@ -58,6 +82,7 @@ class Endpoint:
         self.last_probe: Optional[dict] = None
         self.last_probe_ts = 0.0
         self.failures = 0
+        self._last_state: Optional[str] = None  # demotion-transition edge
 
     # -- transport hooks ------------------------------------------------------
 
@@ -86,27 +111,57 @@ class Endpoint:
         now = clock()
         if self.last_probe_ts and now - self.last_probe_ts < ttl_s:
             return self.last_probe
+        t0 = time.perf_counter()
         try:
             p = self._probe()
             self.failures = 0
         except Exception:
             p = None
             self.failures += 1
+        # per-endpoint probe latency: the router's own view of how slow
+        # each node's health surface answers (failed probes count too —
+        # a timing-out replica IS the signal)
+        _metrics.observe(f"router.probe.{self.name}",
+                         time.perf_counter() - t0)
+        _metrics.inc("router.probes")
         self.last_probe = p
         self.last_probe_ts = now
         return p
 
+    def _demotion_reason(self, p: dict, staleness_ms: float) \
+            -> Optional[str]:
+        if p.get("fenced"):
+            return "fenced"
+        if p.get("draining"):
+            return "draining"
+        if p.get("breaker_open"):
+            return "breaker_open"
+        if not p.get("scheduler_ok", True):
+            return "scheduler_unhealthy"
+        if (p.get("lag_ms") or 0.0) > staleness_ms:
+            return "stale"
+        return None
+
     def classify(self, staleness_ms: Optional[float] = None) -> str:
         p = self.probe()
-        if p is None:
-            return DOWN
         if staleness_ms is None:
             staleness_ms = float(config.REPL_STALENESS_MS.get())
-        if p.get("fenced") or p.get("draining") or p.get("breaker_open") \
-                or not p.get("scheduler_ok", True) \
-                or (p.get("lag_ms") or 0.0) > staleness_ms:
-            return DEMOTED
-        return HEALTHY
+        if p is None:
+            state, reason = DOWN, None
+        else:
+            reason = self._demotion_reason(p, staleness_ms)
+            state = DEMOTED if reason is not None else HEALTHY
+        if state != self._last_state:
+            # transition edges only — a demoted node re-probed every TTL
+            # is ONE demotion, not one per request (`debug replication`
+            # dumps these; demotions were previously silent)
+            if state == DEMOTED:
+                _metrics.inc(f"router.demotions.{reason}")
+                _metrics.inc("router.demotions")
+            elif state == DOWN:
+                _metrics.inc("router.endpoint_down")
+            self._last_state = state
+        return state
 
     @property
     def role(self) -> str:
@@ -171,8 +226,17 @@ class LocalEndpoint(Endpoint):
             return self.store.count_coalesced(
                 type_name, cql, auths=auths, deadline_ms=deadline_ms,
                 priority=priority)
-        except (ShedError, CircuitOpenError) as e:
-            raise EndpointOverloaded(str(e))
+        except ShedError as e:
+            raise EndpointOverloaded(
+                str(e), status=429,
+                envelope={"error": str(e), "kind": "shed",
+                          "priority": e.priority,
+                          "retry_after_s": e.retry_after_s})
+        except CircuitOpenError as e:
+            raise EndpointOverloaded(
+                str(e), status=503,
+                envelope={"error": str(e), "kind": "breaker_open",
+                          "retry_after_s": e.retry_after_s})
         except ValueError as e:
             # a closed store surfaces as ValueError("WAL is closed") etc.
             if "closed" in str(e):
@@ -197,14 +261,38 @@ class HttpEndpoint(Endpoint):
         self.base = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
 
-    def _request(self, path: str, method: str = "GET") -> dict:
+    def _request(self, path: str, method: str = "GET",
+                 propagate: bool = False) -> dict:
         req = urllib.request.Request(self.base + path, method=method)
+        if propagate:
+            # cross-process trace context: the remote node opens its
+            # request trace as a child of the current span, so the
+            # stitcher can reassemble ONE fleet-wide tree
+            from geomesa_tpu import trace as _t
+            for k, v in _t.inject_headers().items():
+                req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.loads(r.read().decode())
         except urllib.error.HTTPError as e:
+            body = None
+            envelope = {}
+            try:
+                body = e.read()
+                envelope = json.loads(body.decode())
+            except Exception:
+                pass
+            retry_after = e.headers.get("Retry-After") if e.headers else None
             if e.code in (429, 503):
-                raise EndpointOverloaded(f"{self.name}: HTTP {e.code}")
+                # the replica's structured envelope + Retry-After ride
+                # the exception so the router hop replays them verbatim
+                raise EndpointOverloaded(f"{self.name}: HTTP {e.code}",
+                                         status=e.code, body=body,
+                                         retry_after=retry_after,
+                                         envelope=envelope)
+            if e.code == 504:
+                raise EndpointDeadline(f"{self.name}: HTTP 504",
+                                       body=body, envelope=envelope)
             raise EndpointDown(f"{self.name}: HTTP {e.code}")
         except (urllib.error.URLError, OSError, ValueError) as e:
             raise EndpointDown(f"{self.name}: {e}")
@@ -225,13 +313,20 @@ class HttpEndpoint(Endpoint):
 
     def count(self, type_name, cql="INCLUDE", auths=None, deadline_ms=None,
               priority="interactive") -> int:
+        from geomesa_tpu import trace as _t
         q = {"cql": cql, "priority": priority}
         if auths:
             q["auths"] = ",".join(auths)
         if deadline_ms:
             q["deadline_ms"] = str(deadline_ms)
-        out = self._request(f"/types/{type_name}/count?"
-                            + urllib.parse.urlencode(q))
+        # the proxy span is the remote half's parent: its span id rides
+        # X-Span-Id, and its wall time minus the remote root's wall time
+        # is the hop's network cost in the stitched tree
+        with _t.span(f"proxy.{self.name}", kind="remote_call",
+                     endpoint=self.name):
+            out = self._request(f"/types/{type_name}/count?"
+                                + urllib.parse.urlencode(q),
+                                propagate=True)
         return int(out["count"])
 
     def promote(self, port: int = 0) -> dict:
@@ -319,6 +414,8 @@ class ReplicaRouter:
         candidate refuses."""
         self._n_requests += 1
         _metrics.inc("router.requests")
+        if freshness == "strong":
+            _metrics.inc("router.strong_pins")
         last: Optional[Exception] = None
         for i, ep in enumerate(self.candidates(freshness)):
             try:
@@ -329,6 +426,10 @@ class ReplicaRouter:
                     self._n_failovers += 1
                     _metrics.inc("router.read_failovers")
                 return n
+            except EndpointDeadline:
+                # terminal: the deadline is request-global — another
+                # replica cannot beat a clock that already expired
+                raise
             except (EndpointDown, EndpointOverloaded) as e:
                 # transport death invalidates the cached probe immediately
                 if isinstance(e, EndpointDown):
@@ -390,3 +491,206 @@ class ReplicaRouter:
                        "probe": ep.last_probe}
                 for name, ep in self.endpoints.items()},
         }
+
+    def node_targets(self) -> Dict[str, Optional[str]]:
+        """name -> base URL (None for in-process endpoints) — the node
+        map the federator and the trace stitcher fetch from."""
+        out: Dict[str, Optional[str]] = {}
+        for name, ep in self.endpoints.items():
+            out[name] = ep.base if isinstance(ep, HttpEndpoint) else None
+        return out
+
+
+# -- the router's own HTTP surface (the fleet's front door) -------------------
+
+
+class RouterApi:
+    """Transport-agnostic request handler for a router node: proxied
+    counts with cross-process trace propagation, the federated fleet
+    surfaces, and the trace stitcher.
+
+    Routes:
+      GET /types/{t}/count?cql=&freshness=   routed count (one stitched
+                                             trace across router + the
+                                             serving node); a replica's
+                                             429/503/504 envelope and
+                                             Retry-After header survive
+                                             the hop VERBATIM
+      GET /fleet                             per-node health/lag/seq +
+                                             fleet SLO burn rates
+      GET /fleet/metrics                     federated Prometheus (node-
+                                             labeled counters/gauges,
+                                             exactly-merged histograms)
+      GET /fleet/slo                         fleet-level burn rates only
+      GET /traces?id=G                       the STITCHED cross-process
+                                             tree for global trace id G
+                                             (+ the collected halves)
+      GET /router                            router stats (states, probes)
+      GET /metrics[?format=prometheus]       this router process's own
+                                             registry
+      GET /healthz                           router liveness + node id
+      POST /promote?port=                    router-orchestrated failover
+    """
+
+    def __init__(self, router: ReplicaRouter, federator=None):
+        from geomesa_tpu import obs as _obs
+        from geomesa_tpu.obs import federation as _fed
+        _obs.install()
+        _trace_mod().set_node_role("router")
+        self.router = router
+        if federator is None:
+            nodes = dict(router.node_targets())
+            nodes.setdefault(_trace_mod().node_id(), None)  # self
+            federator = _fed.Federator(nodes)
+        self.federator = federator
+
+    # returns (status, payload, headers) — payload bytes are replayed
+    # verbatim (the error-envelope contract), dicts serialize as JSON
+    def handle(self, method: str, path: str, query: dict,
+               headers=None):
+        try:
+            return self._route(method, path, query, headers)
+        except NoEndpointAvailable as e:
+            return 503, {"error": str(e), "kind": "no_endpoint"}, {}
+        except EndpointOverloaded as e:
+            # the terminal candidate's envelope, replayed verbatim:
+            # body bytes when the hop captured them (HttpEndpoint),
+            # the structured envelope otherwise (LocalEndpoint)
+            hdrs = {}
+            if e.retry_after is not None:
+                hdrs["Retry-After"] = str(e.retry_after)
+            elif e.envelope.get("retry_after_s") is not None:
+                hdrs["Retry-After"] = str(max(
+                    1, int(-(-float(e.envelope["retry_after_s"]) // 1))))
+            payload = e.body if e.body is not None else (
+                e.envelope or {"error": str(e), "kind": "overloaded"})
+            return e.status, payload, hdrs
+        except EndpointDown as e:
+            return 502, {"error": str(e), "kind": "endpoint_down"}, {}
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"error": str(e), "kind": "bad_request"}, {}
+        except Exception as e:
+            return 500, {"error": str(e), "kind": "internal",
+                         "type": type(e).__name__}, {}
+
+    def _route(self, method, path, query, headers):
+        from geomesa_tpu import trace as _t
+        from geomesa_tpu.metrics import REGISTRY as _reg
+        from geomesa_tpu.obs import federation as _fed
+        parts = [p for p in path.split("/") if p]
+        if parts == ["healthz"]:
+            return 200, {"status": "ok",
+                         "node": {"id": _t.node_id(), "role": "router"},
+                         "router": self.router.stats()}, {}
+        if parts == ["router"]:
+            return 200, self.router.stats(), {}
+        if parts == ["metrics"]:
+            if query.get("format", [None])[0] == "prometheus":
+                return 200, _reg.to_prometheus(), {}
+            if query.get("format", [None])[0] == "state":
+                return 200, {"node": {"id": _t.node_id(),
+                                      "role": "router"},
+                             "state": _reg.export_state()}, {}
+            return 200, _reg.snapshot(), {}
+        if parts == ["fleet"]:
+            return 200, self.federator.fleet(), {}
+        if parts == ["fleet", "metrics"]:
+            return 200, self.federator.to_prometheus(), {}
+        if parts == ["fleet", "slo"]:
+            return 200, {"slo": self.federator.slo()}, {}
+        if parts == ["traces"]:
+            gid = query.get("id", [None])[0]
+            if not gid:
+                return 400, {"error": "the router trace surface needs "
+                                      "?id=<global trace id>"}, {}
+            nodes = dict(self.federator.nodes)
+            halves = _fed.collect_trace(gid, nodes)
+            return 200, {"id": gid,
+                         "stitched": _fed.stitch(halves),
+                         "traces": halves}, {}
+        if parts == ["promote"] and method == "POST":
+            port = int(query.get("port", [0])[0])
+            return 200, self.router.promote(port=port), {}
+        if len(parts) == 3 and parts[0] == "types" \
+                and parts[2] == "count":
+            t = parts[1]
+            cql = query.get("cql", ["INCLUDE"])[0]
+            auths = query["auths"][0].split(",") \
+                if "auths" in query else None
+            freshness = query.get("freshness", ["bounded"])[0]
+            raw_dl = query.get("deadline_ms", [None])[0]
+            if raw_dl is None and headers is not None:
+                raw_dl = headers.get("X-Deadline-Ms")
+            deadline_ms = float(raw_dl) if raw_dl else None
+            priority = query.get("priority", ["interactive"])[0]
+            # the routed query's ROOT trace: the proxy span inside it
+            # (HttpEndpoint.count) parents the remote half
+            with _t.trace("router.count", type=t, filter=cql,
+                          freshness=freshness) as tr:
+                n = self.router.count(t, cql, auths=auths,
+                                      deadline_ms=deadline_ms,
+                                      priority=priority,
+                                      freshness=freshness)
+                gid = tr.global_id if tr is not None else None
+            return 200, {"count": int(n), "trace": gid}, {}
+        return 404, {"error": f"no route {method} {path}"}, {}
+
+
+def _trace_mod():
+    from geomesa_tpu import trace as _t
+    return _t
+
+
+def serve_router(router: ReplicaRouter, host: str = "127.0.0.1",
+                 port: int = 8760, federator=None,
+                 background: bool = False):
+    """Start the router's HTTP surface. ``background=True`` returns the
+    server after starting a daemon thread (tests / embedded use)."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    api = RouterApi(router, federator=federator)
+
+    class _RouterHandler(BaseHTTPRequestHandler):
+        def _serve(self, method):
+            try:
+                u = urllib.parse.urlparse(self.path)
+                status, payload, extra = api.handle(
+                    method, u.path, urllib.parse.parse_qs(u.query),
+                    headers=self.headers)
+            except Exception as e:
+                status, payload, extra = 500, {"error": str(e),
+                                               "kind": "internal"}, {}
+            if isinstance(payload, bytes):
+                data, ctype = payload, "application/json"
+            elif isinstance(payload, str):
+                data, ctype = payload.encode(), "text/plain; version=0.0.4"
+            else:
+                data = _json.dumps(payload, default=str).encode()
+                ctype = "application/json"
+            try:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def do_GET(self):
+            self._serve("GET")
+
+        def do_POST(self):
+            self._serve("POST")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), _RouterHandler)
+    httpd.router_api = api
+    if background:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+    httpd.serve_forever()

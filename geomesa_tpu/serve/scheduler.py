@@ -218,9 +218,9 @@ class Request:
                  "batched", "batch_size", "deadline", "priority",
                  "cancelled", "degraded",
                  # flight-recorder dimensions (obs/flight.py wide events)
-                 "trace_id", "budget_ms", "plan_cache_hit",
-                 "cover_cache_hit", "batch_id", "rows_scanned", "shed",
-                 "breaker_open", "retries")
+                 "trace_id", "trace_gid", "parent_span", "budget_ms",
+                 "plan_cache_hit", "cover_cache_hit", "batch_id",
+                 "rows_scanned", "shed", "breaker_open", "retries")
 
     def __init__(self, type_name, f_ir, f_key, auths, auths_key,
                  planner, delta, generation, epoch,
@@ -248,6 +248,8 @@ class Request:
         self.cancelled = False
         self.degraded = False
         self.trace_id: Optional[int] = None
+        self.trace_gid: Optional[str] = None
+        self.parent_span: Optional[int] = None
         self.budget_ms: Optional[float] = None
         self.plan_cache_hit: Optional[bool] = None
         self.cover_cache_hit: Optional[bool] = None
@@ -366,6 +368,9 @@ class QueryScheduler:
         caller_trace = _trace.current_trace()
         if caller_trace is not None:
             req.trace_id = caller_trace.trace_id
+            req.trace_gid = caller_trace.global_id
+            if caller_trace.parent is not None:
+                req.parent_span = caller_trace.parent.span_id
         req.breaker_open = self.breaker.state != "closed"
         if config.OBS_ENABLED.get():
             req.future.add_done_callback(_flight.request_callback(req))

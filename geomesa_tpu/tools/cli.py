@@ -12,11 +12,14 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|events|slo|kernels|scheduler|admission|wal|replication
+    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|admission|wal|replication
                               [--format prometheus] [--slow MS] [--errors]
-                              [--kind K] [--addr HOST:PORT] [-s STORE -f NAME -q ECQL]
+                              [--kind K] [--addr HOST:PORT ...] [-s STORE -f NAME -q ECQL]
+                              [--id TRACE_ID --fleet]   (debug trace: stitched tree)
     geomesa-tpu serve         -s STORE [--durable] [--ship-port P] [--port W]
     geomesa-tpu replica       --dir DIR --follow HOST:PORT [--port W] [--id ID]
+    geomesa-tpu router        --endpoint NAME=HOST:PORT ... [--port P]
+    geomesa-tpu fleet         status --addr HOST:PORT [--addr ...] [--json]
     geomesa-tpu perfwatch     check|update|show [--run BENCH_summary.json]
                               [--baseline perf/baselines.json] [--k 3]
                               [--report out.json]
@@ -310,18 +313,22 @@ def cmd_debug(args):
         # via --addr, since replication state lives in the serving
         # process), plus this process's replication/router/drill counters
         out = {}
-        if args.addr:
+        for addr in (args.addr or []):
+            base = addr if addr.startswith("http") else f"http://{addr}"
             import urllib.request
-            base = args.addr if args.addr.startswith("http") \
-                else f"http://{args.addr}"
+            node = {}
             for path, key in (("/replication", "replication"),
                               ("/healthz", "healthz")):
                 try:
                     with urllib.request.urlopen(base + path,
                                                 timeout=5) as r:
-                        out[key] = json.loads(r.read().decode())
+                        node[key] = json.loads(r.read().decode())
                 except OSError as e:
-                    out[key] = {"error": str(e)}
+                    node[key] = {"error": str(e)}
+            if len(args.addr) == 1:
+                out.update(node)  # the established single-node shape
+            else:
+                out.setdefault("nodes", {})[addr] = node
         snap = REGISTRY.snapshot_prefixed("replication.", "router.",
                                           "drill.")
         out["metrics"] = {k: v for k, v in snap.items() if v}
@@ -330,6 +337,24 @@ def cmd_debug(args):
                       ("replication.lag_seqs", "replication.lag_ms",
                        "replication.followers") if k in gauges}
         print(json.dumps(out, indent=2, default=str))
+    elif args.what == "trace":
+        # the stitched cross-process tree for one global trace id:
+        # collect this process's halves plus every --addr node's
+        # GET /traces?id= halves, stitch, render (--fleet implied by any
+        # --addr; without addrs it stitches whatever is local)
+        from geomesa_tpu.obs import federation as _fed
+        if not args.id:
+            raise SystemExit("debug trace requires --id GLOBAL_TRACE_ID")
+        nodes = {"local": None}
+        for i, addr in enumerate(args.addr or []):
+            nodes[f"addr{i}"] = addr
+        halves = _fed.collect_trace(args.id, nodes)
+        st = _fed.stitch(halves)
+        print(_fed.render_stitched(st))
+        if args.format == "json":
+            print(json.dumps({"id": args.id, "stitched": st,
+                              "halves": len(halves)}, indent=2,
+                             default=str))
     elif args.what == "slo":
         # burn-rate runbook surface: compliance + multi-window burn rates
         # + page/ticket state per objective
@@ -444,6 +469,75 @@ def cmd_replica(args):
         f.close()
 
 
+def cmd_router(args):
+    """Run the fleet front door: a health/lag-aware read router over the
+    named endpoints, serving routed counts WITH cross-process trace
+    propagation plus the federated observability plane (GET /fleet,
+    /fleet/metrics, /fleet/slo, the /traces?id= stitcher)."""
+    from geomesa_tpu import trace as _t
+    from geomesa_tpu.obs import federation as _fed
+    from geomesa_tpu.serve.router import (HttpEndpoint, ReplicaRouter,
+                                          serve_router)
+    eps, nodes = [], {}
+    for spec in args.endpoint:
+        name, sep, addr = spec.partition("=")
+        if not sep:
+            name, addr = f"n{len(eps)}", spec
+        base = addr if addr.startswith("http") else f"http://{addr}"
+        eps.append(HttpEndpoint(name, base))
+        nodes[name] = base
+    router = ReplicaRouter(eps)
+    nodes[_t.node_id()] = None  # federate this router's own counters too
+    fed = _fed.configure(nodes)
+    print(json.dumps({"router": f"http://{args.host}:{args.port}",
+                      "endpoints": sorted(nodes)}), flush=True)
+    serve_router(router, host=args.host, port=args.port, federator=fed)
+
+
+def _render_fleet(fl) -> str:
+    lines = ["NODE              ROLE        LAG      SEQ            "
+             "BREAKER   QUEUE  FENCED  SLO"]
+    for name, n in sorted(fl.get("nodes", {}).items()):
+        if not n.get("ok"):
+            lines.append(f"{name:<17} DOWN        {n.get('error')}")
+            continue
+        lag = "-" if n.get("lag_ms") is None else f"{n['lag_ms']}ms"
+        seq = f"{n.get('applied_seq')}/{n.get('wal_seq')}"
+        lines.append(
+            f"{name:<17} {str(n.get('role')):<11} {lag:<8} {seq:<14} "
+            f"{str(n.get('breaker')):<9} {str(n.get('queue_depth')):<6} "
+            f"{str(n.get('fenced')):<7} {n.get('slo')}")
+    for k, v in sorted((fl.get("slo") or {}).items()):
+        lines.append(f"slo {k}: status={v.get('status')} "
+                     f"compliance={v.get('compliance')} "
+                     f"good={v.get('good')}/{v.get('total')}")
+    e2e = fl.get("repl_e2e_ms")
+    if e2e:
+        lines.append(f"repl.e2e: count={e2e.get('count')} "
+                     f"p50={e2e.get('p50_ms')}ms p99={e2e.get('p99_ms')}ms "
+                     f"exemplars={e2e.get('exemplars')}")
+    return "\n".join(lines)
+
+
+def cmd_fleet(args):
+    """Fleet status from anywhere: scrape every --addr node's /healthz +
+    bucket-exact metrics state, merge client-side, and print the single
+    pane of glass (per-node health/lag/seq, fleet SLO burn rates over
+    MERGED samples, the replication e2e pipeline histogram)."""
+    from geomesa_tpu.obs import federation as _fed
+    if args.action != "status":
+        raise SystemExit(f"unknown fleet action {args.action!r}")
+    if not args.addr:
+        raise SystemExit("fleet status requires --addr HOST:PORT "
+                         "(repeatable, one per node)")
+    fed = _fed.Federator({a: a for a in args.addr})
+    fl = fed.fleet()
+    if args.json:
+        print(json.dumps(fl, indent=2, default=str))
+    else:
+        print(_render_fleet(fl))
+
+
 def cmd_remove_schema(args):
     store = _load(args.store, must_exist=True)
     store.remove_schema(args.feature)
@@ -550,15 +644,15 @@ def build_parser() -> argparse.ArgumentParser:
                       "events, SLO burn rates, per-kernel attribution, "
                       "scheduler state, admission/overload state, or the "
                       "WAL segment inspector")
-    sp.add_argument("what", choices=("metrics", "traces", "events", "slo",
-                                     "kernels", "scheduler", "admission",
-                                     "wal", "replication"))
+    sp.add_argument("what", choices=("metrics", "traces", "trace", "events",
+                                     "slo", "kernels", "scheduler",
+                                     "admission", "wal", "replication"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "
                                             "`debug events`)")
     sp.add_argument("-q", "--cql", help="ECQL filter for the warm query")
-    sp.add_argument("--format", default="json",
+    sp.add_argument("--format", default=None,
                     choices=("json", "prometheus"))
     sp.add_argument("--limit", type=int, default=20,
                     help="max traces/events to print")
@@ -570,10 +664,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--kind", default=None,
                     help="match record kind / trace name / a span kind "
                          "present in the stage breakdown")
-    sp.add_argument("--addr", default=None, metavar="HOST:PORT",
-                    help="for `debug replication`: query a RUNNING node's "
-                         "/replication + /healthz instead of (only) this "
-                         "process's counters")
+    sp.add_argument("--addr", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="a RUNNING node to query (repeatable). "
+                         "`debug replication`: its /replication + "
+                         "/healthz; `debug trace --fleet`: every node's "
+                         "/traces?id= halves for the stitcher")
+    sp.add_argument("--id", default=None, metavar="TRACE_ID",
+                    help="for `debug trace`: the global trace id to "
+                         "stitch (the `trace` field a routed count / "
+                         "flight event / exemplar carries)")
+    sp.add_argument("--fleet", action="store_true",
+                    help="for `debug trace`: fetch remote halves from "
+                         "every --addr node (without it, only this "
+                         "process's rings are searched)")
     sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser(
@@ -605,6 +709,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "port (0 = ephemeral); followers connect with "
                          "`geomesa-tpu replica --follow host:port`")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "router",
+        help="run the fleet front door: health/lag-aware routed reads "
+             "with cross-process trace propagation, plus the federated "
+             "observability plane (/fleet, /fleet/metrics, the "
+             "/traces?id= stitcher)")
+    sp.add_argument("--endpoint", action="append", required=True,
+                    metavar="NAME=HOST:PORT",
+                    help="one serving node's REST base address "
+                         "(repeatable; NAME= optional)")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8760)
+    sp.set_defaults(fn=cmd_router)
+
+    sp = sub.add_parser(
+        "fleet",
+        help="fleet-wide status: scrape every --addr node, merge "
+             "client-side, print per-node health + fleet SLO burn rates")
+    sp.add_argument("action", choices=("status",))
+    sp.add_argument("--addr", action="append", metavar="HOST:PORT",
+                    help="a fleet node's REST address (repeatable)")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw merged JSON instead of the table")
+    sp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser(
         "replica",

@@ -44,13 +44,24 @@ plain span, so datastore-level and planner-level roots compose.
 
 Thread model: the current trace is thread-local (one query per thread, the
 ThreadingHTTPServer model); the ring buffer is process-global and locked.
+
+Fleet context (obs/federation.py rides on these primitives): every root
+trace carries a process-stable ``node_id``/``role`` dimension and a
+globally-unique ``global_id`` (``<node>-<local id>``). A proxied request
+propagates its context over HTTP (X-Trace-Id / X-Span-Id / X-Trace-Node /
+X-Trace-Sampled — ``inject_headers``/``extract_headers``); the receiving
+process opens its root trace as a CHILD of the remote parent
+(``remote_parent``), sharing the parent's global id so a stitcher can
+reassemble ONE cross-process tree from the per-node halves.
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Dict, Iterator, List, Optional
 
@@ -76,10 +87,148 @@ class _Local(threading.local):
     # never traced (no getattr-with-default on the hot path)
     trace = None
     stack = None
+    remote = None  # pending RemoteParent consumed by the next root trace
 
 
 _local = _Local()
 _ids = itertools.count(1)
+_span_ids = itertools.count(1)
+
+
+# -- node identity (the fleet dimension on every trace/event/metric) ----------
+
+
+class _Node:
+    id: Optional[str] = None
+    role = "standalone"
+
+
+def node_id() -> str:
+    """Process-stable node identity: GEOMESA_TPU_NODE_ID, else
+    ``<short-hostname>-<pid>`` (unique per incarnation on one host — the
+    shape localhost fleets and tests produce)."""
+    nid = _Node.id
+    if nid is None:
+        from geomesa_tpu import config
+        nid = str(config.NODE_ID.get() or "").strip()
+        if not nid:
+            try:
+                import socket as _socket
+                host = _socket.gethostname().split(".")[0]
+            except OSError:
+                host = "node"
+            nid = f"{host}-{os.getpid()}"
+        _Node.id = nid
+    return nid
+
+
+def node_role() -> str:
+    return _Node.role
+
+
+def set_node_role(role: str) -> None:
+    """Stamp this process's fleet role (primary / replica / router /
+    standalone) — replication and router constructors call it so every
+    trace/flight event carries the role it was produced under."""
+    _Node.role = str(role)
+
+
+def _reset_node_for_tests() -> None:
+    _Node.id = None
+    _Node.role = "standalone"
+
+
+# -- cross-process propagation ------------------------------------------------
+
+
+class RemoteParent:
+    """The extracted upstream context: the remote parent this process's
+    next root trace is a child of."""
+
+    __slots__ = ("trace_id", "span_id", "node", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[int],
+                 node: Optional[str], sampled: bool):
+        self.trace_id = str(trace_id)
+        self.span_id = int(span_id) if span_id else None
+        self.node = node
+        self.sampled = bool(sampled)
+
+    def to_dict(self) -> dict:
+        out = {"trace": self.trace_id}
+        if self.span_id is not None:
+            out["span"] = self.span_id
+        if self.node is not None:
+            out["node"] = self.node
+        return out
+
+
+def extract_headers(headers) -> Optional[RemoteParent]:
+    """RemoteParent from incoming HTTP headers (None when the request
+    carries no trace context or propagation is off)."""
+    if headers is None:
+        return None
+    tid = headers.get("X-Trace-Id")
+    if not tid:
+        return None
+    from geomesa_tpu import config
+    if not config.FED_PROPAGATE.get():
+        return None
+    try:
+        span_id = int(headers.get("X-Span-Id") or 0)
+    except (TypeError, ValueError):
+        span_id = 0
+    return RemoteParent(tid, span_id or None, headers.get("X-Trace-Node"),
+                        str(headers.get("X-Trace-Sampled") or "0") == "1")
+
+
+def inject_headers() -> Dict[str, str]:
+    """Propagation headers for an outbound hop made under the current
+    trace: the trace's global id, the CURRENT span's id (assigned on
+    demand — the remote half parents under it), this node, and the
+    sampling decision (sticky once made: deterministic on the global id,
+    so every hop of one request agrees without coordination)."""
+    tr = _local.trace
+    if tr is None:
+        return {}
+    from geomesa_tpu import config
+    if not config.FED_PROPAGATE.get():
+        return {}
+    sp = _local.stack[-1]
+    if sp.span_id is None:
+        sp.span_id = next(_span_ids)
+    gid = tr.global_id
+    if not tr.sampled_hint:
+        rate = float(config.OBS_SAMPLE.get())
+        if rate > 0 and (zlib.crc32(gid.encode()) % 10_000) < rate * 10_000:
+            tr.sampled_hint = True
+    return {"X-Trace-Id": gid,
+            "X-Span-Id": str(sp.span_id),
+            "X-Trace-Node": node_id(),
+            "X-Trace-Sampled": "1" if tr.sampled_hint else "0"}
+
+
+class remote_parent:
+    """Context manager binding an extracted RemoteParent to this thread:
+    the next ROOT trace opened inside becomes its child (adopts the
+    remote global id, records the parent span, honors the propagated
+    sampling decision). None is a no-op, so callers pass
+    ``extract_headers(...)`` unconditionally."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[RemoteParent]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = _local.remote
+        if self._ctx is not None:
+            _local.remote = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _local.remote = self._prev
+        return False
 
 
 class _State:
@@ -114,7 +263,8 @@ class Span:
     None until the first child attaches (most spans are leaves; the lazy
     list keeps leaf allocation to one object on the hot path)."""
 
-    __slots__ = ("name", "kind", "attrs", "duration_ms", "children")
+    __slots__ = ("name", "kind", "attrs", "duration_ms", "children",
+                 "span_id")
 
     def __init__(self, name: str, kind: Optional[str], attrs: Optional[dict]):
         self.name = name
@@ -123,6 +273,9 @@ class Span:
         self.attrs = attrs
         self.duration_ms = 0.0
         self.children: Optional[List[Span]] = None
+        # assigned on demand (inject_headers) when this span parents a
+        # remote child — the stitcher's attachment point
+        self.span_id: Optional[int] = None
 
     def add_child(self, node: "Span") -> None:
         c = self.children
@@ -147,6 +300,8 @@ class Span:
         d = {"name": self.name, "kind": self.kind,
              "duration_ms": round(self.duration_ms, 3),
              "self_ms": round(self.self_ms, 3)}
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
         if self.attrs:
             d["attrs"] = {k: str(v) for k, v in self.attrs.items()}
         if self.children:
@@ -159,7 +314,8 @@ class QueryTrace:
     ``error`` is the exception type name when the traced block raised —
     the tail sampler's keep-always signal."""
 
-    __slots__ = ("trace_id", "name", "ts_ms", "root", "error")
+    __slots__ = ("trace_id", "name", "ts_ms", "root", "error",
+                 "parent", "sampled_hint", "_global_id")
 
     def __init__(self, name: str, attrs: Optional[dict]):
         self.trace_id = next(_ids)
@@ -167,6 +323,19 @@ class QueryTrace:
         self.ts_ms = int(time.time() * 1000)
         self.root = Span(name, "trace", attrs)
         self.error: Optional[str] = None
+        # fleet context: the remote parent this trace is a child of, the
+        # propagated keep-me sampling decision, and the cross-process id
+        # (adopted from the parent, else derived lazily from node+local id)
+        self.parent: Optional[RemoteParent] = None
+        self.sampled_hint = False
+        self._global_id: Optional[str] = None
+
+    @property
+    def global_id(self) -> str:
+        gid = self._global_id
+        if gid is None:
+            gid = self._global_id = f"{node_id()}-{self.trace_id}"
+        return gid
 
     @property
     def duration_ms(self) -> float:
@@ -196,10 +365,14 @@ class QueryTrace:
 
     def to_dict(self) -> dict:
         out = {"id": self.trace_id, "name": self.name, "ts_ms": self.ts_ms,
+               "global_id": self.global_id,
+               "node": node_id(), "role": _Node.role,
                "duration_ms": round(self.duration_ms, 3),
                "stages_ms": {k: round(v, 3)
                              for k, v in self.self_times_ms().items()},
                "root": self.root.to_dict()}
+        if self.parent is not None:
+            out["parent"] = self.parent.to_dict()
         if self.error is not None:
             out["error"] = self.error
         return out
@@ -332,6 +505,7 @@ def _leaf(name: str, kind: str, duration_ms: float) -> Span:
     s.attrs = None
     s.duration_ms = duration_ms
     s.children = None
+    s.span_id = None
     return s
 
 
@@ -398,6 +572,16 @@ class trace:
                               **(self.attrs or {}))
             return self._span.__enter__()._node
         t = QueryTrace(self.name, self.attrs)
+        remote = _local.remote
+        if remote is not None:
+            # this root is the remote parent's child: adopt its global id
+            # (ONE cross-process trace) and its sampling decision, and
+            # consume the context so nested/subsequent roots on this
+            # thread don't re-parent under it
+            t.parent = remote
+            t._global_id = remote.trace_id
+            t.sampled_hint = remote.sampled
+            _local.remote = None
         _local.trace = t
         _local.stack = [t.root]
         self._trace = t

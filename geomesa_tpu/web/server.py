@@ -89,6 +89,21 @@ class GeoJsonApi:
     def store(self):
         return getattr(self._target, "store", self._target)
 
+    def _node_meta(self) -> dict:
+        """This node's fleet identity: stable node id + live role (the
+        replication role when one is active, the process-stamped role
+        otherwise) — the attribution /healthz, /metrics?format=state and
+        federated scrapes carry."""
+        from geomesa_tpu import trace as _t
+        repl = getattr(self.store, "replication", None)
+        role = _t.node_role()
+        if repl is not None:
+            try:
+                role = repl.stats().get("role", role)
+            except Exception:
+                pass
+        return {"id": _t.node_id(), "role": role}
+
     @staticmethod
     def _request_deadline(query: dict, headers) -> Optional[object]:
         """Per-request Deadline from ?deadline_ms= / X-Deadline-Ms, falling
@@ -130,7 +145,11 @@ class GeoJsonApi:
         from geomesa_tpu.serve.resilience.breaker import CircuitOpenError
         from geomesa_tpu.serve.resilience.admission import ShedError
         try:
-            with _rdl.use(self._request_deadline(query, headers)):
+            # cross-process trace context: a request carrying X-Trace-Id
+            # (the router's proxy hop) opens its root trace as a CHILD of
+            # the remote parent — one global id, one stitched fleet tree
+            with _trace.remote_parent(_trace.extract_headers(headers)), \
+                    _rdl.use(self._request_deadline(query, headers)):
                 return self._route(method, path, query, body,
                                    headers=headers)
         except ShedError as e:        # admission control shed this request
@@ -162,13 +181,26 @@ class GeoJsonApi:
             return 200, {"types": self.store.get_type_names()}
         if parts == ["metrics"]:
             from geomesa_tpu.metrics import REGISTRY
-            if query.get("format", [None])[0] == "prometheus":
+            fmt = query.get("format", [None])[0]
+            if fmt == "prometheus":
                 # str payload → text/plain exposition body
                 return 200, REGISTRY.to_prometheus()
+            if fmt == "state":
+                # bucket-exact registry state for the metrics federator
+                # (lossless cross-node histogram merge), tagged with this
+                # node's fleet identity
+                return 200, {"node": self._node_meta(),
+                             "state": REGISTRY.export_state()}
             return 200, REGISTRY.snapshot()
         if parts == ["traces"]:
             from geomesa_tpu.trace import RING
             limit = int(query.get("limit", [50])[0])
+            gid = query.get("id", [None])[0]
+            if gid is not None:
+                # this node's halves of one (global) trace id — what the
+                # router-side stitcher / `debug trace --fleet` fetch
+                from geomesa_tpu.obs.federation import local_traces_by_id
+                return 200, {"id": gid, "traces": local_traces_by_id(gid)}
             if query.get("retained", [None])[0] not in (None, "0", "false"):
                 # the tail-sampled ring: errors/cancel/shed/degrade always,
                 # slow outliers past the adaptive threshold, plus the
@@ -206,6 +238,21 @@ class GeoJsonApi:
             return 200, d.status()
         if parts and parts[0] == "replication":
             return self._route_replication(parts[1:], method, query)
+        if parts and parts[0] == "fleet":
+            # the single pane of glass — served by whichever node carries
+            # a configured federator (the router/primary, typically)
+            from geomesa_tpu.obs import federation as _fed
+            fed = _fed.federator()
+            if fed is None:
+                return 404, {"error": "no federator configured on this "
+                                      "node (obs.federation.configure)"}
+            if parts == ["fleet"]:
+                return 200, fed.fleet()
+            if parts == ["fleet", "metrics"]:
+                return 200, fed.to_prometheus()  # str → text exposition
+            if parts == ["fleet", "slo"]:
+                return 200, {"slo": fed.slo()}
+            return 404, {"error": f"no route {method} {path}"}
         if parts == ["healthz"]:
             import jax
             report = getattr(self.store, "recovery_report", None)
@@ -228,6 +275,7 @@ class GeoJsonApi:
                 slo = {"status": "unknown"}
             repl = getattr(self.store, "replication", None)
             return 200, {"status": "ok",
+                         "node": self._node_meta(),
                          "devices": len(jax.local_devices()),
                          "types": len(self.store.get_type_names()),
                          "overload": overload,

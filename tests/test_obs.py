@@ -206,11 +206,18 @@ def test_exemplars_link_metrics_buckets_to_retained_traces(store):
     text = REGISTRY.to_prometheus()
     ex_lines = [l for l in text.splitlines() if "trace_id=" in l]
     assert ex_lines, "retained traces must surface as bucket exemplars"
-    # every exemplar names a trace the sampled ring actually retains
+    # every LOCAL exemplar names a trace the sampled ring actually
+    # retains; cross-node refs (pinned by observe_exemplar, e.g. the
+    # repl.e2e apply-trace link) are global `<node>-<id>` strings the
+    # local ring cannot vouch for
     import re
+    checked = 0
     for line in ex_lines:
-        tid = int(re.search(r'trace_id="(\d+)"', line).group(1))
-        assert SAMPLER.is_retained(tid)
+        ref = re.search(r'trace_id="([^"]+)"', line).group(1)
+        if ref.isdigit():
+            assert SAMPLER.is_retained(int(ref))
+            checked += 1
+    assert checked, "the count trace must land a local exemplar"
 
 
 # -- per-kernel device cost attribution ---------------------------------------
