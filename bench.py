@@ -32,6 +32,11 @@ Covers the five BASELINE.md configs:
      (excess rejected with backpressure, not queued into collapse) and the
      p99 latency of the ADMITTED requests (the property load shedding
      exists to protect).
+  8. Workload analytics: a skewed (Zipf) multi-tenant mix of ~200 query
+     shapes through the scheduler — measures the hot-set sketch's recall
+     of the TRUE top-10 plan hashes against an exact oracle, and the
+     wall-clock overhead of the workload plane (enabled at defaults vs
+     GEOMESA_TPU_WORKLOAD=0).
 
 Headline metric = config 1 blocking p50 (RTT included; see rtt field).
 ``vs_baseline`` = indexed-CPU comparator p50 / batch64 per-query (sustained
@@ -239,7 +244,7 @@ def main(args=None) -> int:
 
     n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
-    default_configs = "0,1,2,3,4,5,6,7"
+    default_configs = "0,1,2,3,4,5,6,7,8"
     if args.mini:
         from geomesa_tpu import config as _gcfg
         n = min(n, int(_gcfg.BENCH_MINI_N.get()))
@@ -953,6 +958,99 @@ def main(args=None) -> int:
         finally:
             _cfg.ADMIT_INTERACTIVE.unset()
             sched7.shutdown()
+
+    # ---- config 8: workload analytics (hot-set recall + overhead) ---------
+    if "8" in configs:
+        from geomesa_tpu import config as _cfg
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.filter.parser import parse_ecql
+        from geomesa_tpu.obs import workload as _wl
+        from geomesa_tpu.obs.flight import plan_hash as _plan_hash
+        from geomesa_tpu.serve.scheduler import QueryScheduler, StoreBinding
+
+        n8 = min(n, 1_000_000)
+        sft8 = SimpleFeatureType.from_spec(
+            "wload", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
+        st8 = TpuDataStore()
+        st8.create_schema(sft8)
+        st8.load("wload", FeatureTable.build(
+            sft8, {"dtg": dtg[:n8], "geom": (x[:n8], y[:n8])}))
+        sched8 = QueryScheduler(StoreBinding(st8), flush_size=8,
+                                window_us=300)
+        try:
+            # ~200 distinct query shapes (each its own plan hash) drawn
+            # Zipf(1.1); 12 tenants drawn from a second skew — the shape
+            # the result cache will face, 3x over the 64-slot sketch
+            n_shapes, n_tenants, n_draws = 200, 12, 1200
+            shapes = [
+                f"BBOX(geom, {qx0 + (i % 20) * 0.3:.2f}, "
+                f"{qy0 + (i // 20) * 0.3:.2f}, "
+                f"{qx1 + (i % 20) * 0.3:.2f}, "
+                f"{qy1 + (i // 20) * 0.3:.2f}) AND dtg DURING "
+                "2020-01-05T00:00:00Z/2020-01-12T00:00:00Z"
+                for i in range(n_shapes)]
+            wz = 1.0 / (np.arange(n_shapes) + 1) ** 1.1
+            draw_s = rng.choice(n_shapes, size=n_draws, p=wz / wz.sum())
+            wt = 1.0 / (np.arange(n_tenants) + 1)
+            draw_t = rng.choice(n_tenants, size=n_draws, p=wt / wt.sum())
+            sched8.count("wload", shapes[0])  # warm: plan + kernels
+
+            def run8() -> float:
+                t0 = time.perf_counter()
+                for c0 in range(0, n_draws, 32):
+                    reqs = [sched8.submit("wload", shapes[draw_s[i]],
+                                          tenant=f"tenant{draw_t[i]}")
+                            for i in range(c0, min(c0 + 32, n_draws))]
+                    for r in reqs:
+                        r.result(timeout=60)
+                return time.perf_counter() - t0
+
+            # overhead: same burst, workload plane off vs on (defaults).
+            # INTERLEAVED minima (the perf-guard estimator): each rep
+            # times one off and one on pass back to back so drift hits
+            # both arms; min-of-each isolates the intrinsic plane cost
+            def _workload_on(on: bool) -> None:
+                if on:
+                    _cfg.WORKLOAD_ENABLED.unset()
+                else:
+                    _cfg.WORKLOAD_ENABLED.set(False)
+                _wl._enabled_cache[1] = 0
+
+            _workload_on(False)
+            run8()  # warm both arms' shared path
+            _wl.WORKLOAD.clear()
+            t_off = t_on = float("inf")
+            for _ in range(3):
+                _workload_on(False)
+                t_off = min(t_off, run8())
+                _workload_on(True)
+                t_on = min(t_on, run8())
+            detail["cfg8_n"] = n8
+            detail["cfg8_submitted"] = n_draws
+            detail["cfg8_workload_overhead_pct"] = round(
+                100.0 * (t_on / t_off - 1.0), 2)
+
+            # recall: sketch top-10 plan hashes vs the exact oracle (the
+            # true per-shape draw counts hashed the way the scheduler
+            # hashes them) — 3 identical enabled passes only scale every
+            # count equally, so recall is that of one pass
+            true8: dict = {}
+            for si in draw_s:
+                ph = _plan_hash("wload", repr(parse_ecql(shapes[si])),
+                                None)
+                true8[ph] = true8.get(ph, 0) + 1
+            oracle8 = {k for k, _ in sorted(
+                true8.items(), key=lambda kv: (-kv[1], kv[0]))[:10]}
+            _wl.WORKLOAD.drain()
+            got8 = {e["key"] for e in
+                    _wl.WORKLOAD.hot_set(k=10)["plans"]}
+            detail["cfg8_hotset_recall"] = round(
+                len(got8 & oracle8) / 10.0, 2)
+            detail["cfg8_hotset_total"] = _wl.WORKLOAD.hot_set()["total"]
+        finally:
+            _cfg.WORKLOAD_ENABLED.unset()
+            _wl._enabled_cache[1] = 0
+            sched8.shutdown()
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

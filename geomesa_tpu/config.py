@@ -354,6 +354,43 @@ OBS_JSONL_MAX_BYTES = _register(
     "Rotation threshold for the flight-recorder JSONL sink (keep-one-"
     "previous, shared durability/rotation.py policy).")
 
+# -- workload intelligence plane (obs/workload.py + obs/sketches.py) ---------
+
+WORKLOAD_ENABLED = _register(
+    "GEOMESA_TPU_WORKLOAD", True, _parse_bool,
+    "Master switch for the workload-analytics plane (windowed rollups, "
+    "heavy-hitter sketches, hot-set feed, per-tenant metering). The hot "
+    "path pays one bounded deque append per event; aggregation is "
+    "deferred to read time.")
+
+WORKLOAD_WINDOWS = _register(
+    "GEOMESA_TPU_WORKLOAD_WINDOWS", 6, int,
+    "Windows retained per rollup tier (10s/1m/10m rings): the newest N "
+    "wall-clock-aligned windows; older windows rotate out with their "
+    "event counts folded into retired_events.")
+
+WORKLOAD_SKETCH_K = _register(
+    "GEOMESA_TPU_WORKLOAD_SKETCH_K", 64, int,
+    "SpaceSaving sketch capacity (counters tracked) for the plan-hash, "
+    "tenant and hot-cell heavy-hitter summaries. Any key with frequency "
+    "above total/capacity is guaranteed tracked.")
+
+WORKLOAD_HOTSET_K = _register(
+    "GEOMESA_TPU_WORKLOAD_HOTSET_K", 10, int,
+    "Entries returned by hot_set() per dimension (top plan hashes, top "
+    "cells) — the feed a result cache would key its admission on.")
+
+WORKLOAD_CELL_BITS = _register(
+    "GEOMESA_TPU_WORKLOAD_CELL_BITS", 6, int,
+    "Resolution of the hot-cell grid: queries map to a coarse Morton "
+    "cell on a 2^bits x 2^bits lon/lat grid (6 -> 64x64 world cells, "
+    "~5.6 x 2.8 degrees at the equator).")
+
+WORKLOAD_PENDING = _register(
+    "GEOMESA_TPU_WORKLOAD_PENDING", 65536, int,
+    "Bound on the workload plane's pending-event queue; events past the "
+    "bound are counted dropped rather than blocking the hot path.")
+
 SLO_LATENCY_MS = _register(
     "GEOMESA_TPU_SLO_LATENCY_MS", 250.0, float,
     "Latency objective threshold for the default serving SLO: a count "

@@ -593,15 +593,17 @@ class TpuDataStore:
     def count_many(self, type_name: str, filters,
                    auths: Optional[list] = None,
                    deadline_ms: Optional[float] = None,
-                   priority: str = "interactive") -> List[int]:
+                   priority: str = "interactive",
+                   tenant: Optional[str] = None) -> List[int]:
         """Counts for many filters through the scheduler: compatible queries
         fuse into single batched device dispatches; repeated/parameterized
         filters hit the plan/cover caches. Order-preserving. ``deadline_ms``
         bounds every count in the set; ``priority`` classes the work for
-        admission control ('interactive' | 'batch')."""
+        admission control ('interactive' | 'batch'); ``tenant`` labels it
+        for workload analytics/metering (auths-derived when omitted)."""
         return self.scheduler().count_many(type_name, filters, auths=auths,
                                            deadline_ms=deadline_ms,
-                                           priority=priority)
+                                           priority=priority, tenant=tenant)
 
     def count_future(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
                      auths: Optional[list] = None,
@@ -618,12 +620,13 @@ class TpuDataStore:
                         f: Union[str, ir.Filter] = "INCLUDE",
                         auths: Optional[list] = None,
                         deadline_ms: Optional[float] = None,
-                        priority: str = "interactive") -> int:
+                        priority: str = "interactive",
+                        tenant: Optional[str] = None) -> int:
         """Count via the scheduler when serving coalescing is enabled
         (GEOMESA_TPU_SCHEDULER / params {'scheduler': False}); otherwise the
         direct per-request path. The web /count route calls this, so
         concurrent HTTP requests share device dispatches — and propagate
-        their deadline/priority envelope into the scheduler."""
+        their deadline/priority/tenant envelope into the scheduler."""
         from geomesa_tpu import config
         if not config.SCHED_ENABLED.get() \
                 or self.params.get("scheduler") is False:
@@ -631,7 +634,7 @@ class TpuDataStore:
                               deadline_ms=deadline_ms)
         return self.scheduler().count(type_name, f, auths=auths,
                                       deadline_ms=deadline_ms,
-                                      priority=priority)
+                                      priority=priority, tenant=tenant)
 
     # -- queries ------------------------------------------------------------
 

@@ -68,13 +68,15 @@ def install() -> None:
     _trace.add_close_hook(_on_trace_close)
     _metrics.set_exemplar_filter(_retained_filter)
 
-    # the sampler's deferred retention decisions and the device hook's
-    # pending fetch attributions settle right before any snapshot-ish
-    # registry read, so surfaces stay accurate without the per-query hot
-    # path paying for either
+    # the sampler's deferred retention decisions, the device hook's
+    # pending fetch attributions, and the workload plane's pending event
+    # queue all settle right before any snapshot-ish registry read, so
+    # surfaces stay accurate without the per-query hot path paying for any
     def _pre_drain():
+        from geomesa_tpu.obs import workload as _workload
         _sampling.SAMPLER.drain()
         _attrib.flush()
+        _workload.WORKLOAD.drain()
 
     _metrics.set_pre_drain_hook(_pre_drain)
     _metrics.set_gauge("obs.flight_depth", lambda: len(_flight.RECORDER))

@@ -95,9 +95,12 @@ class NodeScrape:
 def _local_fetch() -> Tuple[dict, dict]:
     """The in-process node's (healthz-lite, state) — the router federates
     its own router.* counters without scraping itself over HTTP."""
+    from geomesa_tpu.obs import workload as _workload
     hz = {"status": "ok",
           "node": {"id": _trace.node_id(), "role": _trace.node_role()}}
-    return hz, _metrics.export_state()
+    state = _metrics.export_state()
+    state["workload"] = _workload.WORKLOAD.export_state()
+    return hz, state
 
 
 class Federator:
@@ -286,6 +289,31 @@ class Federator:
         return {"nodes": nodes,
                 "slo": self.slo(),
                 "repl_e2e_ms": self._repl_e2e_summary()}
+
+    def fleet_workload(self) -> dict:
+        """Fleet-wide workload intelligence: every node's windowed
+        rollup/sketch state (riding the same /metrics?format=state
+        scrape) merged exactly — aligned windows sum bucket counts,
+        SpaceSaving sketches merge with propagated error bounds — then
+        summarized through the SAME read surfaces a single node exposes,
+        so /workload and /fleet/workload speak one schema."""
+        from geomesa_tpu.obs import workload as _workload
+        states, nodes = [], {}
+        for name, s in sorted(self.refresh().items()):
+            if not (s.ok and s.state):
+                nodes[name] = {"ok": False, "error": s.error}
+                continue
+            wst = s.state.get("workload") or {}
+            states.append(wst)
+            nodes[name] = {"ok": True, "node_id": s.node_id,
+                           "consumed": int(wst.get("consumed", 0)),
+                           "dropped": int(wst.get("dropped", 0))}
+        merged = _workload.WorkloadAnalytics.from_state(
+            _workload.merge_states(states))
+        return {"nodes": nodes,
+                "hot_set": merged.hot_set(),
+                "tenants": merged.top_tenants(),
+                "rollups": merged.rollups()}
 
     def _repl_e2e_summary(self) -> Optional[dict]:
         merged = self._merged_hists("timers")

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 from geomesa_tpu import config
 from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.obs import workload as _workload
 
 
 def plan_hash(type_name: str, f_key: str, auths_key=None) -> str:
@@ -43,6 +44,18 @@ def plan_hash(type_name: str, f_key: str, auths_key=None) -> str:
     ``hash()``, so two runs agree)."""
     raw = f"{type_name}|{f_key}|{auths_key}".encode()
     return format(zlib.crc32(raw), "08x")
+
+
+def tenant_label(tenant=None, auths=None) -> str:
+    """Canonical tenant label for workload analytics and metering: the
+    explicit tenant (``?tenant=`` / ``X-Tenant`` / submit kwarg) wins;
+    otherwise the FIRST sorted auth stands in (one label per principal
+    group, bounded cardinality); otherwise ``default``."""
+    if tenant:
+        return str(tenant)[:64]
+    if auths:
+        return "auth:" + sorted(str(a) for a in auths)[0][:56]
+    return "default"
 
 
 def matches(rec: dict, slow_ms: Optional[float] = None,
@@ -146,6 +159,9 @@ class FlightRecorder:
             if self._sink_path() is not None:
                 self._write_jsonl_locked(
                     (json.dumps(event, default=str) + "\n").encode())
+        # tee into the workload-analytics plane (one bounded append;
+        # aggregation is deferred to its drain)
+        _workload.WORKLOAD.offer(event)
 
     def record_trace(self, t) -> None:
         """Hot-path variant for the trace close hook: the ring holds the
@@ -169,6 +185,9 @@ class FlightRecorder:
         # is advisory
         self._ring.append(t)
         self._n_recorded += 1
+        # the workload plane gets the raw trace too; its wide event
+        # materializes at ITS drain, same deferral as the ring's
+        _workload.WORKLOAD.offer(t)
 
     def _ring_snapshot(self) -> list:
         """Copy the ring despite lockless concurrent appends: deque
@@ -283,6 +302,8 @@ def event_from_request(req, fut) -> dict:
         "batch_size": req.batch_size,
         "batch_id": req.batch_id,
         "priority": req.priority,
+        "tenant": req.tenant,
+        "cell": req.cell,
         "deadline_budget_ms": req.budget_ms,
         "deadline_slack_ms": None if req.deadline is None
         else round(req.deadline.remaining_ms(), 3),
@@ -349,4 +370,6 @@ def event_from_trace(t, retained: bool = False,
     }
     if f is not None:
         ev["plan_hash"] = plan_hash(str(attrs.get("type")), str(f))
+    if attrs.get("tenant") is not None:
+        ev["tenant"] = attrs.get("tenant")
     return ev

@@ -1,0 +1,217 @@
+"""Mergeable streaming sketches for workload analytics.
+
+Two primitives back obs/workload.py's heavy-hitter surfaces:
+
+  SpaceSaving   the Metwally/Agrawal/El Abbadi stream-summary: a fixed
+                budget of counters tracks the heavy hitters of an
+                unbounded key stream. Every tracked key carries an
+                OVERESTIMATE of its true count plus an explicit error
+                bound: true <= estimate and estimate - error <= true.
+                Sketches MERGE like histograms (counter sums + error
+                propagation, commutative), so per-node sketches fold
+                into one fleet-wide top-k through the Federator exactly
+                the way bucket histograms do.
+
+  cell_key()    coarse Morton/Z-prefix spatial cells: a query's bbox
+                center quantized onto a 2^bits x 2^bits lon/lat grid and
+                bit-interleaved in the same x-least-significant layout
+                as curves/zorder.py's Z2 keys (a cell IS a z2 prefix at
+                reduced resolution). SpaceSaving over cell keys is the
+                hot-cell grid — a spatial heatmap of query LOAD, not of
+                the data.
+
+Import discipline (obs/__init__ rule): stdlib only — no planner /
+scheduler / datastore imports, not even curves/ (the interleave is ~10
+lines; tests assert it agrees with curves.zorder.z2_encode).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class SpaceSaving:
+    """Fixed-capacity heavy-hitter summary over (key -> count) streams.
+
+    ``offer(key, n)`` admits a key by evicting the minimum counter and
+    inheriting its value as the new key's error bound — the classic
+    stream-summary update. Guarantees for every tracked key:
+
+        true_count <= estimate            (never an undercount)
+        estimate - error <= true_count    (the bound is explicit)
+
+    and any key with true_count > n_total/capacity is guaranteed tracked.
+    """
+
+    __slots__ = ("capacity", "n_total", "_counts", "_errors")
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self.n_total = 0                       # total weight offered
+        self._counts: Dict[str, int] = {}
+        self._errors: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def offer(self, key: str, count: int = 1) -> None:
+        if count <= 0:
+            return
+        self.n_total += count
+        c = self._counts.get(key)
+        if c is not None:
+            self._counts[key] = c + count
+            return
+        if len(self._counts) < self.capacity:
+            self._counts[key] = count
+            self._errors[key] = 0
+            return
+        # evict the minimum counter; the newcomer inherits its value as
+        # the overestimate/error (ties break on key for determinism)
+        mkey = min(self._counts, key=lambda k: (self._counts[k], k))
+        m = self._counts.pop(mkey)
+        self._errors.pop(mkey, None)
+        self._counts[key] = m + count
+        self._errors[key] = m
+
+    def estimate(self, key: str) -> int:
+        return self._counts.get(key, 0)
+
+    def error(self, key: str) -> int:
+        return self._errors.get(key, 0)
+
+    def min_count(self) -> int:
+        """The floor below which an UNTRACKED key's true count must lie
+        (0 while the summary still has free slots)."""
+        if len(self._counts) < self.capacity:
+            return 0
+        return min(self._counts.values()) if self._counts else 0
+
+    def top(self, k: int) -> List[Tuple[str, int, int]]:
+        """Top-k ``(key, estimate, error)`` by estimate, deterministic
+        tie-break on key."""
+        items = sorted(self._counts.items(),
+                       key=lambda kv: (-kv[1], kv[0]))[: max(0, int(k))]
+        return [(key, c, self._errors.get(key, 0)) for key, c in items]
+
+    # -- merge / serialization ------------------------------------------------
+
+    def to_state(self) -> dict:
+        """Wire form for /metrics?format=state federation (sorted so two
+        equal sketches serialize identically)."""
+        return {"capacity": self.capacity, "n_total": self.n_total,
+                "items": {k: [self._counts[k], self._errors.get(k, 0)]
+                          for k in sorted(self._counts)}}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "SpaceSaving":
+        s = cls(int(state.get("capacity", 1)))
+        s.n_total = int(state.get("n_total", 0))
+        for k, (c, e) in (state.get("items") or {}).items():
+            s._counts[k] = int(c)
+            s._errors[k] = int(e)
+        return s
+
+    @classmethod
+    def merge(cls, a: "SpaceSaving", b: "SpaceSaving") -> "SpaceSaving":
+        """Commutative merge: for each key in either summary the merged
+        estimate sums the per-sketch estimates, substituting a sketch's
+        ``min_count`` (its maximum possible missed count) for keys it
+        does not track — so the merged value is still an overestimate
+        and the merged error still bounds it. Keeps the top ``capacity``
+        keys by (estimate, key), which is symmetric in (a, b)."""
+        cap = max(a.capacity, b.capacity)
+        out = cls(cap)
+        out.n_total = a.n_total + b.n_total
+        amin, bmin = a.min_count(), b.min_count()
+        merged: Dict[str, Tuple[int, int]] = {}
+        for key in set(a._counts) | set(b._counts):
+            ca, cb = a._counts.get(key), b._counts.get(key)
+            est = (ca if ca is not None else amin) \
+                + (cb if cb is not None else bmin)
+            err = (a._errors.get(key, 0) if ca is not None else amin) \
+                + (b._errors.get(key, 0) if cb is not None else bmin)
+            merged[key] = (est, err)
+        keep = sorted(merged.items(),
+                      key=lambda kv: (-kv[1][0], kv[0]))[:cap]
+        for key, (est, err) in keep:
+            out._counts[key] = est
+            out._errors[key] = err
+        return out
+
+    @classmethod
+    def merge_all(cls, sketches: List["SpaceSaving"]) -> "SpaceSaving":
+        if not sketches:
+            return cls(1)
+        out = sketches[0]
+        for s in sketches[1:]:
+            out = cls.merge(out, s)
+        return out
+
+
+# -- coarse Morton/Z-prefix cells ---------------------------------------------
+
+
+def _spread_bits(v: int) -> int:
+    """Interleave helper: bit i of ``v`` moves to bit 2i (plain-int twin
+    of curves/zorder.spread2, enough bits for any cell resolution)."""
+    v &= 0xFFFFFFFF
+    v = (v | (v << 16)) & 0x0000FFFF0000FFFF
+    v = (v | (v << 8)) & 0x00FF00FF00FF00FF
+    v = (v | (v << 4)) & 0x0F0F0F0F0F0F0F0F
+    v = (v | (v << 2)) & 0x3333333333333333
+    v = (v | (v << 1)) & 0x5555555555555555
+    return v
+
+
+def _squash_bits(v: int) -> int:
+    v &= 0x5555555555555555
+    v = (v | (v >> 1)) & 0x3333333333333333
+    v = (v | (v >> 2)) & 0x0F0F0F0F0F0F0F0F
+    v = (v | (v >> 4)) & 0x00FF00FF00FF00FF
+    v = (v | (v >> 8)) & 0x0000FFFF0000FFFF
+    v = (v | (v >> 16)) & 0x00000000FFFFFFFF
+    return v
+
+
+def z_interleave(x: int, y: int) -> int:
+    """x least-significant of each bit pair — the Z2 layout of
+    curves/zorder.z2_encode, as plain ints."""
+    return _spread_bits(x) | (_spread_bits(y) << 1)
+
+
+def cell_key(xmin: float, ymin: float, xmax: float, ymax: float,
+             bits: int) -> Optional[str]:
+    """The coarse Morton cell holding a query bbox's CENTER on a
+    ``2^bits x 2^bits`` lon/lat grid, as a stable string key
+    ``b<bits>:<z hex>``. None for out-of-range/degenerate boxes."""
+    bits = max(1, min(16, int(bits)))
+    try:
+        cx = (float(xmin) + float(xmax)) / 2.0
+        cy = (float(ymin) + float(ymax)) / 2.0
+    except (TypeError, ValueError):
+        return None
+    if not (-180.0 <= cx <= 180.0 and -90.0 <= cy <= 90.0):
+        return None
+    n = 1 << bits
+    gx = min(n - 1, max(0, int((cx + 180.0) / 360.0 * n)))
+    gy = min(n - 1, max(0, int((cy + 90.0) / 180.0 * n)))
+    width = max(1, (2 * bits + 3) // 4)  # fixed hex width per resolution
+    return f"b{bits}:{z_interleave(gx, gy):0{width}x}"
+
+
+def cell_bbox(cell: str) -> Optional[Tuple[float, float, float, float]]:
+    """Invert :func:`cell_key` → the cell's (xmin, ymin, xmax, ymax) in
+    lon/lat degrees (the heatmap display surface)."""
+    try:
+        prefix, zhex = cell.split(":", 1)
+        bits = int(prefix.lstrip("b"))
+        z = int(zhex, 16)
+    except (AttributeError, ValueError):
+        return None
+    n = 1 << bits
+    gx = _squash_bits(z)
+    gy = _squash_bits(z >> 1)
+    dx, dy = 360.0 / n, 180.0 / n
+    return (-180.0 + gx * dx, -90.0 + gy * dy,
+            -180.0 + (gx + 1) * dx, -90.0 + (gy + 1) * dy)

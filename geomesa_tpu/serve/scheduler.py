@@ -74,6 +74,7 @@ from geomesa_tpu.filter.parser import parse_ecql
 from geomesa_tpu.metrics import REGISTRY as _metrics
 from geomesa_tpu.obs import attrib as _attrib
 from geomesa_tpu.obs import flight as _flight
+from geomesa_tpu.obs import workload as _workload
 from geomesa_tpu.serve.resilience import deadline as _rdl
 from geomesa_tpu.serve.resilience import degrade as _degrade
 from geomesa_tpu.serve.resilience.admission import (AdmissionController,
@@ -85,6 +86,22 @@ from geomesa_tpu.serve.resilience.deadline import Deadline, DeadlineExceeded
 _pc = time.perf_counter
 _MISS = object()
 _STOP = object()
+
+
+def _query_cell(f: "ir.Filter") -> Optional[str]:
+    """The coarse Morton hot-cell key for a filter's FIRST bbox
+    constraint (And recurses; anything else is spatially unkeyed) —
+    the workload plane's spatial heatmap dimension."""
+    if isinstance(f, ir.BBox):
+        from geomesa_tpu.obs.sketches import cell_key
+        return cell_key(f.xmin, f.ymin, f.xmax, f.ymax,
+                        int(config.WORKLOAD_CELL_BITS.get()))
+    if isinstance(f, ir.And):
+        for c in f.children:
+            cell = _query_cell(c)
+            if cell is not None:
+                return cell
+    return None
 
 # priority-queue ranks: interactive dequeues before batch; _STOP ranks last
 # so a graceful shutdown serves already-queued work first
@@ -220,12 +237,15 @@ class Request:
                  # flight-recorder dimensions (obs/flight.py wide events)
                  "trace_id", "trace_gid", "parent_span", "budget_ms",
                  "plan_cache_hit", "cover_cache_hit", "batch_id",
-                 "rows_scanned", "shed", "breaker_open", "retries")
+                 "rows_scanned", "shed", "breaker_open", "retries",
+                 # workload-analytics dimensions (obs/workload.py)
+                 "tenant", "cell")
 
     def __init__(self, type_name, f_ir, f_key, auths, auths_key,
                  planner, delta, generation, epoch,
                  deadline: Optional[Deadline] = None,
-                 priority: str = "interactive"):
+                 priority: str = "interactive",
+                 tenant: Optional[str] = None):
         self.type_name = type_name
         self.f_ir = f_ir
         self.f_key = f_key
@@ -258,6 +278,8 @@ class Request:
         self.shed = False
         self.breaker_open = False
         self.retries = 0
+        self.tenant = tenant
+        self.cell: Optional[str] = None
 
     def result(self, timeout: Optional[float] = None) -> int:
         return self.future.result(timeout=timeout)
@@ -348,11 +370,14 @@ class QueryScheduler:
                auths: Optional[list] = None,
                deadline: Optional[Deadline] = None,
                deadline_ms: Optional[float] = None,
-               priority: str = "interactive") -> Request:
+               priority: str = "interactive",
+               tenant: Optional[str] = None) -> Request:
         """Enqueue one count; returns a Request whose ``result()`` blocks.
         Parse errors and admission sheds (ShedError) raise here, before
         anything queues. The effective deadline is the sooner of the
-        explicit one and any ambient request deadline."""
+        explicit one and any ambient request deadline. ``tenant`` labels
+        the request for workload analytics/metering (falls back to the
+        first sorted auth, then 'default')."""
         if not self._running:
             raise RuntimeError("scheduler is shut down")
         f_ir = parse_ecql(f) if isinstance(f, str) else f
@@ -362,7 +387,10 @@ class QueryScheduler:
         dl = _rdl.resolve(deadline, deadline_ms)
         req = Request(type_name, f_ir, repr(f_ir), auths, auths_key,
                       planner, delta, gen, epoch, deadline=dl,
-                      priority=normalize_priority(priority))
+                      priority=normalize_priority(priority),
+                      tenant=_flight.tenant_label(tenant, auths))
+        if _workload.enabled():
+            req.cell = _query_cell(f_ir)
         # flight-recorder envelope: the wide event fires on EVERY resolution
         # path, so the callback attaches before any of them can run
         caller_trace = _trace.current_trace()
@@ -410,25 +438,28 @@ class QueryScheduler:
               auths: Optional[list] = None,
               timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None,
-              priority: str = "interactive") -> int:
+              priority: str = "interactive",
+              tenant: Optional[str] = None) -> int:
         """Blocking scheduled count. The caller's trace receives queue_wait
         / plan / scan leaves — a plan-cache hit shows NO plan span."""
         with _trace.trace("query.count", type=type_name, filter=str(f),
                           scheduled=True):
             req = self.submit(type_name, f, auths, deadline_ms=deadline_ms,
-                              priority=priority)
+                              priority=priority, tenant=tenant)
             return self._finish(req, timeout)
 
     def count_many(self, type_name: str, filters, auths: Optional[list] = None,
                    timeout: Optional[float] = None,
                    deadline_ms: Optional[float] = None,
-                   priority: str = "interactive") -> List[int]:
+                   priority: str = "interactive",
+                   tenant: Optional[str] = None) -> List[int]:
         """Counts for many filters, submitted together so they coalesce into
         fused dispatches. Order-preserving."""
         with _trace.trace("query.count_many", type=type_name,
                           n=len(filters), scheduled=True):
             reqs = [self.submit(type_name, f, auths, deadline_ms=deadline_ms,
-                                priority=priority) for f in filters]
+                                priority=priority, tenant=tenant)
+                    for f in filters]
             return [self._finish(r, timeout) for r in reqs]
 
     def _finish(self, req: Request, timeout: Optional[float]) -> int:
@@ -826,10 +857,17 @@ class QueryScheduler:
             # per-kernel device attribution + the per-dispatch wide event
             _attrib.record_dispatch(kid, tier, wait_s)
             if config.OBS_ENABLED.get():
+                # a fused batch may mix admission classes/tenants: the
+                # event carries the distinct labels so the JSONL sink's
+                # batch rows are attributable like per-query rows
                 _flight.RECORDER.record({
                     "kind": "batch", "batch_id": batch_id,
                     "type": grp[0].type_name, "kernel": kid,
                     "batch_size": len(grp),
+                    "priority": ",".join(sorted({r.priority
+                                                 for r in grp})),
+                    "tenant": ",".join(sorted({str(r.tenant or "default")
+                                               for r in grp})),
                     "duration_ms": round(scan_s * 1000, 3),
                     "device_ms": round(wait_s * 1000, 3),
                     "rows_scanned": grp[0].rows_scanned})

@@ -12,7 +12,7 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|admission|wal|replication
+    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|admission|wal|replication|workload
                               [--format prometheus] [--slow MS] [--errors]
                               [--kind K] [--addr HOST:PORT ...] [-s STORE -f NAME -q ECQL]
                               [--id TRACE_ID --fleet]   (debug trace: stitched tree)
@@ -252,7 +252,7 @@ def cmd_debug(args):
     if args.store:
         store = _load(args.store, must_exist=True)
         if args.feature and args.cql:
-            if args.what == "scheduler":
+            if args.what in ("scheduler", "workload"):
                 ns = store.count_many(args.feature, [args.cql] * 8)
                 print(f"# ran 8x count({args.feature!r}, {args.cql!r}) "
                       f"through the scheduler -> {ns[0]}", file=sys.stderr)
@@ -360,6 +360,29 @@ def cmd_debug(args):
         # + page/ticket state per objective
         from geomesa_tpu.obs.slo import ENGINE
         print(json.dumps({"slo": ENGINE.evaluate()}, indent=2, default=str))
+    elif args.what == "workload":
+        # workload intelligence: windowed rollups, heavy-hitter plan
+        # hashes/tenants, hot spatial cells — this process's plane, or a
+        # RUNNING node's GET /workload via --addr
+        out = {}
+        if args.addr:
+            import urllib.request
+            for addr in args.addr:
+                base = addr if addr.startswith("http") else f"http://{addr}"
+                try:
+                    with urllib.request.urlopen(base + "/workload",
+                                                timeout=5) as r:
+                        node = json.loads(r.read().decode())
+                except OSError as e:
+                    node = {"error": str(e)}
+                if len(args.addr) == 1:
+                    out.update(node)
+                else:
+                    out.setdefault("nodes", {})[addr] = node
+        else:
+            from geomesa_tpu.obs.workload import WORKLOAD
+            out = {"workload": WORKLOAD.summary()}
+        print(json.dumps(out, indent=2, default=str))
     elif args.what == "kernels":
         # per-kernel device cost attribution (dispatches, device wait,
         # transfer bytes, compiles, flops/bytes cost model per kernel id
@@ -646,7 +669,8 @@ def build_parser() -> argparse.ArgumentParser:
                       "WAL segment inspector")
     sp.add_argument("what", choices=("metrics", "traces", "trace", "events",
                                      "slo", "kernels", "scheduler",
-                                     "admission", "wal", "replication"))
+                                     "admission", "wal", "replication",
+                                     "workload"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "

@@ -132,6 +132,19 @@ class GeoJsonApi:
             raw = headers.get("X-Priority")
         return normalize_priority(raw)
 
+    @staticmethod
+    def _request_tenant(query: dict, headers) -> Optional[str]:
+        """Caller-declared tenant from ?tenant= / X-Tenant. None falls back
+        to the auth-derived label inside the scheduler (workload metering
+        never trusts this for access control — auths stay authoritative)."""
+        raw = query.get("tenant", [None])[0]
+        if raw is None and headers is not None:
+            raw = headers.get("X-Tenant")
+        if raw is None:
+            return None
+        raw = str(raw).strip()
+        return raw or None
+
     # returns (status, payload) — dict for JSON, str for raw text bodies.
     # A 429/503 payload carries retry_after_s; the transport turns it into
     # a Retry-After header.
@@ -188,9 +201,12 @@ class GeoJsonApi:
             if fmt == "state":
                 # bucket-exact registry state for the metrics federator
                 # (lossless cross-node histogram merge), tagged with this
-                # node's fleet identity
-                return 200, {"node": self._node_meta(),
-                             "state": REGISTRY.export_state()}
+                # node's fleet identity; workload rollup/sketch state rides
+                # the same payload so one scrape carries both
+                from geomesa_tpu.obs.workload import WORKLOAD
+                state = REGISTRY.export_state()
+                state["workload"] = WORKLOAD.export_state()
+                return 200, {"node": self._node_meta(), "state": state}
             return 200, REGISTRY.snapshot()
         if parts == ["traces"]:
             from geomesa_tpu.trace import RING
@@ -224,6 +240,11 @@ class GeoJsonApi:
         if parts == ["slo"]:
             from geomesa_tpu.obs.slo import ENGINE
             return 200, {"slo": ENGINE.evaluate()}
+        if parts == ["workload"]:
+            # streaming workload analytics: windowed rollups, heavy-hitter
+            # plan hashes / tenants, hot spatial cells (query LOAD, not data)
+            from geomesa_tpu.obs.workload import WORKLOAD
+            return 200, {"workload": WORKLOAD.summary()}
         if parts == ["progress"]:
             # long-running operation phases (index builds): live phases
             # with running row throughput + the recent history
@@ -252,6 +273,10 @@ class GeoJsonApi:
                 return 200, fed.to_prometheus()  # str → text exposition
             if parts == ["fleet", "slo"]:
                 return 200, {"slo": fed.slo()}
+            if parts == ["fleet", "workload"]:
+                # fleet-wide workload intelligence: per-node window states
+                # and sketches merged into one hot-set / rollup view
+                return 200, fed.fleet_workload()
             return 404, {"error": f"no route {method} {path}"}
         if parts == ["healthz"]:
             import jax
@@ -329,7 +354,8 @@ class GeoJsonApi:
                 # flagged stats estimate
                 n = self.store.count_coalesced(
                     t, cql, auths=auths,
-                    priority=self._request_priority(query, headers))
+                    priority=self._request_priority(query, headers),
+                    tenant=self._request_tenant(query, headers))
                 out = {"count": int(n)}
                 if getattr(n, "approximate", False):
                     out["approximate"] = True

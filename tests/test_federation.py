@@ -146,7 +146,7 @@ def test_local_traces_by_id_searches_both_rings():
 
 
 def _scrape(name, role, counters=None, timers=(), gauges=None,
-            exemplars=None):
+            exemplars=None, values=()):
     """A synthetic node scrape from a REAL per-node registry — the merge
     tests exercise exactly the bytes a remote /metrics?format=state
     returns."""
@@ -156,6 +156,9 @@ def _scrape(name, role, counters=None, timers=(), gauges=None,
     for k, secs in timers:
         for s in secs:
             reg.observe(k, s)
+    for k, vals in values:
+        for v in vals:
+            reg.observe_value(k, v)
     for k, (sec, ref) in (exemplars or {}).items():
         reg.observe_exemplar(k, sec, ref)
     for k, v in (gauges or {}).items():
@@ -296,6 +299,42 @@ def test_federated_exposition_conformance():
     # summary family count matches too
     assert int(samples["geomesa_tpu_query_count_seconds_count"][0][1]) \
         == 300
+
+
+def test_federated_value_histograms_merge_and_conform():
+    """ISSUE 10 satellite: raw-unit value histograms (observe_value
+    families — batch sizes, cover cardinalities) ride export_state() and
+    federate exactly like timers: merged losslessly across nodes, emitted
+    as conformant summary + _hist families (no _seconds suffix)."""
+    a = [4.0] * 30 + [16.0] * 10
+    b = [8.0] * 25 + [16.0] * 5
+    s1 = _scrape("n1", "primary", values=[("scheduler.batch_size", a)])
+    s2 = _scrape("n2", "replica", values=[("scheduler.batch_size", b)])
+    # the state payload really carries the values section per node
+    assert s1.state["values"]["scheduler.batch_size"]["count"] == 40
+    f = _pinned_federator([s1, s2])
+    h, _ex = f._merged_hists("values")["scheduler.batch_size"]
+    oracle = MetricsRegistry()
+    for v in a + b:
+        oracle.observe_value("scheduler.batch_size", v)
+    want = oracle.export_state()["values"]["scheduler.batch_size"]
+    assert h.count == want["count"] == 70
+    assert h.total_s == pytest.approx(want["total"])
+    assert {i: c for i, c in enumerate(h.buckets) if c} \
+        == {int(i): c for i, c in want["buckets"].items()}
+    # exposition: raw-unit family (no _seconds), single # TYPE, merged
+    # _bucket cumulativity, +Inf == _count == 70
+    text = f.to_prometheus()
+    types, samples = _parse_exposition(text)
+    assert types["geomesa_tpu_scheduler_batch_size"] == "summary"
+    assert "geomesa_tpu_scheduler_batch_size_seconds" not in types
+    fam = "geomesa_tpu_scheduler_batch_size_hist"
+    assert types[fam] == "histogram"
+    counts = [int(v) for _lab, v in samples[fam + "_bucket"]]
+    assert all(x <= y for x, y in zip(counts, counts[1:]))
+    assert counts[-1] == 70
+    assert int(samples["geomesa_tpu_scheduler_batch_size_count"][0][1]) \
+        == 70
 
 
 def test_federated_exemplar_refs_rewritten_to_global_ids():

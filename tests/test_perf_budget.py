@@ -248,17 +248,19 @@ def test_tracing_overhead_under_5pct():
 
 
 def test_obs_flight_recorder_overhead_under_5pct():
-    """ISSUE 5 acceptance bar: with the flight recorder + tail sampling
-    enabled AT DEFAULTS (obs hooks installed, wide event per query, sampling
-    decision per trace close, kernel attribution labels), a count query's
-    cost stays <5% over observability disabled. Same interleaved-minima
-    estimator as the tracing guard — each rep times one disabled and one
-    fully-observed call back to back."""
+    """ISSUE 5 acceptance bar, extended by ISSUE 10: with the flight
+    recorder + tail sampling + WORKLOAD ANALYTICS enabled AT DEFAULTS
+    (obs hooks installed, wide event per query, sampling decision per
+    trace close, workload tee per event, kernel attribution labels), a
+    count query's cost stays <5% over observability disabled. Same
+    interleaved-minima estimator as the tracing guard — each rep times
+    one disabled and one fully-observed call back to back."""
     from geomesa_tpu import config, obs, trace
     from geomesa_tpu.datastore import TpuDataStore
     from geomesa_tpu.features.table import FeatureTable
     from geomesa_tpu.obs.flight import RECORDER
     from geomesa_tpu.obs.sampling import SAMPLER
+    from geomesa_tpu.obs.workload import WORKLOAD
 
     obs.install()
     rng = np.random.default_rng(6)
@@ -285,13 +287,19 @@ def test_obs_flight_recorder_overhead_under_5pct():
         return observed / base - 1.0, base, observed
 
     planner.count(q)  # warm
-    # defaults on: OBS enabled, sampling/flight at their shipped rates
-    for p in (config.OBS_ENABLED, config.OBS_SAMPLE, config.OBS_SLOW_MS):
+    # defaults on: OBS enabled, sampling/flight/workload at shipped rates
+    for p in (config.OBS_ENABLED, config.OBS_SAMPLE, config.OBS_SLOW_MS,
+              config.WORKLOAD_ENABLED):
         p.unset()
     RECORDER.clear()
     SAMPLER.clear()
+    WORKLOAD.clear()
     overhead, base, observed = min(measure() for _ in range(3))
     assert len(RECORDER), "flight events must actually have been recorded"
+    # the workload plane really rode the measured run (its producer cost
+    # is inside the <5% bar, not switched off)
+    WORKLOAD.drain()
+    assert WORKLOAD.consumed, "workload analytics must have consumed events"
     assert overhead < 0.05, (
         f"obs overhead {overhead:.1%} (observed {observed * 1e6:.0f}us vs "
         f"disabled {base * 1e6:.0f}us)")

@@ -1,0 +1,486 @@
+"""Workload intelligence plane (obs/workload.py + obs/sketches.py):
+Space-Saving guarantees and merge commutativity, Morton cell keys vs the
+real Z2 curve, rollup-window rotation under concurrent producers,
+hot-set recall against an exact oracle, fleet merge vs a single-process
+oracle, tenant metering, batch-event labels, and the web surfaces."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.metrics import REGISTRY
+from geomesa_tpu.obs import workload as wl
+from geomesa_tpu.obs.flight import RECORDER, tenant_label
+from geomesa_tpu.obs.sketches import (SpaceSaving, cell_bbox, cell_key,
+                                      z_interleave)
+from geomesa_tpu.obs.workload import (WORKLOAD, WorkloadAnalytics,
+                                      merge_states, tenant_metric_label)
+
+
+@pytest.fixture(autouse=True)
+def _workload_defaults():
+    """Reset the process-global plane and the mutable knobs per test."""
+    WORKLOAD.clear()
+    RECORDER.clear()
+    yield
+    for p in (config.WORKLOAD_ENABLED, config.WORKLOAD_WINDOWS,
+              config.WORKLOAD_SKETCH_K, config.WORKLOAD_HOTSET_K,
+              config.WORKLOAD_CELL_BITS, config.WORKLOAD_PENDING,
+              config.OBS_JSONL):
+        p.unset()
+    wl._enabled_cache[1] = 0  # drop the cached enabled verdict
+    WORKLOAD.clear()
+    RECORDER.clear()
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(7)
+    n = 20_000
+    ds = TpuDataStore()
+    ds.create_schema("wt", "v:Int,dtg:Date,*geom:Point")
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    ds.load("wt", FeatureTable.build(ds.get_schema("wt"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "dtg": base + rng.integers(0, 30 * 86400000, n),
+        "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n))}))
+    yield ds
+    if ds._scheduler is not None:
+        ds._scheduler.shutdown()
+
+
+def _ev(plan="p0", tenant="default", typ="wt", priority="interactive",
+        ts_ms=1_000_000.0, dur=1.0, cell=None, **extra):
+    ev = {"kind": "count.scheduled", "type": typ, "plan_hash": plan,
+          "priority": priority, "tenant": tenant, "ts_ms": ts_ms,
+          "duration_ms": dur, "cell": cell}
+    ev.update(extra)
+    return ev
+
+
+# -- SpaceSaving guarantees ---------------------------------------------------
+
+
+def test_space_saving_exact_within_capacity():
+    sk = SpaceSaving(8)
+    for i in range(5):
+        for _ in range(i + 1):
+            sk.offer(f"k{i}")
+    assert sk.n_total == 15
+    assert sk.min_count() == 0  # not full: untracked keys are truly absent
+    assert [(k, c) for k, c, _e in sk.top(3)] \
+        == [("k4", 5), ("k3", 4), ("k2", 3)]
+    assert all(e == 0 for _k, _c, e in sk.top(5))
+
+
+def test_space_saving_bounds_under_eviction():
+    """true <= estimate and estimate - error <= true for every tracked
+    key, and any key above n/capacity is guaranteed tracked."""
+    rng = np.random.default_rng(0)
+    true = {}
+    sk = SpaceSaving(16)
+    keys = [f"k{i}" for i in range(200)]
+    # Zipf-ish skew: key i drawn proportionally to 1/(i+1)
+    w = 1.0 / (np.arange(len(keys)) + 1)
+    for k in rng.choice(keys, size=5000, p=w / w.sum()):
+        sk.offer(str(k))
+        true[str(k)] = true.get(str(k), 0) + 1
+    assert sk.n_total == 5000
+    for k, est, err in sk.top(16):
+        assert true.get(k, 0) <= est
+        assert est - err <= true.get(k, 0)
+    guaranteed = [k for k, c in true.items()
+                  if c > sk.n_total / sk.capacity]
+    tracked = {k for k, _c, _e in sk.top(16)}
+    assert set(guaranteed) <= tracked
+
+
+def test_space_saving_merge_commutes_and_bounds():
+    rng = np.random.default_rng(1)
+    keys = [f"k{i}" for i in range(60)]
+    w = 1.0 / (np.arange(len(keys)) + 1)
+    a, b, true = SpaceSaving(12), SpaceSaving(12), {}
+    for i, k in enumerate(rng.choice(keys, size=4000, p=w / w.sum())):
+        (a if i % 2 else b).offer(str(k))
+        true[str(k)] = true.get(str(k), 0) + 1
+    ab = SpaceSaving.merge(a, b)
+    ba = SpaceSaving.merge(b, a)
+    assert ab.to_state() == ba.to_state()  # commutative, bit for bit
+    assert ab.n_total == 4000
+    for k, est, err in ab.top(12):
+        assert true.get(k, 0) <= est
+        assert est - err <= true.get(k, 0)
+
+
+def test_space_saving_state_round_trip():
+    sk = SpaceSaving(4)
+    for k in ("a", "a", "b", "c", "d", "e"):  # forces one eviction
+        sk.offer(k)
+    clone = SpaceSaving.from_state(
+        json.loads(json.dumps(sk.to_state())))
+    assert clone.to_state() == sk.to_state()
+    assert clone.top(4) == sk.top(4)
+
+
+# -- Morton cells -------------------------------------------------------------
+
+
+def test_z_interleave_matches_real_z2_curve():
+    """The stdlib-only interleave IS the curves/zorder.py Z2 bit layout —
+    a hot cell is a genuine z2 prefix at reduced resolution."""
+    from geomesa_tpu.curves.zorder import z2_encode
+    for x, y in ((0, 0), (1, 0), (0, 1), (3, 5), (63, 63),
+                 (2 ** 20, 2 ** 19), (2 ** 21 - 1, 2 ** 21 - 1)):
+        assert z_interleave(x, y) == int(z2_encode(
+            np.asarray([x], dtype=np.uint64),
+            np.asarray([y], dtype=np.uint64))[0])
+
+
+def test_cell_key_round_trip_and_range():
+    key = cell_key(-1.0, -1.0, 1.0, 1.0, bits=6)
+    assert key.startswith("b6:")
+    xmin, ymin, xmax, ymax = cell_bbox(key)
+    assert xmin <= 0.0 <= xmax and ymin <= 0.0 <= ymax
+    assert xmax - xmin == pytest.approx(360.0 / 64)
+    # same center -> same cell regardless of box size
+    assert cell_key(-10, -10, 10, 10, bits=6) == key
+    # out-of-range / garbage centers yield no cell, not a bogus one
+    assert cell_key(350, 0, 380, 10, bits=6) is None
+    assert cell_key("x", 0, 1, 1, bits=6) is None
+    assert cell_bbox("garbage") is None
+
+
+# -- rollup windows -----------------------------------------------------------
+
+
+def test_window_rotation_and_conservation_under_concurrency():
+    """Concurrent producers + out-of-order timestamps: every consumed
+    event is either in a retained window, counted retired, or counted
+    late-dropped — nothing vanishes — and each ring keeps <= keep
+    wall-aligned windows in ascending order."""
+    w = WorkloadAnalytics(spans=(10.0,), keep=4, sketch_capacity=8,
+                          meter=False)
+    per_thread, threads = 500, 8
+    rng = np.random.default_rng(2)
+    starts = rng.integers(0, 40, size=(threads, per_thread))  # 40 windows
+
+    def produce(ti):
+        for j in range(per_thread):
+            ts = 1_000_000_000.0 + float(starts[ti][j]) * 10_000.0
+            w.offer(_ev(plan=f"p{ti}", ts_ms=ts))
+
+    ts_list = [threading.Thread(target=produce, args=(i,))
+               for i in range(threads)]
+    for t in ts_list:
+        t.start()
+    # drain concurrently with production (the serving shape: reads race
+    # producers)
+    for _ in range(20):
+        w.drain()
+    for t in ts_list:
+        t.join()
+    w.drain()
+    ring = w.rings[10.0]
+    assert w.consumed == threads * per_thread
+    assert len(ring.windows) <= 4
+    ws = list(ring.windows)
+    assert all(a.start < b.start for a, b in zip(ws, ws[1:]))
+    assert all(x.start % 10.0 == 0.0 for x in ws)
+    retained = sum(x.n for x in ws)
+    assert retained + ring.retired_events + ring.late_dropped \
+        == w.consumed  # conservation: rotation loses nothing silently
+
+
+def test_rollup_summaries_expose_rates_and_percentiles():
+    w = WorkloadAnalytics(spans=(10.0,), keep=2, sketch_capacity=8,
+                          meter=False)
+    for i in range(20):
+        w.offer(_ev(plan="pA", tenant="acme", dur=5.0,
+                    plan_cache_hit=(i > 0), rows_scanned=100,
+                    rows_matched=10, device_ms=0.5,
+                    error="deadline" if i % 10 == 9 else None))
+    w.drain()
+    roll = w.rollups()["10s"]
+    assert len(roll) == 1
+    grp = roll[0]["groups"]["wt|pA|interactive|acme"]
+    assert grp["n"] == 20 and grp["qps"] == 2.0
+    assert grp["error_rate"] == pytest.approx(0.1)
+    assert grp["plan_cache_hit_rate"] == pytest.approx(19 / 20)
+    assert grp["rows_scanned"] == 2000 and grp["device_ms"] == 10.0
+    # p50/p99 come from the shared log-bucket geometry: ~5ms +- one bucket
+    assert 3.0 < grp["p50_ms"] < 8.0
+
+
+# -- hot set vs exact oracle --------------------------------------------------
+
+
+def test_hot_set_recall_on_zipf_workload():
+    """ISSUE 10 acceptance: >=0.9 recall of the true top-10 plan hashes
+    on a skewed workload with ~200 distinct shapes and a 64-slot sketch."""
+    rng = np.random.default_rng(3)
+    plans = [f"plan{i:03d}" for i in range(200)]
+    weights = 1.0 / (np.arange(200) + 1) ** 1.1  # Zipf(1.1)
+    draws = rng.choice(plans, size=20_000, p=weights / weights.sum())
+    w = WorkloadAnalytics(spans=(600.0,), keep=2, sketch_capacity=64,
+                          meter=False)
+    true = {}
+    for p in draws:
+        w.offer(_ev(plan=str(p)))
+        true[str(p)] = true.get(str(p), 0) + 1
+    w.drain()
+    oracle = {k for k, _ in sorted(true.items(),
+                                   key=lambda kv: (-kv[1], kv[0]))[:10]}
+    hs = w.hot_set(k=10)
+    got = {e["key"] for e in hs["plans"]}
+    recall = len(got & oracle) / 10
+    assert recall >= 0.9, (recall, sorted(got), sorted(oracle))
+    assert hs["total"] == 20_000
+    for e in hs["plans"]:  # confidence bounds hold against the oracle
+        assert true.get(e["key"], 0) <= e["count"]
+        assert e["at_least"] <= true.get(e["key"], 0)
+
+
+# -- fleet merge vs single-process oracle -------------------------------------
+
+
+def test_fleet_merge_matches_single_process_oracle():
+    """Split one event stream across two per-node planes; the merged
+    state's windows equal the one-process oracle EXACTLY, and the merged
+    sketch agrees on the top-10 with estimates bounded by true counts."""
+    rng = np.random.default_rng(4)
+    plans = [f"p{i:02d}" for i in range(40)]
+    weights = 1.0 / (np.arange(40) + 1)
+    draws = rng.choice(plans, size=6000, p=weights / weights.sum())
+    tenants = rng.choice(["acme", "globex", "initech"], size=6000)
+    ts = 2_000_000_000.0 + rng.integers(0, 60_000, size=6000)
+
+    def mk():
+        return WorkloadAnalytics(spans=(10.0, 60.0), keep=8,
+                                 sketch_capacity=16, meter=False)
+
+    n1, n2, oracle = mk(), mk(), mk()
+    true = {}
+    for i in range(6000):
+        ev = _ev(plan=str(draws[i]), tenant=str(tenants[i]),
+                 ts_ms=float(ts[i]))
+        (n1 if i % 2 else n2).offer(dict(ev))
+        oracle.offer(dict(ev))
+        true[str(draws[i])] = true.get(str(draws[i]), 0) + 1
+    merged = merge_states([n1.export_state(), n2.export_state()])
+    want = oracle.export_state()
+    # windows: bucket-exact equality, both tiers
+    assert merged["spans"] == want["spans"]
+    assert merged["consumed"] == want["consumed"] == 6000
+    # sketches (over-capacity regime: 40 keys, 16 slots/node): merged
+    # top-10 recalls >=0.9 of the TRUE top-10 and every estimate keeps
+    # the over/under bounds against true counts
+    m = WorkloadAnalytics.from_state(merged)
+    true_top = {k for k, _ in sorted(true.items(),
+                                     key=lambda kv: (-kv[1], kv[0]))[:10]}
+    got = [e for e in m.hot_set(k=10)["plans"]]
+    assert len({e["key"] for e in got} & true_top) >= 9
+    for e in got:
+        assert true.get(e["key"], 0) <= e["count"]
+        assert e["at_least"] <= true.get(e["key"], 0)
+    # tenant sketch merges exactly (3 distinct keys <= capacity)
+    assert {t["tenant"]: t["count"] for t in m.top_tenants()} \
+        == {t["tenant"]: t["count"] for t in oracle.top_tenants()}
+    # and the merge itself commutes
+    assert merge_states([n2.export_state(), n1.export_state()]) == merged
+
+
+def test_fleet_merge_exact_when_within_capacity():
+    """With distinct keys <= sketch capacity no eviction ever happens, so
+    the fleet-merged sketch state is IDENTICAL to the single-process
+    oracle — the acceptance regime for exact fleet/oracle agreement."""
+    rng = np.random.default_rng(5)
+    plans = [f"q{i}" for i in range(12)]
+    draws = rng.choice(plans, size=2000)
+    ts = 3_000_000_000.0 + rng.integers(0, 30_000, size=2000)
+
+    def mk():
+        return WorkloadAnalytics(spans=(10.0,), keep=8,
+                                 sketch_capacity=16, meter=False)
+
+    n1, n2, oracle = mk(), mk(), mk()
+    for i in range(2000):
+        ev = _ev(plan=str(draws[i]), ts_ms=float(ts[i]))
+        (n1 if i % 3 == 0 else n2).offer(dict(ev))
+        oracle.offer(dict(ev))
+    merged = merge_states([n1.export_state(), n2.export_state()])
+    assert merged == oracle.export_state()
+
+
+def test_merge_states_handles_empty_and_missing():
+    assert merge_states([])["consumed"] == 0
+    w = WorkloadAnalytics(spans=(10.0,), keep=2, sketch_capacity=4,
+                          meter=False)
+    w.offer(_ev())
+    st = merge_states([w.export_state(), {}, None])
+    assert st["consumed"] == 1
+    assert WorkloadAnalytics.from_state(st).hot_set(k=1)["plans"]
+
+
+# -- tenant labels + metering -------------------------------------------------
+
+
+def test_tenant_label_precedence():
+    assert tenant_label("acme", ["admin"]) == "acme"
+    assert tenant_label(None, ["user", "admin"]) == "auth:admin"
+    assert tenant_label(None, None) == "default"
+    assert len(tenant_label("x" * 200)) == 64
+    assert tenant_metric_label("we/ird te nant") == "we_ird_te_nant"
+    assert tenant_metric_label(None) == "default"
+
+
+def test_tenant_metering_counters(store):
+    before = REGISTRY.snapshot()["counters"].get(
+        "tenant.acme_test.queries", 0)
+    for _ in range(3):
+        store.count_coalesced("wt", "BBOX(geom, -5, -5, 5, 5)",
+                              tenant="acme_test")
+    WORKLOAD.drain()
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["tenant.acme_test.queries"] - before == 3
+    assert counters.get("tenant.acme_test.rows_scanned", 0) > 0
+
+
+def test_auth_fallback_tenant_flows_through_scheduler(store):
+    store.count_coalesced("wt", "BBOX(geom, -4, -4, 4, 4)",
+                          auths=["secret", "admin"])
+    WORKLOAD.drain()
+    assert any(t["tenant"] == "auth:admin" for t in WORKLOAD.top_tenants())
+
+
+# -- batch events carry admission/tenant labels (satellite 2) -----------------
+
+
+def test_batch_events_and_jsonl_sink_carry_priority_and_tenant(
+        store, tmp_path):
+    path = tmp_path / "flight.jsonl"
+    config.OBS_JSONL.set(str(path))
+    try:
+        store.count_many("wt", [f"BBOX(geom, {-8 + i}, -8, {8 + i}, 8)"
+                                for i in range(6)], tenant="batcher")
+        batches = RECORDER.recent(kind="batch")
+        assert batches, "a fused burst must emit batch events"
+        for ev in batches:
+            assert "interactive" in ev["priority"]
+            assert "batcher" in ev["tenant"]
+        RECORDER.close()
+        rows = [json.loads(line) for line in
+                path.read_text().strip().splitlines()]
+        sunk = [r for r in rows if r.get("kind") == "batch"]
+        assert sunk, "the JSONL sink must see batch events"
+        for r in sunk:  # the regression: sunk batch rows were label-less
+            assert "batcher" in r["tenant"]
+            assert "interactive" in r["priority"]
+    finally:
+        config.OBS_JSONL.unset()
+        RECORDER.close()
+
+
+def test_batch_events_not_double_counted_in_rollups(store):
+    WORKLOAD.clear()
+    store.count_many("wt", [f"BBOX(geom, {-6 + i}, -6, {6 + i}, 6)"
+                            for i in range(4)], tenant="dd")
+    WORKLOAD.drain()
+    # the burst emitted batch events (tenant=dd) into the recorder, but
+    # they're skipped at drain — only the 4 per-query events fold, so
+    # device time isn't counted once per query AND once per batch
+    assert RECORDER.recent(kind="batch")
+    dd = sum(g["n"] for w in WORKLOAD.rollups()["10s"]
+             for key, g in w["groups"].items() if key.endswith("|dd"))
+    assert dd == 4
+
+
+# -- enablement + backpressure ------------------------------------------------
+
+
+def test_disabled_plane_drops_nothing_into_pending():
+    config.WORKLOAD_ENABLED.set(False)
+    wl._enabled_cache[1] = 0
+    w = WorkloadAnalytics(spans=(10.0,), keep=2, sketch_capacity=4,
+                          meter=False)
+    for _ in range(10):
+        w.offer(_ev())
+    assert w.drain() == 0 and w.consumed == 0
+
+
+def test_pending_bound_counts_drops():
+    config.WORKLOAD_PENDING.set(5)
+    w = WorkloadAnalytics(spans=(10.0,), keep=2, sketch_capacity=4,
+                          meter=False)
+    for _ in range(12):
+        w.offer(_ev())
+    assert w.dropped == 7
+    w.drain()
+    assert w.consumed == 5
+
+
+# -- web + federation surfaces ------------------------------------------------
+
+
+def test_workload_routes_and_state_payload(store):
+    from geomesa_tpu.web.server import GeoJsonApi
+    api = GeoJsonApi(store)
+    code, payload = api.handle(
+        "GET", "/types/wt/count", {"tenant": ["webco"]})
+    assert code == 200
+    code, payload = api.handle("GET", "/workload", {})
+    assert code == 200
+    s = payload["workload"]
+    assert s["consumed"] >= 1
+    assert any(t["tenant"] == "webco" for t in s["tenants"])
+    assert set(s["rollups"].keys()) == {"10s", "60s", "600s"}
+    # the federation scrape payload carries the mergeable state
+    code, payload = api.handle("GET", "/metrics", {"format": ["state"]})
+    assert code == 200
+    wst = payload["state"]["workload"]
+    assert wst["consumed"] >= 1 and "plans" in wst
+    # header beats nothing; query param beats header
+    code, _ = api.handle("GET", "/types/wt/count", {"tenant": ["q_t"]},
+                         headers={"X-Tenant": "h_t"})
+    assert code == 200
+    WORKLOAD.drain()
+    tenants = {t["tenant"] for t in WORKLOAD.top_tenants()}
+    assert "q_t" in tenants and "h_t" not in tenants
+
+
+def test_fleet_workload_merges_local_node(store):
+    from geomesa_tpu.obs import federation as _fed
+    from geomesa_tpu.web.server import GeoJsonApi
+    store.count_coalesced("wt", "BBOX(geom, -3, -3, 3, 3)",
+                          tenant="fleet_t")
+    fed = _fed.Federator({"local": None})
+    fw = fed.fleet_workload()
+    assert fw["nodes"]["local"]["ok"]
+    assert fw["nodes"]["local"]["consumed"] >= 1
+    assert any(t["tenant"] == "fleet_t" for t in fw["tenants"])
+    assert fw["hot_set"]["total"] >= 1
+    # the /fleet/workload route serves the same payload
+    _fed.FEDERATOR = fed
+    try:
+        api = GeoJsonApi(store)
+        code, payload = api.handle("GET", "/fleet/workload", {})
+        assert code == 200 and payload["nodes"]["local"]["ok"]
+    finally:
+        _fed.FEDERATOR = None
+
+
+def test_queries_record_hot_cells(store):
+    for _ in range(3):
+        store.count_coalesced("wt", "BBOX(geom, -1, -1, 1, 1)")
+    WORKLOAD.drain()
+    cells = WORKLOAD.hot_set()["cells"]
+    assert cells, "BBOX queries must land in the hot-cell grid"
+    key = cells[0]["key"]
+    assert key == cell_key(-1, -1, 1, 1,
+                           int(config.WORKLOAD_CELL_BITS.get()))
+    xmin, ymin, xmax, ymax = cells[0]["bbox"]
+    assert xmin <= 0.0 <= xmax and ymin <= 0.0 <= ymax
