@@ -629,6 +629,46 @@ def main(args=None) -> int:
             (bb[:, 0] <= 14) & (bb[:, 2] >= -12)
             & (bb[:, 1] <= 50) & (bb[:, 3] >= 28))), 5)
         detail["cfg2_cpu_envelope_ms"] = round(_p50(lat2c), 2)
+        # exact CPU comparator: each feature is one segment, the query a
+        # convex-free fixed ring — segment intersects polygon iff an
+        # endpoint is inside (even-odd ray cast) or it crosses an edge
+        # (orientation signs; zero-sign covers boundary touches). This is
+        # ground truth for the device-prefilter + host-refine count above,
+        # so a mismatch fails the whole run, same as cfg1's assert.
+        ring = np.array([(-12.0, 30.0), (10.0, 28.0), (14.0, 44.0),
+                         (-2.0, 50.0), (-12.0, 30.0)])
+
+        def exact_intersects_count():
+            ax, ay, bx_, by_ = lx, ly, lx + dx, ly + dy
+            hit = np.zeros(n2, dtype=bool)
+            for qx, qy in ((ax, ay), (bx_, by_)):
+                ins = np.zeros(n2, dtype=bool)
+                for i in range(len(ring) - 1):
+                    (x1, y1), (x2, y2) = ring[i], ring[i + 1]
+                    crosses = (y1 > qy) != (y2 > qy)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        xint = x1 + (qy - y1) * (x2 - x1) / (y2 - y1)
+                    ins ^= crosses & (qx < xint)
+                hit |= ins
+
+            def orient(ox, oy, px_, py_, rx, ry):
+                return np.sign((px_ - ox) * (ry - oy)
+                               - (py_ - oy) * (rx - ox))
+
+            for i in range(len(ring) - 1):
+                (x1, y1), (x2, y2) = ring[i], ring[i + 1]
+                o1 = orient(ax, ay, bx_, by_, x1, y1)
+                o2 = orient(ax, ay, bx_, by_, x2, y2)
+                o3 = orient(x1, y1, x2, y2, ax, ay)
+                o4 = orient(x1, y1, x2, y2, bx_, by_)
+                hit |= (o1 != o2) & (o3 != o4)
+            return int(hit.sum())
+
+        lat2e = _time_reps(exact_intersects_count, max(3, reps // 4))
+        detail["cfg2_cpu_exact_ms"] = round(_p50(lat2e), 2)
+        exact_ref = exact_intersects_count()
+        assert c2 == exact_ref, \
+            f"cfg2 correctness check failed: {c2} != {exact_ref}"
         del idx2, planner2, table2, garr
         gc.collect()
 

@@ -470,6 +470,92 @@ REPL_TRACE_EVERY = _register(
     "repl.e2e histogram (fleet p99 -> exemplar -> remote apply trace). "
     "0 disables the traced applies (timers still populate).")
 
+# -- fleet doctor: anomaly detectors + incidents (obs/doctor, obs/incidents) --
+
+DOCTOR_ENABLED = _register(
+    "GEOMESA_TPU_DOCTOR", True, _parse_bool,
+    "Master switch for the fleet doctor: rule-driven anomaly detectors "
+    "(SLO burn, replication lag, recompile churn, shed storm, breaker "
+    "flapping, WAL fsync stall, hot-set skew) evaluated on read/tick — "
+    "the query hot path never pays for it.")
+
+DOCTOR_WINDOW_S = _register(
+    "GEOMESA_TPU_DOCTOR_WINDOW_S", 60.0, float,
+    "Observation window for the doctor's rate detectors (recompile "
+    "churn, shed storm, breaker flapping): counter deltas older than "
+    "this are forgotten, so a burst must sustain inside the window to "
+    "keep an incident active.")
+
+DOCTOR_LAG_MS = _register(
+    "GEOMESA_TPU_DOCTOR_LAG_MS", 1000.0, float,
+    "Replication-lag detector threshold on the decay-based "
+    "replication.lag_ms gauge; a follower above it opens a "
+    "replication_lag incident.")
+
+DOCTOR_LAG_SEQS = _register(
+    "GEOMESA_TPU_DOCTOR_LAG_SEQS", 64, int,
+    "Replication-lag detector threshold on sequence backlog "
+    "(replication.lag_seqs): a follower this many WAL frames behind "
+    "fires even when the time-based gauge has decayed.")
+
+DOCTOR_RECOMPILES_PER_MIN = _register(
+    "GEOMESA_TPU_DOCTOR_RECOMPILES_PER_MIN", 6.0, float,
+    "Recompile-churn detector threshold: kernels.recompiles advancing "
+    "faster than this (rate normalized to per-minute over the doctor "
+    "window) opens an incident naming the most-recompiled kernel.")
+
+DOCTOR_SHED_PER_MIN = _register(
+    "GEOMESA_TPU_DOCTOR_SHED_PER_MIN", 30.0, float,
+    "Shed-storm detector threshold: admission.shed advancing faster "
+    "than this per minute over the doctor window opens an incident "
+    "naming the dominant shed priority class.")
+
+DOCTOR_BREAKER_FLAPS = _register(
+    "GEOMESA_TPU_DOCTOR_BREAKER_FLAPS", 3, int,
+    "Breaker-flapping detector threshold: this many open/close "
+    "transition edges on one breaker inside the doctor window opens a "
+    "breaker_flapping incident.")
+
+DOCTOR_FSYNC_ERRORS = _register(
+    "GEOMESA_TPU_DOCTOR_FSYNC_ERRORS", 1, int,
+    "WAL fsync-stall detector threshold: this many new wal.fsync_errors "
+    "(or fsync retries) inside the doctor window opens an incident — "
+    "durability faults page immediately by default.")
+
+DOCTOR_SKEW_FRACTION = _register(
+    "GEOMESA_TPU_DOCTOR_SKEW_FRACTION", 0.6, float,
+    "Hot-set skew detector threshold: a single plan/cell/tenant whose "
+    "guaranteed (at_least) share of the workload window exceeds this "
+    "fraction opens a hot_skew incident naming it.")
+
+DOCTOR_SKEW_MIN = _register(
+    "GEOMESA_TPU_DOCTOR_SKEW_MIN", 200, int,
+    "Minimum events in the workload window before the skew detector "
+    "may fire (tiny samples always look skewed).")
+
+DOCTOR_CLEAR_TICKS = _register(
+    "GEOMESA_TPU_DOCTOR_CLEAR_TICKS", 2, int,
+    "Consecutive clear evaluations required before an active incident "
+    "closes with a resolution record (debounces detectors oscillating "
+    "around their threshold).")
+
+DOCTOR_JOURNAL = _register(
+    "GEOMESA_TPU_DOCTOR_JOURNAL", "", str,
+    "Path of the incident journal: every incident open/close appends a "
+    "JSONL record with its correlated timeline. Empty disables the "
+    "journal (incidents stay queryable in memory).")
+
+DOCTOR_JOURNAL_MAX_BYTES = _register(
+    "GEOMESA_TPU_DOCTOR_JOURNAL_MAX_BYTES", 16 * 1024 * 1024, int,
+    "Size cap for the incident journal before rotation (keeps one "
+    "rotated predecessor, .1, via the durability rotation helper).")
+
+DOCTOR_TIMELINE_EVENTS = _register(
+    "GEOMESA_TPU_DOCTOR_TIMELINE_EVENTS", 8, int,
+    "Correlated flight events snapshotted into each incident timeline "
+    "(matched with the flight recorder's shared predicate, newest "
+    "first).")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

@@ -1,0 +1,458 @@
+"""Fleet doctor: rule-driven anomaly detectors over the telemetry planes.
+
+PRs 5-10 made the fleet visible — stitched traces, federated metrics,
+SLO burn rates, recompile profiling, replication telemetry, the workload
+hot-set feed — but nothing INTERPRETED any of it. The doctor runs a
+fixed rule set over the local registry (and, when a Federator is
+configured, the fleet-merged state) on the same injectable clock as
+``obs/slo.py``, turning raw counters into attributed incidents:
+
+  slo_burn          multi-window burn-rate page/ticket decisions, reusing
+                    the unmodified SloEngine policies (local + fleet; the
+                    fleet evaluation suppresses pages computed from a
+                    partial merge — see Federator.slo)
+  replication_lag   decay-based ``replication.lag_ms`` gauge over its
+                    threshold OR a sequence backlog (``lag_seqs``) — the
+                    stalled/dead-follower signal
+  recompile_churn   ``kernels.recompiles`` advancing faster than the
+                    per-minute bar inside the window; the suspect kernel
+                    is named from the recompile flight events, with the
+                    perfwatch baseline compile counts as context
+  shed_storm        ``admission.shed`` rate over the bar; the dominant
+                    shed priority class is the suspect
+  breaker_flapping  open/close transition EDGES on one breaker inside
+                    the window (state thrash, not steady open)
+  wal_fsync_stall   new ``wal.fsync_errors``/retries — durability faults
+                    page immediately by default
+  hot_skew          one plan/cell/tenant whose GUARANTEED (at_least)
+                    share of the workload window exceeds the bar
+
+Every firing opens (or dedups into) an incident via ``obs/incidents.py``
+with a correlated timeline snapshot; detectors that stay clear close
+their incident with a resolution record. Evaluation happens ONLY on
+read/tick surfaces (``/alerts``, ``/incidents``, the CLI) — the query
+hot path never pays for the doctor (the <5% obs-overhead guard holds
+with it enabled at defaults).
+
+Import discipline (obs/__init__ rule): config/metrics/trace/obs.* only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
+from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.obs.incidents import IncidentStore
+
+# rule -> (severity default, one-line description — the CLI/docs table)
+RULES: Dict[str, Tuple[str, str]] = {
+    "slo_burn": ("page", "multi-window SLO burn over page/ticket policy"),
+    "replication_lag": ("page", "follower lag_ms/seq backlog over bar"),
+    "recompile_churn": ("ticket", "kernels.recompiles rate over bar"),
+    "shed_storm": ("page", "admission.shed rate over bar"),
+    "breaker_flapping": ("ticket", "breaker open/close edges in window"),
+    "wal_fsync_stall": ("page", "new WAL fsync errors/retries"),
+    "hot_skew": ("ticket", "single plan/cell/tenant dominates window"),
+}
+
+
+class DoctorEngine:
+    """The rule evaluator. All collaborators are injectable (registry,
+    clock, SLO engine, federator, workload plane, incident store) so
+    tests drive it deterministically; the process-global ``DOCTOR``
+    late-binds every one of them to the process globals."""
+
+    def __init__(self, registry=None, clock=time.monotonic,
+                 slo_engine=None, store: Optional[IncidentStore] = None,
+                 journal_path: Optional[str] = None,
+                 federator=None, workload=None):
+        self._reg = registry if registry is not None else _metrics
+        self._clock = clock
+        self._slo = slo_engine          # None -> late-bind slo.ENGINE
+        self._federator = federator     # None -> late-bind federation
+        self._workload = workload       # None -> late-bind WORKLOAD
+        self.store = store if store is not None else IncidentStore(
+            journal_path=journal_path, registry=self._reg,
+            node=_trace.node_id())
+        self._lock = threading.RLock()
+        # per-counter (ts, value) samples for the windowed rate detectors
+        self._rates: Dict[str, deque] = {}
+
+    # -- late-bound collaborators ---------------------------------------------
+
+    def _slo_engine(self):
+        if self._slo is not None:
+            return self._slo
+        from geomesa_tpu.obs import slo as _slo
+        return _slo.ENGINE
+
+    def _fed(self):
+        if self._federator is False:    # fleet checks explicitly disabled
+            return None
+        if self._federator is not None:
+            return self._federator
+        from geomesa_tpu.obs import federation as _fed
+        return _fed.federator()
+
+    def _wl(self):
+        if self._workload is not None:
+            return self._workload
+        from geomesa_tpu.obs import workload as _wl
+        return _wl.WORKLOAD
+
+    # -- windowed counter deltas ----------------------------------------------
+
+    def _delta(self, key: str, value: float, now: float,
+               window_s: float) -> Tuple[float, float]:
+        """(per-minute rate, absolute delta) of a counter over the
+        trailing window. The first sighting of a counter contributes no
+        delta, so a fresh doctor never fires on preexisting totals."""
+        samples = self._rates.setdefault(key, deque())
+        samples.append((now, float(value)))
+        while samples and now - samples[0][0] > window_s:
+            samples.popleft()
+        if len(samples) < 2:
+            return 0.0, 0.0
+        dt = samples[-1][0] - samples[0][0]
+        dv = samples[-1][1] - samples[0][1]
+        if dt <= 0.0:
+            return 0.0, dv
+        return dv * 60.0 / dt, dv
+
+    # -- detectors (each returns a list of alert dicts) -----------------------
+
+    def _check_slo(self, now: float) -> List[dict]:
+        alerts = []
+        engine = self._slo_engine()
+        scopes = [("local", engine.evaluate() if engine else {})]
+        fed = self._fed()
+        if fed is not None:
+            try:
+                scopes.append(("fleet", fed.slo()))
+            except Exception:
+                self._reg.inc("doctor.detector_errors")
+        for scope, res in scopes:
+            for name, obj in sorted((res or {}).items()):
+                if not isinstance(obj, dict):
+                    continue
+                status = obj.get("status")
+                if status not in ("page", "ticket"):
+                    continue
+                detail = {"scope": scope,
+                          "burn_rates": obj.get("burn_rates"),
+                          "compliance": obj.get("compliance"),
+                          "error_budget": obj.get("error_budget")}
+                if obj.get("page_suppressed"):
+                    detail["page_suppressed"] = True
+                alerts.append({
+                    "rule": "slo_burn", "severity": status,
+                    "cause": f"{scope}-slo:{name}",
+                    "detail": detail,
+                    "suspect": {"objective": name, "scope": scope},
+                    "match": {"slow_ms": config.SLO_LATENCY_MS.get()},
+                })
+        return alerts
+
+    def _check_replication(self, now: float, gauges: dict) -> List[dict]:
+        try:
+            lag_ms = float(gauges.get("replication.lag_ms") or 0.0)
+            lag_seqs = int(gauges.get("replication.lag_seqs") or 0)
+        except (TypeError, ValueError):
+            return []
+        bar_ms = float(config.DOCTOR_LAG_MS.get())
+        bar_seqs = int(config.DOCTOR_LAG_SEQS.get())
+        over_ms = bar_ms > 0 and lag_ms > bar_ms
+        over_seqs = bar_seqs > 0 and lag_seqs >= bar_seqs
+        if not (over_ms or over_seqs):
+            return []
+        why = "lag_ms" if over_ms else "lag_seqs"
+        return [{
+            "rule": "replication_lag", "severity": "page",
+            "cause": f"replication:{why}",
+            "detail": {"lag_ms": round(lag_ms, 1), "lag_seqs": lag_seqs,
+                       "bar_ms": bar_ms, "bar_seqs": bar_seqs},
+            "suspect": {"role": _trace.node_role(), "signal": why},
+            "match": {"kind": "repl.apply"},
+        }]
+
+    def _check_recompiles(self, now: float, counters: dict) -> List[dict]:
+        v = counters.get("kernels.recompiles", 0)
+        window = float(config.DOCTOR_WINDOW_S.get())
+        rate, delta = self._delta("kernels.recompiles", v, now, window)
+        bar = float(config.DOCTOR_RECOMPILES_PER_MIN.get())
+        if bar <= 0 or delta <= 0 or rate < bar:
+            return []
+        suspect: dict = {}
+        try:
+            from geomesa_tpu.obs.flight import RECORDER
+            kernels: Dict[str, int] = {}
+            for e in RECORDER.recent(limit=64, kind="kernel.recompile"):
+                k = str(e.get("kernel") or e.get("name") or "?")
+                kernels[k] = kernels.get(k, 0) + 1
+            if kernels:
+                top = max(kernels.items(), key=lambda kv: kv[1])
+                suspect = {"kernel": top[0], "recent_recompiles": top[1]}
+        except Exception:
+            pass
+        baseline = None
+        try:
+            import os
+            from geomesa_tpu.obs import perfwatch as _pw
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "perf", "baselines.json")
+            kb = _pw.load_baselines(path).get("kernels") or {}
+            baseline = sum(int((m or {}).get("compiles", 0))
+                           for m in kb.values()) or None
+        except Exception:
+            pass
+        return [{
+            "rule": "recompile_churn", "severity": "ticket",
+            "cause": "kernels:recompiles",
+            "detail": {"rate_per_min": round(rate, 2), "delta": delta,
+                       "bar_per_min": bar, "total": int(v),
+                       "baseline_compiles": baseline},
+            "suspect": suspect,
+            "match": {"kind": "kernel.recompile"},
+        }]
+
+    def _check_shed(self, now: float, counters: dict) -> List[dict]:
+        window = float(config.DOCTOR_WINDOW_S.get())
+        rate, delta = self._delta("admission.shed",
+                                  counters.get("admission.shed", 0),
+                                  now, window)
+        # per-class deltas ride along so the dominant class is nameable
+        classes = {}
+        for k, v in counters.items():
+            if k.startswith("admission.shed."):
+                _r, d = self._delta(k, v, now, window)
+                if d > 0:
+                    classes[k[len("admission.shed."):]] = d
+        bar = float(config.DOCTOR_SHED_PER_MIN.get())
+        if bar <= 0 or delta <= 0 or rate < bar:
+            return []
+        suspect = {}
+        if classes:
+            top = max(classes.items(), key=lambda kv: kv[1])
+            suspect = {"priority": top[0], "shed_in_window": int(top[1])}
+        return [{
+            "rule": "shed_storm", "severity": "page",
+            "cause": "admission:shed",
+            "detail": {"rate_per_min": round(rate, 2), "delta": delta,
+                       "bar_per_min": bar,
+                       "by_class": {k: int(v) for k, v in classes.items()}},
+            "suspect": suspect,
+            "match": {"errors": True},
+        }]
+
+    def _check_breakers(self, now: float, counters: dict) -> List[dict]:
+        window = float(config.DOCTOR_WINDOW_S.get())
+        bar = int(config.DOCTOR_BREAKER_FLAPS.get())
+        edges: Dict[str, float] = {}
+        for k, v in counters.items():
+            if not k.startswith("breaker."):
+                continue
+            if k.endswith(".opened") or k.endswith(".closed"):
+                name = k[len("breaker."):k.rfind(".")]
+                _r, d = self._delta(k, v, now, window)
+                edges[name] = edges.get(name, 0.0) + max(0.0, d)
+        alerts = []
+        for name, flaps in sorted(edges.items()):
+            if bar <= 0 or flaps < bar:
+                continue
+            alerts.append({
+                "rule": "breaker_flapping", "severity": "ticket",
+                "cause": f"breaker:{name}",
+                "detail": {"edges_in_window": int(flaps), "bar": bar,
+                           "window_s": window},
+                "suspect": {"breaker": name},
+                "match": {"errors": True},
+            })
+        return alerts
+
+    def _check_wal(self, now: float, counters: dict) -> List[dict]:
+        window = float(config.DOCTOR_WINDOW_S.get())
+        bar = int(config.DOCTOR_FSYNC_ERRORS.get())
+        _r, errs = self._delta("wal.fsync_errors",
+                               counters.get("wal.fsync_errors", 0),
+                               now, window)
+        _r, retries = self._delta("wal.fsync_retries",
+                                  counters.get("wal.fsync_retries", 0),
+                                  now, window)
+        faults = errs + retries
+        if bar <= 0 or faults < bar:
+            return []
+        return [{
+            "rule": "wal_fsync_stall", "severity": "page",
+            "cause": "wal:fsync",
+            "detail": {"errors_in_window": int(errs),
+                       "retries_in_window": int(retries), "bar": bar},
+            "suspect": {"path": "wal"},
+            "match": {"errors": True},
+        }]
+
+    def _check_skew(self, now: float) -> List[dict]:
+        try:
+            wl = self._wl()
+            hs = wl.hot_set()
+            tenants = wl.top_tenants()
+        except Exception:
+            return []
+        total = int(hs.get("total") or 0)
+        if total < int(config.DOCTOR_SKEW_MIN.get()):
+            return []
+        bar = float(config.DOCTOR_SKEW_FRACTION.get())
+        if bar <= 0:
+            return []
+        alerts = []
+        dims = [("plan", hs.get("plans") or []),
+                ("cell", hs.get("cells") or []),
+                ("tenant", tenants or [])]
+        for dim, entries in dims:
+            if not entries:
+                continue
+            e = entries[0]
+            key = e.get("key", e.get("tenant"))
+            at_least = e.get("at_least")
+            if at_least is None:
+                at_least = max(0, int(e.get("count", 0))
+                               - int(e.get("error", 0)))
+            share = float(at_least) / float(total)
+            if share < bar:
+                continue
+            suspect = {dim: key, "share_at_least": round(share, 3)}
+            if "bbox" in e:
+                suspect["bbox"] = e["bbox"]
+            alerts.append({
+                "rule": "hot_skew", "severity": "ticket",
+                "cause": f"skew:{dim}:{key}",
+                "detail": {"dimension": dim, "at_least": int(at_least),
+                           "window_total": total, "bar_fraction": bar},
+                "suspect": suspect,
+                "match": {},
+            })
+        return alerts
+
+    # -- the correlated timeline ----------------------------------------------
+
+    def _timeline(self, alert: dict, counters: dict) -> dict:
+        cap = max(0, int(config.DOCTOR_TIMELINE_EVENTS.get()))
+        match = dict(alert.get("match") or {})
+        events: List[dict] = []
+        gids: List[str] = []
+        try:
+            from geomesa_tpu.obs.flight import RECORDER
+            events = RECORDER.recent(limit=cap, **match) if cap else []
+        except Exception:
+            pass
+        try:
+            from geomesa_tpu.obs.sampling import SAMPLER
+            for t in SAMPLER.recent(cap):
+                g = t.get("global_id")
+                if g and g not in gids:
+                    gids.append(str(g))
+        except Exception:
+            pass
+        demotions = {k: int(v) for k, v in counters.items()
+                     if k.startswith("router.demotions")}
+        drills = {k: int(v) for k, v in counters.items()
+                  if k.startswith("drill.")}
+        return {"events": events, "trace_gids": gids,
+                "router_demotions": demotions, "drills": drills}
+
+    # -- evaluation -----------------------------------------------------------
+
+    def evaluate(self, tick: bool = True) -> dict:
+        """Run every detector, reconcile with the incident store, and
+        return ``{alerts, incidents}``. Read/tick surfaces only — never
+        called from the query hot path."""
+        if not config.DOCTOR_ENABLED.get():
+            return {"enabled": False, "alerts": [],
+                    "incidents": self.store.active()}
+        with self._lock:
+            now = self._clock()
+            snap = self._reg.snapshot()
+            counters = snap.get("counters") or {}
+            gauges = snap.get("gauges") or {}
+            alerts: List[dict] = []
+            for check in (lambda: self._check_slo(now),
+                          lambda: self._check_replication(now, gauges),
+                          lambda: self._check_recompiles(now, counters),
+                          lambda: self._check_shed(now, counters),
+                          lambda: self._check_breakers(now, counters),
+                          lambda: self._check_wal(now, counters),
+                          lambda: self._check_skew(now)):
+                try:
+                    alerts.extend(check())
+                except Exception:
+                    # one broken detector must not take down the surface
+                    self._reg.inc("doctor.detector_errors")
+            self._reg.inc("doctor.evaluations")
+            firing = set()
+            for a in alerts:
+                self._reg.inc(f"doctor.alerts.{a['rule']}")
+                key = (a["rule"], str(a.get("cause", "")))
+                firing.add(key)
+                timeline = None
+                if key not in {(i["rule"], i["cause"])
+                               for i in self.store.active()}:
+                    timeline = self._timeline(a, counters)
+                self.store.open_or_update(a, timeline, now)
+            resolved = []
+            if tick:
+                resolved = self.store.sweep(
+                    firing, now, int(config.DOCTOR_CLEAR_TICKS.get()))
+            return {"alerts": alerts,
+                    "incidents": self.store.active(),
+                    "resolved": [i["id"] for i in resolved]}
+
+    def alerts(self) -> dict:
+        """The ``GET /alerts`` payload: current firings + active
+        incident ids (evaluates, so reading IS detecting)."""
+        res = self.evaluate()
+        return {"alerts": res.get("alerts", []),
+                "active_incidents": [i["id"] for i in
+                                     res.get("incidents", [])],
+                "enabled": bool(config.DOCTOR_ENABLED.get())}
+
+    def incidents(self, active_only: bool = False) -> dict:
+        """The ``GET /incidents`` payload (evaluates first so the answer
+        reflects the present, then includes the resolved tail)."""
+        self.evaluate()
+        return {"incidents": self.store.all(active_only=active_only),
+                "stats": self.store.stats()}
+
+    def reset(self) -> None:
+        """Forget rate-detector history and all incidents (tests)."""
+        with self._lock:
+            self._rates.clear()
+            self.store.clear()
+
+
+def verdict(inc: dict) -> str:
+    """One human line per incident: what fired, since when, suspected
+    cause, linked trace — the CLI ``doctor`` output contract."""
+    age_s = None
+    if inc.get("opened_ms"):
+        age_s = max(0.0, time.time() - inc["opened_ms"] / 1000.0)
+    since = f"{age_s:.0f}s ago" if age_s is not None else "unknown"
+    suspect = inc.get("suspect") or {}
+    cause = ", ".join(f"{k}={v}" for k, v in sorted(suspect.items())) \
+        or inc.get("cause", "?")
+    tl = inc.get("timeline") or {}
+    gids = tl.get("trace_gids") or []
+    link = f" trace={gids[0]}" if gids else ""
+    status = inc.get("status", "open")
+    return (f"[{inc.get('severity', '?').upper()}] {inc.get('rule')}"
+            f" ({status}) since {since} x{inc.get('count', 1)}"
+            f" — suspected: {cause}{link}")
+
+
+# -- process-global doctor (the /alerts /incidents surfaces' backing) ---------
+
+DOCTOR = DoctorEngine()

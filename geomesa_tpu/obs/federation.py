@@ -164,6 +164,10 @@ class Federator:
         except Exception as e:
             s.error = str(e)
             _metrics.inc("federation.scrape_errors")
+            # per-node attribution: a flaky node is visible BY NAME, and
+            # merged surfaces can mark themselves partial instead of
+            # silently presenting N-1 nodes as the fleet
+            _metrics.inc(f"fed.scrape_errors.{name}")
         return s
 
     def refresh(self, force: bool = False) -> Dict[str, NodeScrape]:
@@ -184,6 +188,13 @@ class Federator:
 
     def _states(self) -> List[NodeScrape]:
         return [s for s in self.refresh().values() if s.ok and s.state]
+
+    def missing_nodes(self) -> List[str]:
+        """Names of nodes whose latest scrape failed or timed out — the
+        merge over the remaining nodes is PARTIAL, and every merged
+        surface says so instead of silently omitting them."""
+        return sorted(name for name, s in self.refresh().items()
+                      if not (s.ok and s.state))
 
     # -- exact merge ----------------------------------------------------------
 
@@ -242,15 +253,33 @@ class Federator:
 
     def snapshot(self) -> dict:
         """Registry-shaped view of the merged fleet (counters merged by
-        summation; the availability-SLO feed)."""
-        return {"counters": self.merged_counters()}
+        summation; the availability-SLO feed). Extra keys ride along
+        (the SLO engine reads only ``counters``)."""
+        missing = self.missing_nodes()
+        return {"counters": self.merged_counters(),
+                "partial": bool(missing), "missing": missing}
 
     # -- surfaces -------------------------------------------------------------
 
     def slo(self) -> dict:
         """Fleet-level burn rates over MERGED good/total samples — 'count
-        latency' judged across the fleet, not per node."""
-        return self.engine.evaluate()
+        latency' judged across the fleet, not per node. When the merge is
+        partial (a node's scrape failed), burn-PAGE decisions are
+        suppressed: a fleet missing a node looks healthier than it is,
+        and paging off that view would both mask the real problem and
+        train operators to distrust pages. Tickets still stand; each
+        suppressed objective says so."""
+        res = self.engine.evaluate()
+        missing = self.missing_nodes()
+        if missing:
+            for obj in res.values():
+                if not isinstance(obj, dict):
+                    continue
+                if obj.get("page"):
+                    obj["page"] = False
+                    obj["page_suppressed"] = True
+                    obj["status"] = "ticket" if obj.get("ticket") else "ok"
+        return res
 
     def fleet(self) -> dict:
         """The single pane of glass: per-node health, role, replication
@@ -286,8 +315,10 @@ class Federator:
                                  .get("draining")),
                 "slo": (hz.get("slo") or {}).get("status"),
             }
+        missing = self.missing_nodes()
         return {"nodes": nodes,
                 "slo": self.slo(),
+                "partial": bool(missing), "missing": missing,
                 "repl_e2e_ms": self._repl_e2e_summary()}
 
     def fleet_workload(self) -> dict:
@@ -310,10 +341,46 @@ class Federator:
                            "dropped": int(wst.get("dropped", 0))}
         merged = _workload.WorkloadAnalytics.from_state(
             _workload.merge_states(states))
+        missing = self.missing_nodes()
         return {"nodes": nodes,
+                "partial": bool(missing), "missing": missing,
                 "hot_set": merged.hot_set(),
                 "tenants": merged.top_tenants(),
                 "rollups": merged.rollups()}
+
+    def fleet_incidents(self) -> dict:
+        """Every node's doctor incidents under one pane with node
+        attribution — the ``GET /fleet/incidents`` payload. The local
+        process (target None) reads its DOCTOR directly; remote nodes
+        serve ``GET /incidents``. Unreachable nodes mark the answer
+        partial rather than vanishing."""
+        nodes: Dict[str, dict] = {}
+        incidents: List[dict] = []
+        missing: List[str] = []
+        for name, target in sorted(self.nodes.items()):
+            try:
+                if target is None:
+                    from geomesa_tpu.obs.doctor import DOCTOR
+                    body = DOCTOR.incidents()
+                else:
+                    body = self._fetch_json(target, "/incidents")
+            except Exception as e:
+                nodes[name] = {"ok": False, "error": str(e)}
+                missing.append(name)
+                _metrics.inc(f"fed.scrape_errors.{name}")
+                continue
+            node_incidents = body.get("incidents") or []
+            nodes[name] = {"ok": True,
+                           "active": sum(1 for i in node_incidents
+                                         if i.get("status") == "open"),
+                           "total": len(node_incidents)}
+            for inc in node_incidents:
+                inc = dict(inc)
+                inc["fleet_node"] = name
+                incidents.append(inc)
+        incidents.sort(key=lambda i: i.get("opened_ms", 0))
+        return {"nodes": nodes, "incidents": incidents,
+                "partial": bool(missing), "missing": sorted(missing)}
 
     def _repl_e2e_summary(self) -> Optional[dict]:
         merged = self._merged_hists("timers")
@@ -334,6 +401,14 @@ class Federator:
         ids). One # TYPE line per family across all nodes."""
         scrapes = [s for s in self.refresh().values() if s.ok and s.state]
         lines: List[str] = []
+        # partiality is a first-class sample: scrapers see WHICH nodes
+        # the merge below is missing, not just that some scrape failed
+        missing = self.missing_nodes()
+        lines.append("# TYPE geomesa_tpu_fed_scrape_missing gauge")
+        lines.append(f"geomesa_tpu_fed_scrape_missing {len(missing)}")
+        for name in missing:
+            lines.append('geomesa_tpu_fed_scrape_missing'
+                         f'{{node="{_label(name)}"}} 1')
         # counters: one family, one labeled sample per node
         families: Dict[str, List[tuple]] = {}
         for s in scrapes:

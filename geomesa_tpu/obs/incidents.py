@@ -1,0 +1,221 @@
+"""Incident objects + the size-rotated incident journal.
+
+An incident is the doctor's unit of attribution: one detector firing,
+deduplicated while it stays active, carrying a correlated TIMELINE
+snapshot (matching flight events, retained trace gids, router demotions,
+drill counters, the suspect kernel/plan/tenant) captured at open time —
+the evidence an operator needs without re-querying five surfaces after
+the fact. When the detector clears for enough consecutive evaluations,
+the incident closes with a resolution record.
+
+Every open/close appends a JSONL record to the incident journal,
+size-rotated through the SAME durability helper the flight recorder's
+wide-event sink uses (``durability/rotation.py``) — a failing journal
+never fails an evaluation (dropwizard rule).
+
+Import discipline (obs/__init__ rule): config/metrics only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+_CLOSED_KEEP = 64  # resolved incidents kept queryable in memory
+
+
+def _public(inc: dict) -> dict:
+    """An incident dict minus the store's private bookkeeping keys."""
+    return {k: v for k, v in inc.items() if not k.startswith("_")}
+
+
+class IncidentStore:
+    """Active-incident dedup + resolution + the rotated JSONL journal."""
+
+    def __init__(self, journal_path: Optional[str] = None,
+                 max_bytes: Optional[int] = None,
+                 registry=None, node: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._active: Dict[Tuple[str, str], dict] = {}
+        self._closed: deque = deque(maxlen=_CLOSED_KEEP)
+        self._seq = 0
+        self._journal_path = journal_path
+        self._max_bytes = max_bytes
+        self._fh = None
+        self._fh_path: Optional[str] = None
+        self._fh_bytes = 0
+        self._reg = registry if registry is not None else _metrics
+        self._node = node
+        self._reg.set_gauge("incident.active", lambda: len(self._active))
+
+    # -- journal (same shape as FlightRecorder's rotated sink) ----------------
+
+    def _path(self) -> Optional[str]:
+        if self._journal_path is not None:
+            return self._journal_path or None
+        return config.DOCTOR_JOURNAL.get() or None
+
+    def _journal_locked(self, record: dict) -> None:
+        path = self._path()
+        if path is None:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            return
+        try:
+            if self._fh is None or self._fh_path != path:
+                if self._fh is not None:
+                    self._fh.close()
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._fh = open(path, "ab")
+                self._fh_path = path
+                self._fh_bytes = self._fh.tell()
+            line = (json.dumps(record, default=str) + "\n").encode()
+            self._fh.write(line)
+            self._fh.flush()
+            self._fh_bytes += len(line)
+            cap = int(self._max_bytes if self._max_bytes is not None
+                      else config.DOCTOR_JOURNAL_MAX_BYTES.get())
+            if cap > 0 and self._fh_bytes >= cap:
+                from geomesa_tpu.durability.rotation import rotate
+                self._fh.close()
+                self._fh = None
+                rotate(path, keep=1,
+                       on_drop=lambda p: self._reg.inc(
+                           "incident.journal_dropped"))
+        except OSError:
+            # a failing journal must never fail an evaluation
+            self._reg.inc("incident.journal_errors")
+            self._fh = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def open_or_update(self, alert: dict, timeline: Optional[dict],
+                       now: float) -> dict:
+        """Open a new incident for this (rule, cause), or bump the active
+        one (dedup) — either way the clear streak resets."""
+        key = (str(alert["rule"]), str(alert.get("cause", "")))
+        with self._lock:
+            inc = self._active.get(key)
+            if inc is not None:
+                inc["count"] += 1
+                inc["last_seen_ts"] = now
+                inc["severity"] = alert.get("severity", inc["severity"])
+                if alert.get("detail"):
+                    inc["detail"] = alert["detail"]
+                inc["_clear"] = 0
+                self._reg.inc("incident.deduped")
+                return inc
+            self._seq += 1
+            inc = {
+                "id": f"inc-{self._seq}",
+                "rule": key[0],
+                "cause": key[1],
+                "severity": alert.get("severity", "ticket"),
+                "node": self._node,
+                "status": "open",
+                "opened_ts": now,
+                "last_seen_ts": now,
+                "opened_ms": int(time.time() * 1000),
+                "count": 1,
+                "detail": alert.get("detail") or {},
+                "suspect": alert.get("suspect") or {},
+                "timeline": timeline or {},
+                "_clear": 0,
+            }
+            self._active[key] = inc
+            self._reg.inc("incident.opened")
+            self._journal_locked({"kind": "incident.open", **_public(inc)})
+            return inc
+
+    def sweep(self, firing: set, now: float, clear_ticks: int) -> List[dict]:
+        """Advance the clear streak of every active incident NOT in
+        ``firing``; close the ones that stayed clear long enough.
+        Returns the incidents resolved this sweep."""
+        resolved = []
+        with self._lock:
+            for key in list(self._active):
+                inc = self._active[key]
+                if key in firing:
+                    continue
+                inc["_clear"] += 1
+                if inc["_clear"] < max(1, int(clear_ticks)):
+                    continue
+                del self._active[key]
+                inc["status"] = "resolved"
+                inc["resolved_ts"] = now
+                inc["resolved_ms"] = int(time.time() * 1000)
+                inc["resolution"] = {
+                    "cleared_after_s": round(now - inc["opened_ts"], 3),
+                    "clear_ticks": inc.pop("_clear"),
+                    "firings": inc["count"],
+                }
+                self._closed.append(inc)
+                resolved.append(inc)
+                self._reg.inc("incident.resolved")
+                self._journal_locked(
+                    {"kind": "incident.close", **_public(inc)})
+        return resolved
+
+    # -- read surfaces --------------------------------------------------------
+
+    def active(self) -> List[dict]:
+        with self._lock:
+            return [_public(i) for i in
+                    sorted(self._active.values(),
+                           key=lambda i: i["opened_ts"])]
+
+    def all(self, active_only: bool = False) -> List[dict]:
+        """Active incidents plus the recently-resolved tail, oldest
+        first (the /incidents payload)."""
+        with self._lock:
+            out = [] if active_only else [_public(i) for i in self._closed]
+            out.extend(_public(i) for i in
+                       sorted(self._active.values(),
+                              key=lambda i: i["opened_ts"]))
+            return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"active": len(self._active),
+                    "resolved_kept": len(self._closed),
+                    "opened_total": self._seq,
+                    "journal": self._path()}
+
+    def clear(self) -> None:
+        """Drop all state (tests / soak halves)."""
+        with self._lock:
+            self._active.clear()
+            self._closed.clear()
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def replay_journal(path: str) -> List[dict]:
+    """Read the incident journal back, rotated predecessor first — the
+    replay surface for post-mortems and the rotation test."""
+    out: List[dict] = []
+    for p in (f"{path}.1", path):
+        try:
+            with open(p, "rb") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line.decode()))
+                    except (ValueError, UnicodeDecodeError):
+                        continue  # torn tail from rotation mid-write
+        except OSError:
+            continue
+    return out

@@ -522,6 +522,9 @@ class RouterApi:
                                              labeled counters/gauges,
                                              exactly-merged histograms)
       GET /fleet/slo                         fleet-level burn rates only
+      GET /fleet/incidents                   every node's doctor verdicts
+                                             with node attribution
+      GET /alerts, /incidents                this router's own doctor
       GET /traces?id=G                       the STITCHED cross-process
                                              tree for global trace id G
                                              (+ the collected halves)
@@ -598,6 +601,18 @@ class RouterApi:
             return 200, self.federator.to_prometheus(), {}
         if parts == ["fleet", "slo"]:
             return 200, {"slo": self.federator.slo()}, {}
+        if parts == ["fleet", "incidents"]:
+            return 200, self.federator.fleet_incidents(), {}
+        if parts == ["incidents"]:
+            # the router process's OWN doctor (it has breakers/demotions
+            # worth diagnosing too)
+            from geomesa_tpu.obs.doctor import DOCTOR
+            active = query.get("active", [None])[0] \
+                not in (None, "0", "false")
+            return 200, DOCTOR.incidents(active_only=active), {}
+        if parts == ["alerts"]:
+            from geomesa_tpu.obs.doctor import DOCTOR
+            return 200, DOCTOR.alerts(), {}
         if parts == ["traces"]:
             gid = query.get("id", [None])[0]
             if not gid:

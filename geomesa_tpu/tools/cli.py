@@ -357,9 +357,50 @@ def cmd_debug(args):
                              default=str))
     elif args.what == "slo":
         # burn-rate runbook surface: compliance + multi-window burn rates
-        # + page/ticket state per objective
-        from geomesa_tpu.obs.slo import ENGINE
-        print(json.dumps({"slo": ENGINE.evaluate()}, indent=2, default=str))
+        # + page/ticket state per objective — this process's engine, or a
+        # RUNNING node's GET /slo via --addr (fleet parity with
+        # `debug replication` / `debug workload`)
+        out = {}
+        if args.addr:
+            import urllib.request
+            for addr in args.addr:
+                base = addr if addr.startswith("http") else f"http://{addr}"
+                try:
+                    with urllib.request.urlopen(base + "/slo",
+                                                timeout=5) as r:
+                        node = json.loads(r.read().decode())
+                except OSError as e:
+                    node = {"error": str(e)}
+                if len(args.addr) == 1:
+                    out.update(node)
+                else:
+                    out.setdefault("nodes", {})[addr] = node
+        else:
+            from geomesa_tpu.obs.slo import ENGINE
+            out = {"slo": ENGINE.evaluate()}
+        print(json.dumps(out, indent=2, default=str))
+    elif args.what == "incidents":
+        # the doctor's incident ledger: active + recently-resolved, with
+        # correlated timelines — local, or a RUNNING node's /incidents
+        out = {}
+        if args.addr:
+            import urllib.request
+            for addr in args.addr:
+                base = addr if addr.startswith("http") else f"http://{addr}"
+                try:
+                    with urllib.request.urlopen(base + "/incidents",
+                                                timeout=5) as r:
+                        node = json.loads(r.read().decode())
+                except OSError as e:
+                    node = {"error": str(e)}
+                if len(args.addr) == 1:
+                    out.update(node)
+                else:
+                    out.setdefault("nodes", {})[addr] = node
+        else:
+            from geomesa_tpu.obs.doctor import DOCTOR
+            out = DOCTOR.incidents()
+        print(json.dumps(out, indent=2, default=str))
     elif args.what == "workload":
         # workload intelligence: windowed rollups, heavy-hitter plan
         # hashes/tenants, hot spatial cells — this process's plane, or a
@@ -561,6 +602,45 @@ def cmd_fleet(args):
         print(_render_fleet(fl))
 
 
+def cmd_doctor(args):
+    """The fleet doctor's verdicts: evaluate the anomaly detectors and
+    print ONE line per incident — what fired, since when, suspected
+    cause, linked trace. Local by default; with --addr it reads each
+    RUNNING node's GET /incidents (repeatable, node-attributed)."""
+    from geomesa_tpu.obs.doctor import verdict
+    nodes = {}
+    if args.addr:
+        import urllib.request
+        for addr in args.addr:
+            base = addr if addr.startswith("http") else f"http://{addr}"
+            try:
+                with urllib.request.urlopen(base + "/incidents",
+                                            timeout=5) as r:
+                    nodes[addr] = json.loads(r.read().decode())
+            except OSError as e:
+                nodes[addr] = {"error": str(e)}
+    else:
+        from geomesa_tpu.obs.doctor import DOCTOR
+        nodes["local"] = DOCTOR.incidents()
+    if args.json:
+        print(json.dumps(nodes if len(nodes) > 1
+                         else next(iter(nodes.values())),
+                         indent=2, default=str))
+        return
+    total = 0
+    for name, body in sorted(nodes.items()):
+        if body.get("error"):
+            print(f"{name}: UNREACHABLE ({body['error']})")
+            continue
+        incidents = body.get("incidents") or []
+        for inc in incidents:
+            prefix = f"{name}: " if len(nodes) > 1 else ""
+            print(prefix + verdict(inc))
+        total += len(incidents)
+    if total == 0:
+        print("doctor: no incidents — all detectors clear")
+
+
 def cmd_remove_schema(args):
     store = _load(args.store, must_exist=True)
     store.remove_schema(args.feature)
@@ -665,12 +745,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "debug", help="dump metrics, recent query traces, flight-recorder "
                       "events, SLO burn rates, per-kernel attribution, "
-                      "scheduler state, admission/overload state, or the "
-                      "WAL segment inspector")
+                      "scheduler state, admission/overload state, doctor "
+                      "incidents, or the WAL segment inspector")
     sp.add_argument("what", choices=("metrics", "traces", "trace", "events",
                                      "slo", "kernels", "scheduler",
                                      "admission", "wal", "replication",
-                                     "workload"))
+                                     "workload", "incidents"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "
@@ -747,6 +827,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8760)
     sp.set_defaults(fn=cmd_router)
+
+    sp = sub.add_parser(
+        "doctor",
+        help="fleet doctor verdicts: run the anomaly detectors and print "
+             "one line per incident (what fired, since when, suspected "
+             "cause, linked trace); --addr reads running nodes")
+    sp.add_argument("--addr", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="a RUNNING node's REST address (repeatable); "
+                         "without it the local process is diagnosed")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw incident JSON instead of verdicts")
+    sp.set_defaults(fn=cmd_doctor)
 
     sp = sub.add_parser(
         "fleet",

@@ -43,6 +43,10 @@ Routes:
                                          per query/count/batch), filtered
   GET  /slo                            → SLO burn-rate evaluation (5m/30m/
                                          1h/6h windows, page/ticket state)
+  GET  /alerts                         → fleet-doctor detector firings
+                                         (evaluated on read)
+  GET  /incidents?active=1             → doctor incidents with correlated
+                                         timelines + resolution records
   GET  /progress                       → live + recent long-running phases
                                          (index-build encode/upload/sort
                                          with row throughput)
@@ -240,6 +244,16 @@ class GeoJsonApi:
         if parts == ["slo"]:
             from geomesa_tpu.obs.slo import ENGINE
             return 200, {"slo": ENGINE.evaluate()}
+        if parts == ["alerts"]:
+            # the doctor's current firings — reading IS detecting (the
+            # evaluation runs here, never on the query hot path)
+            from geomesa_tpu.obs.doctor import DOCTOR
+            return 200, DOCTOR.alerts()
+        if parts == ["incidents"]:
+            from geomesa_tpu.obs.doctor import DOCTOR
+            active = query.get("active", [None])[0] \
+                not in (None, "0", "false")
+            return 200, DOCTOR.incidents(active_only=active)
         if parts == ["workload"]:
             # streaming workload analytics: windowed rollups, heavy-hitter
             # plan hashes / tenants, hot spatial cells (query LOAD, not data)
@@ -277,6 +291,9 @@ class GeoJsonApi:
                 # fleet-wide workload intelligence: per-node window states
                 # and sketches merged into one hot-set / rollup view
                 return 200, fed.fleet_workload()
+            if parts == ["fleet", "incidents"]:
+                # every node's doctor verdicts with node attribution
+                return 200, fed.fleet_incidents()
             return 404, {"error": f"no route {method} {path}"}
         if parts == ["healthz"]:
             import jax

@@ -1,0 +1,505 @@
+"""Fleet doctor: detectors, incident lifecycle, journal, federation
+robustness, CLI verdicts, and the precision soak (ISSUE 11).
+
+Every detector test drives a DoctorEngine over a STANDALONE registry on
+a fake clock with every collaborator injected — no process globals, no
+wall time. The soak tests (slow-marked; the CI ``doctor`` job runs
+them) prove end-to-end precision: four injected faults → four
+correctly-attributed incidents, and an identical no-fault run → zero.
+"""
+
+import json
+import re
+
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.obs.doctor import RULES, DoctorEngine, verdict
+from geomesa_tpu.obs.incidents import IncidentStore, replay_journal
+from geomesa_tpu.obs.slo import Objective, SloEngine
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _NoWorkload:
+    """A silent workload plane: the skew detector sees no traffic."""
+
+    def hot_set(self, k=None):
+        return {"total": 0, "plans": [], "cells": []}
+
+    def top_tenants(self, k=10):
+        return []
+
+
+def _mk_doctor(reg, clock, slo_engine=None, workload=None, store=None):
+    eng = slo_engine if slo_engine is not None \
+        else SloEngine(registry=reg, clock=clock)
+    return DoctorEngine(
+        registry=reg, clock=clock, slo_engine=eng, federator=False,
+        workload=workload or _NoWorkload(),
+        store=store or IncidentStore(journal_path="", registry=reg))
+
+
+_KNOBS = (config.DOCTOR_ENABLED, config.DOCTOR_WINDOW_S,
+          config.DOCTOR_LAG_MS, config.DOCTOR_LAG_SEQS,
+          config.DOCTOR_RECOMPILES_PER_MIN, config.DOCTOR_SHED_PER_MIN,
+          config.DOCTOR_BREAKER_FLAPS, config.DOCTOR_FSYNC_ERRORS,
+          config.DOCTOR_SKEW_FRACTION, config.DOCTOR_SKEW_MIN,
+          config.DOCTOR_CLEAR_TICKS, config.DOCTOR_TIMELINE_EVENTS)
+
+
+@pytest.fixture(autouse=True)
+def _restore_doctor_knobs():
+    saved = [(p, p._override) for p in _KNOBS]
+    yield
+    for p, old in saved:
+        if old is None:
+            p.unset()
+        else:
+            p.set(old)
+
+
+# -- detectors ----------------------------------------------------------------
+
+
+def test_replication_lag_fires_resolves_with_resolution_record():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    reg.set_gauge("replication.lag_ms", 2500.0)  # default bar 1000ms
+    out = doc.evaluate()
+    assert [a["rule"] for a in out["alerts"]] == ["replication_lag"]
+    assert out["alerts"][0]["cause"] == "replication:lag_ms"
+    assert out["alerts"][0]["severity"] == "page"
+    assert len(out["incidents"]) == 1
+    inc = out["incidents"][0]
+    assert inc["status"] == "open" and inc["rule"] == "replication_lag"
+    # lag drops: the clear streak (default 2 ticks) closes the incident
+    reg.set_gauge("replication.lag_ms", 0.0)
+    clock.advance(1)
+    assert doc.evaluate()["resolved"] == []          # streak 1 of 2
+    clock.advance(1)
+    out = doc.evaluate()
+    assert out["resolved"] == [inc["id"]]
+    assert out["incidents"] == []
+    done = doc.store.all()[-1]
+    assert done["status"] == "resolved"
+    assert done["resolution"]["firings"] == 1
+    assert done["resolution"]["clear_ticks"] == 2
+    assert done["resolution"]["cleared_after_s"] == pytest.approx(2.0)
+
+
+def test_replication_seq_backlog_is_its_own_cause():
+    reg = MetricsRegistry()
+    doc = _mk_doctor(reg, FakeClock())
+    reg.set_gauge("replication.lag_ms", 0.0)
+    reg.set_gauge("replication.lag_seqs", 64)   # default bar 64
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["cause"] == "replication:lag_seqs"
+
+
+def test_recompile_churn_ignores_preexisting_totals():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    config.DOCTOR_WINDOW_S.set(60.0)
+    reg.inc("kernels.recompiles", 100)      # history from before the doctor
+    assert doc.evaluate()["alerts"] == []   # first sighting never fires
+    clock.advance(10)
+    reg.inc("kernels.recompiles", 10)       # 10 in 10s = 60/min > bar 6
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "recompile_churn"
+    assert alert["detail"]["delta"] == 10
+    assert alert["detail"]["total"] == 110
+    assert alert["detail"]["rate_per_min"] == pytest.approx(60.0)
+
+
+def test_shed_storm_names_dominant_priority_class():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    config.DOCTOR_WINDOW_S.set(60.0)
+    for k in ("admission.shed", "admission.shed.interactive",
+              "admission.shed.batch"):
+        reg.inc(k, 0)
+    doc.evaluate()                          # baseline sample
+    clock.advance(10)
+    reg.inc("admission.shed", 20)           # 120/min > default bar 30
+    reg.inc("admission.shed.interactive", 15)
+    reg.inc("admission.shed.batch", 5)
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "shed_storm" and alert["severity"] == "page"
+    assert alert["suspect"] == {"priority": "interactive",
+                                "shed_in_window": 15}
+    assert alert["detail"]["by_class"] == {"interactive": 15, "batch": 5}
+
+
+def test_breaker_flapping_counts_transition_edges():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    reg.inc("breaker.device.opened", 0)
+    reg.inc("breaker.device.closed", 0)
+    doc.evaluate()
+    clock.advance(5)
+    reg.inc("breaker.device.opened", 2)     # 2 opens + 1 close = 3 edges
+    reg.inc("breaker.device.closed", 1)
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "breaker_flapping"
+    assert alert["cause"] == "breaker:device"
+    assert alert["detail"]["edges_in_window"] == 3
+
+
+def test_wal_fsync_stall_pages_on_first_new_error():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    reg.inc("wal.fsync_errors", 0)
+    reg.inc("wal.fsync_retries", 0)
+    doc.evaluate()
+    clock.advance(1)
+    reg.inc("wal.fsync_errors", 1)
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "wal_fsync_stall"
+    assert alert["severity"] == "page" and alert["cause"] == "wal:fsync"
+
+
+class _SkewedWorkload:
+    def hot_set(self, k=None):
+        return {"total": 1000,
+                "plans": [{"key": "p1", "count": 900, "error": 50,
+                           "at_least": 850}],
+                "cells": [{"key": 42, "count": 700, "error": 20,
+                           "at_least": 680, "bbox": [0, 0, 1, 1]}]}
+
+    def top_tenants(self, k=10):
+        return [{"tenant": "t9", "count": 100, "error": 0}]
+
+
+def test_hot_skew_fires_per_dominant_dimension_with_bbox():
+    reg = MetricsRegistry()
+    doc = _mk_doctor(reg, FakeClock(), workload=_SkewedWorkload())
+    alerts = doc.evaluate()["alerts"]
+    # plan 85% and cell 68% are over the 0.6 bar; tenant t9 at 10% is not
+    causes = {a["cause"] for a in alerts}
+    assert causes == {"skew:plan:p1", "skew:cell:42"}
+    cell = next(a for a in alerts if a["cause"] == "skew:cell:42")
+    assert cell["suspect"]["bbox"] == [0, 0, 1, 1]
+    assert cell["suspect"]["share_at_least"] == pytest.approx(0.68)
+
+
+def test_slo_burn_alert_carries_scope_and_burn_rates():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    eng = SloEngine(registry=reg, clock=clock)
+    eng.add(Objective(name="lat", kind="latency", target=0.999,
+                      timer="q", threshold_ms=100.0))
+    doc = _mk_doctor(reg, clock, slo_engine=eng)
+    for _ in range(1000):
+        reg.observe("q", 0.01)
+    eng.tick()
+    clock.advance(21601)
+    for _ in range(900):
+        reg.observe("q", 0.01)
+    for _ in range(100):
+        reg.observe("q", 1.0)               # 10% bad: 100x burn → page
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "slo_burn" and alert["severity"] == "page"
+    assert alert["cause"] == "local-slo:lat"
+    assert alert["detail"]["scope"] == "local"
+    assert alert["detail"]["burn_rates"]["5m"] > 14
+
+
+# -- incident lifecycle -------------------------------------------------------
+
+
+def test_incident_dedup_while_active_then_resolution_counts_firings():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    reg.set_gauge("replication.lag_ms", 2500.0)
+    for _ in range(3):                      # same (rule, cause) 3 ticks
+        doc.evaluate()
+        clock.advance(1)
+    assert len(doc.store.active()) == 1
+    inc = doc.store.active()[0]
+    assert inc["count"] == 3
+    snap = reg.snapshot()["counters"]
+    assert snap["incident.opened"] == 1
+    assert snap["incident.deduped"] == 2
+    reg.set_gauge("replication.lag_ms", 0.0)
+    doc.evaluate()
+    clock.advance(1)
+    doc.evaluate()
+    assert doc.store.active() == []
+    assert doc.store.all()[-1]["resolution"]["firings"] == 3
+    assert reg.snapshot()["counters"]["incident.resolved"] == 1
+    assert doc.store.stats()["opened_total"] == 1
+
+
+def test_doctor_disabled_gate():
+    reg = MetricsRegistry()
+    doc = _mk_doctor(reg, FakeClock())
+    reg.set_gauge("replication.lag_ms", 9999.0)
+    config.DOCTOR_ENABLED.set(False)
+    out = doc.evaluate()
+    assert out == {"enabled": False, "alerts": [], "incidents": []}
+    assert "doctor.evaluations" not in reg.snapshot()["counters"]
+
+
+def test_verdict_is_one_line_with_suspect_and_trace():
+    inc = {"id": "inc-1", "rule": "slo_burn", "severity": "page",
+           "status": "open", "count": 4, "opened_ms": 0,
+           "suspect": {"objective": "lat", "scope": "local"},
+           "timeline": {"trace_gids": ["n1-abc123"]}}
+    line = verdict(inc)
+    assert "\n" not in line
+    assert line.startswith("[PAGE] slo_burn (open)")
+    assert "x4" in line and "objective=lat" in line
+    assert "trace=n1-abc123" in line
+    assert set(RULES) == {"slo_burn", "replication_lag", "recompile_churn",
+                          "shed_storm", "breaker_flapping",
+                          "wal_fsync_stall", "hot_skew"}
+
+
+# -- journal: rotation + replay (satellite) -----------------------------------
+
+
+def test_incident_journal_rotates_and_replays(tmp_path):
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    path = str(tmp_path / "incidents.jsonl")
+    store = IncidentStore(journal_path=path, max_bytes=2000, registry=reg)
+    doc = _mk_doctor(reg, clock, store=store)
+    config.DOCTOR_CLEAR_TICKS.set(1)
+    for i in range(8):                      # 8 open/close cycles
+        reg.set_gauge("replication.lag_ms", 2500.0)
+        doc.evaluate()
+        clock.advance(1)
+        reg.set_gauge("replication.lag_ms", 0.0)
+        doc.evaluate()
+        clock.advance(1)
+    assert (tmp_path / "incidents.jsonl.1").exists(), "size cap must rotate"
+    recs = replay_journal(path)
+    kinds = {r["kind"] for r in recs}
+    assert kinds == {"incident.open", "incident.close"}
+    # the tail survives rotation: the LAST cycle's close is replayable
+    closes = [r for r in recs if r["kind"] == "incident.close"]
+    assert closes[-1]["rule"] == "replication_lag"
+    assert closes[-1]["resolution"]["firings"] == 1
+    assert "_clear" not in closes[-1]       # private keys never journaled
+
+
+def test_journal_disabled_by_default_and_failure_counts(tmp_path):
+    reg = MetricsRegistry()
+    store = IncidentStore(journal_path="", registry=reg)  # explicit off
+    store.open_or_update({"rule": "r", "cause": "c"}, None, 0.0)
+    assert store.stats()["journal"] is None
+    bad = IncidentStore(journal_path=str(tmp_path), registry=reg)  # a dir
+    bad.open_or_update({"rule": "r", "cause": "c"}, None, 0.0)
+    assert reg.snapshot()["counters"]["incident.journal_errors"] == 1
+
+
+# -- exposition conformance: doctor.* / incident.* families (satellite) -------
+
+
+def _parse_exposition(text):
+    types = {}
+    samples = {}
+    line_re = re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(\{(?P<labels>[^}]*)\})?"
+        r" (?P<value>-?[0-9.eE+-]+|[+-]Inf)"
+        r"(?P<exemplar> # \{[^}]*\} -?[0-9.eE+-]+)?$")
+    for line in text.strip().split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ")
+            assert name not in types, f"duplicate # TYPE for {name}"
+            types[name] = typ
+            continue
+        if line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for kv in m.group("labels").split(","):
+                k, v = kv.split("=", 1)
+                labels[k] = v.strip('"')
+        samples.setdefault(m.group("name"), []).append(
+            (labels, m.group("value")))
+    return types, samples
+
+
+def test_doctor_and_incident_families_conform():
+    reg = MetricsRegistry()
+    doc = _mk_doctor(reg, FakeClock())
+    reg.set_gauge("replication.lag_ms", 2500.0)
+    doc.evaluate()
+    types, samples = _parse_exposition(reg.to_prometheus())
+    assert types["geomesa_tpu_doctor_evaluations_total"] == "counter"
+    assert types[
+        "geomesa_tpu_doctor_alerts_replication_lag_total"] == "counter"
+    assert types["geomesa_tpu_incident_opened_total"] == "counter"
+    assert types["geomesa_tpu_incident_active"] == "gauge"
+    (labels, val) = samples["geomesa_tpu_incident_active"][0]
+    assert float(val) == 1.0                # the callable gauge resolves
+
+
+# -- federation robustness (satellite) ----------------------------------------
+
+
+def test_failed_scrape_counts_per_node_and_marks_partial():
+    from geomesa_tpu.metrics import REGISTRY as global_reg
+    from geomesa_tpu.obs.federation import Federator
+    before = global_reg.snapshot()["counters"].get(
+        "fed.scrape_errors.down", 0)
+    f = Federator({"down": "http://127.0.0.1:9/"})  # nothing listens
+    snap = f.snapshot()
+    assert snap["partial"] is True and snap["missing"] == ["down"]
+    after = global_reg.snapshot()["counters"]["fed.scrape_errors.down"]
+    assert after > before
+    # the exposition reports the gap as ONE gauge family: an unlabeled
+    # total plus a labeled sample per missing node
+    types, samples = _parse_exposition(f.to_prometheus())
+    fam = "geomesa_tpu_fed_scrape_missing"
+    assert types[fam] == "gauge"
+    flat = samples[fam]
+    assert ({}, "1") in [(lb, v) for lb, v in flat]
+    assert any(lb.get("node") == "down" for lb, _ in flat)
+
+
+def _scrape_state(name, role, timers=(), counters=None):
+    from geomesa_tpu.obs.federation import NodeScrape
+    reg = MetricsRegistry()
+    for k, v in (counters or {}).items():
+        reg.inc(k, v)
+    for k, secs in timers:
+        for s in secs:
+            reg.observe(k, s)
+    s = NodeScrape(name)
+    s.ok = True
+    s.healthz = {"status": "ok", "node": {"id": name, "role": role}}
+    s.state = reg.export_state()
+    return s, reg
+
+
+def test_fleet_slo_page_suppressed_when_merge_is_partial():
+    from geomesa_tpu.obs.federation import Federator, NodeScrape
+    t = [0.0]
+    s1, reg1 = _scrape_state(
+        "n1", "primary", counters={"scheduler.queries": 100},
+        timers=[("query.count", [0.010] * 100)])
+    f = Federator({"n1": "http://unused-n1"}, ttl_ms=1e12,
+                  clock=lambda: t[0])
+    f._scrapes = {"n1": s1}
+    f._last_refresh = t[0]
+    f.slo()                                 # healthy baseline sample
+    reg1.inc("scheduler.queries", 300)
+    for _ in range(200):
+        reg1.observe("query.count", 0.010)
+    for _ in range(100):
+        reg1.observe("query.count", 2.0)    # 100 slow: page-level burn
+    s1.state = reg1.export_state()
+    t[0] = 400.0
+    full = f.slo()
+    assert full["count_latency"]["page"], "sanity: full merge pages"
+    # now the same burn with a node missing: page suppressed, said so
+    down = NodeScrape("n2")
+    down.error = "connection refused"
+    f._scrapes["n2"] = down
+    part = f.slo()
+    lat = part["count_latency"]
+    assert not lat["page"] and lat["page_suppressed"] is True
+    assert lat["status"] in ("ticket", "ok")
+
+
+def test_fleet_incidents_attributes_node_and_merges_local():
+    from geomesa_tpu import trace as _trace
+    from geomesa_tpu.obs.doctor import DOCTOR
+    from geomesa_tpu.obs.federation import Federator
+    DOCTOR.reset()
+    try:
+        DOCTOR.store.open_or_update(
+            {"rule": "shed_storm", "cause": "admission:shed",
+             "severity": "page"}, None, 0.0)
+        f = Federator({_trace.node_id(): None})     # None target = local
+        out = f.fleet_incidents()
+        assert out["nodes"][_trace.node_id()]["ok"] is True
+        assert [i["rule"] for i in out["incidents"]] == ["shed_storm"]
+        assert out["incidents"][0]["fleet_node"] == _trace.node_id()
+        assert out["partial"] is False and out["missing"] == []
+    finally:
+        DOCTOR.reset()
+
+
+# -- CLI surfaces -------------------------------------------------------------
+
+
+def test_cli_doctor_and_debug_incidents_local(capsys):
+    from geomesa_tpu.obs.doctor import DOCTOR
+    from geomesa_tpu.tools.cli import main
+    DOCTOR.reset()
+    config.DOCTOR_CLEAR_TICKS.set(100)  # CLI reads evaluate(): keep the
+    try:                                # planted incident from resolving
+        assert main(["doctor"]) == 0
+        out = capsys.readouterr().out
+        assert "doctor: no incidents" in out
+        DOCTOR.store.open_or_update(
+            {"rule": "wal_fsync_stall", "cause": "wal:fsync",
+             "severity": "page", "suspect": {"path": "wal"}}, None, 0.0)
+        assert main(["doctor"]) == 0
+        out = capsys.readouterr().out
+        assert "[PAGE] wal_fsync_stall" in out and "path=wal" in out
+        assert main(["debug", "incidents"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["incidents"][0]["rule"] == "wal_fsync_stall"
+        assert payload["stats"]["active"] == 1
+    finally:
+        DOCTOR.reset()
+
+
+# -- the precision soak (CI doctor job; slow) ---------------------------------
+
+
+@pytest.mark.slow
+def test_doctor_soak_faulted_attributes_every_injection(tmp_path):
+    from geomesa_tpu.obs.soak import run_soak
+    report = run_soak(str(tmp_path), faulted=True,
+                      journal_path=str(tmp_path / "incidents.jsonl"))
+    assert report["ok"], json.dumps(report["phases"], default=str)
+    assert set(report["phases"]) == {"lag_spike", "replica_kill",
+                                     "kernel_handicap", "shed_burst"}
+    expect = {"lag_spike": "replication_lag",
+              "replica_kill": "replication_lag",
+              "kernel_handicap": "slo_burn", "shed_burst": "shed_storm"}
+    for name, rule in expect.items():
+        ph = report["phases"][name]
+        assert ph["exactly_one"] and ph["rule_correct"], (name, ph)
+        assert ph["evidence"], f"{name}: no linked trace/flight evidence"
+    # the journal replays the whole run: 4 opens, the lag pair closed
+    recs = replay_journal(str(tmp_path / "incidents.jsonl"))
+    opens = [r for r in recs if r["kind"] == "incident.open"]
+    assert [r["rule"] for r in opens] == [
+        "replication_lag", "replication_lag", "slo_burn", "shed_storm"]
+    closes = [r for r in recs if r["kind"] == "incident.close"]
+    assert len(closes) >= 2
+
+
+@pytest.mark.slow
+def test_doctor_soak_clean_run_opens_zero_incidents(tmp_path):
+    from geomesa_tpu.obs.soak import run_soak
+    report = run_soak(str(tmp_path), faulted=False)
+    assert report["ok"], json.dumps(
+        report.get("incidents"), default=str)
+    assert report["opened_total"] == 0
