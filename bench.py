@@ -244,12 +244,15 @@ def main(args=None) -> int:
 
     n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
-    default_configs = "0,1,2,3,4,5,6,7,8"
+    default_configs = "0,1,2,3,4,5,6,7,8,9"
     if args.mini:
         from geomesa_tpu import config as _gcfg
         n = min(n, int(_gcfg.BENCH_MINI_N.get()))
         reps = min(reps, 5)
-        default_configs = "0,1,4"
+        # cfg9 rides the mini gate: the serving-layer regressions it pins
+        # (cache serve p50, Zipf hit rate, storm isolation) are host-side
+        # and CI-sized, unlike the device-bound cfg2/3/5-8 sweeps
+        default_configs = "0,1,4,9"
     configs = set(os.environ.get("GEOMESA_TPU_BENCH_CONFIGS",
                                  default_configs).split(","))
     rng = np.random.default_rng(1234)
@@ -678,14 +681,17 @@ def main(args=None) -> int:
         n3 = min(n, 20_000_000)
         px = np.asarray(x[:n3], dtype=np.float32)
         py = np.asarray(y[:n3], dtype=np.float32)
-        polys = []
-        for cx, cy in centers[:32]:
-            ang = np.linspace(0, 2 * np.pi, 17)[:-1]
-            r = 3.0 + 2.0 * rng.random()
-            ring = [[float(cx + r * np.cos(a)), float(cy + r * np.sin(a))]
-                    for a in ang]
-            ring.append(ring[0])
-            polys.append((3, [ring]))  # POLYGON code, single ring
+        # real-complexity polygon set (committed artifact): country-scale
+        # vertex counts anchored at this corpus's cluster centers — toy
+        # 16-gons flattered the join by ~40x fewer edge tests per point
+        with open(os.path.join(REPO, "perf",
+                               "polygons_complex.json")) as fh:
+            _pc = json.load(fh)
+        polys = [(int(code), rings) for code, rings in _pc["polygons"]]
+        _vc = _pc["vertex_counts"]
+        detail["cfg3_poly_vertices_total"] = int(sum(_vc))
+        detail["cfg3_poly_vertices_mean"] = round(sum(_vc) / len(_vc), 1)
+        detail["cfg3_poly_vertices_max"] = int(max(_vc))
         join = SpatialJoin(polys)
         dx_ = jnp.asarray(px)
         dy_ = jnp.asarray(py)
@@ -1092,6 +1098,157 @@ def main(args=None) -> int:
             _wl._enabled_cache[1] = 0
             sched8.shutdown()
 
+    # ---- config 9: self-optimizing serving (result cache + tenant QoS) ----
+    if "9" in configs:
+        import threading as _th
+
+        from geomesa_tpu import config as _cfg
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.obs import workload as _wl
+        from geomesa_tpu.serve.resilience.admission import ShedError
+        from geomesa_tpu.serve.scheduler import QueryScheduler, StoreBinding
+
+        n9 = min(n, 1_000_000)
+        sft9 = SimpleFeatureType.from_spec(
+            "hotq", "dtg:Date,*geom:Point;geomesa.z3.interval=week")
+        st9 = TpuDataStore()
+        st9.create_schema(sft9)
+        st9.load("hotq", FeatureTable.build(
+            sft9, {"dtg": dtg[:n9], "geom": (x[:n9], y[:n9])}))
+        sched9 = QueryScheduler(StoreBinding(st9), flush_size=8,
+                                window_us=300)
+        _wl.WORKLOAD.clear()
+        try:
+            hot_q = (f"BBOX(geom, {qx0}, {qy0}, {qx1}, {qy1}) AND dtg "
+                     "DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+
+            # (a) warm hot-query p50 vs the uncached interactive blocking
+            # p50 it attacks — same query, same scheduler, cache off/on
+            _cfg.RESULT_CACHE_ENABLED.set(False)
+            sched9.count("hotq", hot_q)  # warm: plan + kernels
+            lat9u = _time_reps(lambda: sched9.count("hotq", hot_q), reps,
+                               key="cfg9_uncached")
+            p9u = _p50(lat9u)
+            _cfg.RESULT_CACHE_ENABLED.unset()
+            _cfg.RESULT_CACHE_MIN_AT_LEAST.set(0)
+            sched9.count("hotq", hot_q)  # insert
+            lat9w = _time_reps(lambda: sched9.count("hotq", hot_q), reps)
+            p9w = _p50(lat9w)
+            detail["cfg9_n"] = n9
+            detail["cfg9_uncached_blocking_p50_ms"] = round(p9u, 3)
+            detail["cfg9_warm_hit_p50_ms"] = round(p9w, 4)
+            detail["cfg9_warm_speedup"] = round(p9u / p9w, 1)
+            assert p9w <= p9u / 5.0, \
+                f"warm hit p50 {p9w:.3f}ms not 5x under uncached {p9u:.3f}ms"
+
+            # (b) steady-state hit rate on the cfg8 Zipf mix under the
+            # DEFAULT admission floor: pass A teaches the workload plane
+            # (cold-rejects while nothing is provably hot), pass B replays
+            # the identical draw against the learned hot set
+            _cfg.RESULT_CACHE_MIN_AT_LEAST.unset()
+            sched9.results.clear()
+            n_shapes9 = 200
+            n_draws9 = 400 if args.mini else 1200
+            shapes9 = [
+                f"BBOX(geom, {qx0 + (i % 20) * 0.3:.2f}, "
+                f"{qy0 + (i // 20) * 0.3:.2f}, "
+                f"{qx1 + (i % 20) * 0.3:.2f}, "
+                f"{qy1 + (i // 20) * 0.3:.2f}) AND dtg DURING "
+                "2020-01-05T00:00:00Z/2020-01-12T00:00:00Z"
+                for i in range(n_shapes9)]
+            wz9 = 1.0 / (np.arange(n_shapes9) + 1) ** 1.1
+            draw9 = rng.choice(n_shapes9, size=n_draws9,
+                               p=wz9 / wz9.sum())
+
+            def run9() -> None:
+                for c0 in range(0, n_draws9, 32):
+                    reqs = [sched9.submit("hotq", shapes9[draw9[i]],
+                                          tenant=f"tenant{i % 7}")
+                            for i in range(c0, min(c0 + 32, n_draws9))]
+                    for r in reqs:
+                        r.result(timeout=60)
+
+            run9()  # pass A: learn
+            _wl.WORKLOAD.drain()
+            s9a = sched9.results.stats()
+            run9()  # pass B: replay warm
+            s9b = sched9.results.stats()
+            hit_rate9 = (s9b["hits"] - s9a["hits"]) / n_draws9
+            detail["cfg9_submitted"] = 2 * n_draws9
+            detail["cfg9_result_cache_hit_rate"] = round(hit_rate9, 3)
+            detail["cfg9_result_cache_size"] = s9b["size"]
+            detail["cfg9_result_cache_rejected_cold"] = s9b["rejected_cold"]
+            assert hit_rate9 >= 0.5, \
+                f"Zipf-head replay hit rate {hit_rate9:.3f} < 0.5"
+
+            # (c) tenant-storm drill: 8 noisy threads flood permanently-cold
+            # queries; the victim probes its hot (cached) query. QoS caps
+            # the storm's in-flight share, the cache keeps the victim off
+            # the contended device — its p99 must hold
+            _cfg.RESULT_CACHE_MIN_AT_LEAST.set(0)
+            _cfg.ADMIT_INTERACTIVE.set(8)
+            sched9.count("hotq", hot_q, tenant="victim")  # re-warm
+
+            def probe9(k) -> float:
+                lat = []
+                for _ in range(k):
+                    t0 = time.perf_counter()
+                    sched9.count("hotq", hot_q, tenant="victim",
+                                 timeout=30)
+                    lat.append(time.perf_counter() - t0)
+                return float(np.percentile(np.asarray(lat) * 1000.0, 99))
+
+            k9 = 100 if args.mini else 300
+            p99_unloaded = probe9(k9)
+            stop9 = _th.Event()
+
+            def storm9(tid: int) -> None:
+                i = 0
+                while not stop9.is_set():
+                    try:
+                        sched9.count(
+                            "hotq",
+                            f"BBOX(geom, {qx0 - tid - i * 1e-4:.4f}, "
+                            f"{qy0 - 11}, {qx1 + tid}, {qy1}) AND dtg "
+                            "DURING 2020-01-05T00:00:00Z/"
+                            "2020-01-12T00:00:00Z",
+                            tenant="noisy", timeout=30)
+                    except ShedError:
+                        pass
+                    i += 1
+
+            threads9 = [_th.Thread(target=storm9, args=(t,), daemon=True)
+                        for t in range(8)]
+            [t.start() for t in threads9]
+            try:
+                time.sleep(0.1)
+                p99_storm = probe9(k9)
+            finally:
+                stop9.set()
+                [t.join(timeout=30) for t in threads9]
+            qos9 = sched9.admission.stats()["qos"]
+            detail["cfg9_victim_unloaded_p99_ms"] = round(p99_unloaded, 3)
+            detail["cfg9_victim_storm_p99_ms"] = round(p99_storm, 3)
+            detail["cfg9_victim_p99_ratio"] = round(
+                p99_storm / p99_unloaded, 2)
+            detail["cfg9_storm_qos_shed"] = int(
+                qos9["qos_shed"].get("noisy", 0))
+            assert detail["cfg9_storm_qos_shed"] > 0, \
+                "the storm was never fair-share shed"
+            assert "victim" not in qos9["qos_shed"]
+            # the acceptance bound, with a 2ms absolute floor: both sides
+            # are cache serves, so p99s sit at GIL-jitter scale and the
+            # raw ratio is noise-dominated — the drill still fails loudly
+            # if the victim is pushed anywhere toward device-bound latency
+            # (the uncached p50 yardstick is ~50x the floor at paper scale)
+            assert p99_storm <= max(2.0 * p99_unloaded, 2.0), \
+                (p99_storm, p99_unloaded, p9u)
+        finally:
+            _cfg.RESULT_CACHE_MIN_AT_LEAST.unset()
+            _cfg.RESULT_CACHE_ENABLED.unset()
+            _cfg.ADMIT_INTERACTIVE.unset()
+            sched9.shutdown()
+
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",
         "value": round(headline_p50, 3) if headline_p50 is not None else None,
@@ -1128,6 +1285,14 @@ def main(args=None) -> int:
             # comparable per node, not just per machine class
             "node_id": _trace_mod.node_id(),
             "role": _trace_mod.node_role(),
+            # join-input complexity (bench honesty: these numbers mean
+            # nothing without the polygon set's vertex budget on record)
+            "cfg3_polygons": (
+                {"count": int(detail.get("cfg3_n_polygons", 0)),
+                 "vertices_total": detail["cfg3_poly_vertices_total"],
+                 "vertices_mean": detail["cfg3_poly_vertices_mean"],
+                 "vertices_max": detail["cfg3_poly_vertices_max"]}
+                if "cfg3_poly_vertices_total" in detail else None),
         },
         "metrics": metrics,
         "kernels": _pw.kernel_summary(_attrib.snapshot()),

@@ -556,6 +556,73 @@ DOCTOR_TIMELINE_EVENTS = _register(
     "(matched with the flight recorder's shared predicate, newest "
     "first).")
 
+# -- self-optimizing serving: result cache / affinity / QoS (ISSUE 12) --------
+
+RESULT_CACHE_ENABLED = _register(
+    "GEOMESA_TPU_RESULT_CACHE", True, _parse_bool,
+    "Master switch for the scheduled-count result cache: hot queries "
+    "(admitted by the workload plane's hot_set at_least counts) resolve "
+    "from memory without touching the device. Entries are keyed by the "
+    "same (epoch, type, generation, filter, auths) tuple that salts the "
+    "plan cache, so every mutation path invalidates them exactly.")
+
+RESULT_CACHE_SIZE = _register(
+    "GEOMESA_TPU_RESULT_CACHE_SIZE", 2048, int,
+    "Entry bound for the result cache (LRU past it). Each entry is one "
+    "int plus its key, so memory stays O(entries).")
+
+RESULT_CACHE_MIN_AT_LEAST = _register(
+    "GEOMESA_TPU_RESULT_CACHE_MIN_AT_LEAST", 3, int,
+    "Admission threshold: a result is cached only when its plan hash or "
+    "query cell appears in hot_set() with a guaranteed (at_least) count "
+    ">= this, so cold one-off queries never pollute the cache. 0 admits "
+    "everything (useful in tests).")
+
+RESULT_CACHE_HOTSET_TTL_S = _register(
+    "GEOMESA_TPU_RESULT_CACHE_HOTSET_TTL_S", 1.0, float,
+    "How long the cache's view of hot_set() admission keys may be "
+    "reused before re-reading the workload plane (bounds the per-miss "
+    "admission cost to a dict lookup).")
+
+QOS_ENABLED = _register(
+    "GEOMESA_TPU_QOS", True, _parse_bool,
+    "Master switch for weighted-fair tenant QoS inside admission "
+    "control: each tenant's in-flight share of a priority class is "
+    "bounded, so a noisy tenant saturates its own share and sheds 429 "
+    "while other tenants' latency holds.")
+
+QOS_TENANT_SHARE = _register(
+    "GEOMESA_TPU_QOS_TENANT_SHARE", 0.5, float,
+    "Maximum fraction of a priority class's in-flight limit one tenant "
+    "may hold while other tenants are active (a lone tenant may use "
+    "the full class limit — work-conserving, not a hard quota).")
+
+QOS_TENANT_MIN = _register(
+    "GEOMESA_TPU_QOS_TENANT_MIN", 2, int,
+    "Floor on the per-tenant in-flight share: fairness never starves a "
+    "tenant below this many slots regardless of the share fraction.")
+
+QOS_ACTIVE_S = _register(
+    "GEOMESA_TPU_QOS_ACTIVE_S", 2.0, float,
+    "How long a tenant counts as active after its last admitted request. "
+    "The per-tenant share cap engages only while >= 2 tenants are active "
+    "in a class (work-conserving: a lone tenant is never throttled), so "
+    "this window is how fast a quiet tenant's claim on fairness decays.")
+
+AFFINITY_ENABLED = _register(
+    "GEOMESA_TPU_AFFINITY", True, _parse_bool,
+    "Master switch for cell-affinity routing: the router stamps each "
+    "query's Morton cell and consistently prefers the same healthy "
+    "replica for a hot cell, keeping that replica's result/plan/cover "
+    "caches warm. Cold cells and freshness=strong fall back to the "
+    "health/lag-aware rotation unchanged.")
+
+AFFINITY_MIN_AT_LEAST = _register(
+    "GEOMESA_TPU_AFFINITY_MIN_AT_LEAST", 3, int,
+    "A query cell counts as hot for affinity routing once the workload "
+    "plane guarantees (at_least) this many hits on it in the current "
+    "window. 0 pins every cell (useful in tests).")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

@@ -811,6 +811,10 @@ class TpuDataStore:
                         repr(f_ir), auths_key)
                 out["analyze"]["provenance"]["plan_cache"] = \
                     "hit" if sched.plans.peek(pkey) else "miss"
+                # same key shape as the plan cache: would a scheduled
+                # count be answered from the hot-result cache right now?
+                out["analyze"]["provenance"]["result_cache"] = \
+                    "hit" if sched.results.peek(pkey) else "miss"
         return out
 
     def stats(self, type_name: str):

@@ -298,6 +298,10 @@ def event_from_request(req, fut) -> dict:
         "queue_wait_ms": ms(req.queue_wait_s),
         "plan_cache_hit": req.plan_cache_hit,
         "cover_cache_hit": req.cover_cache_hit,
+        # provenance: "result" = served from the hot-result cache with NO
+        # device round trip (device_ms stays zero; workload device-time
+        # accounting must not re-bill the original dispatch)
+        "cache": "result" if getattr(req, "result_cache_hit", None) else None,
         "batched": req.batched,
         "batch_size": req.batch_size,
         "batch_id": req.batch_id,

@@ -371,6 +371,11 @@ class WorkloadAnalytics:
         if self._meter:
             label = tenant_metric_label(tenant)
             _metrics.inc(f"tenant.{label}.queries")
+            if ev.get("cache") == "result":
+                # result-cache hit: the device/scan cost was billed when
+                # the original dispatch ran — replaying it here would
+                # double-count device time and rows against the tenant
+                return
             dms = float(ev.get("device_ms") or 0.0)
             if dms:
                 _metrics.inc(f"tenant.{label}.device_ms", dms)

@@ -1,0 +1,235 @@
+"""Hot-result cache over scheduled counts (the workload plane's first consumer).
+
+Single interactive queries are pinned at a ~107 ms blocking p50 that is
+dispatch/RTT bound, not device bound (BENCH cfg1) — micro-batching cannot
+touch it because a lone query has nothing to coalesce with. The fastest
+dispatch is the one never made: this cache answers a *hot* repeated count
+from memory, keyed by the exact tuple that already salts the scheduler's
+plan cache —
+
+    (epoch, type_name, generation, normalized filter, auths key)
+
+so the existing invalidation story applies verbatim: every mutation path
+(ingest append, LSM flush, update, remove, age-off, schema change) bumps
+the per-type generation, a restored store gets a fresh incarnation epoch,
+and replicated applies on a follower run through the same mutation paths
+(PR 7) — a stale cached count is unreachable by construction, on primaries
+and replicas alike.
+
+Admission is gated by the workload plane: a result is cached only when its
+plan hash or query cell appears in ``hot_set()`` with a guaranteed
+(``at_least = count - error``) frequency clearing
+``GEOMESA_TPU_RESULT_CACHE_MIN_AT_LEAST``, so cold one-off queries never
+pollute the bounded LRU. The hot-set view is snapshotted on a short TTL so
+a miss pays one dict lookup, not a sketch aggregation.
+
+Observability: hits/misses/insertions/rejections/invalidations feed the
+metrics registry under ``result_cache.*``; per-cell warmth (live entries
+per Morton hot cell) backs the ``GET /cache`` + ``debug cache`` surfaces
+so the doctor's hot_skew suspects can be cross-checked against what is
+actually cached. Cache hits resolve with ``cache="result"`` flight
+provenance and zero device-ms (the scheduler stamps the request), so
+attribution and workload rollups stay honest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+MISS = object()
+_pc = time.perf_counter
+
+
+class ResultCache:
+    """Bounded, generation/epoch-keyed LRU over scheduled count results.
+
+    Thread-safe: gets run on submit paths (any caller thread), puts on the
+    scheduler's completer thread, sweeps inline under the same lock.
+
+    Invalidation is structural — the generation lives in the key — but the
+    cache additionally *sweeps* superseded generations eagerly the first
+    time it sees a newer generation for a type, so ``stats()`` reports an
+    honest ``invalidations`` count and dead entries never squat in the LRU
+    displacing live ones.
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 min_at_least: Optional[int] = None,
+                 hot_ttl_s: Optional[float] = None):
+        self._d: "OrderedDict" = OrderedDict()
+        self._cap_override = capacity
+        self._min_override = min_at_least
+        self._ttl_override = hot_ttl_s
+        self._lock = threading.Lock()
+        # (epoch, type_name) -> newest generation seen; older entries for
+        # the pair are swept (and counted invalidated) on first sight
+        self._gen_seen: dict = {}
+        # cell -> live entry count (the warmth surface)
+        self._cell_entries: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.invalidations = 0
+        self.rejected_cold = 0
+        # TTL-cached admission view of the workload hot set
+        self._hot_at = 0.0
+        self._hot_plans: dict = {}
+        self._hot_cells: dict = {}
+
+    # -- knobs (late-bound so tests can flip config live) ---------------------
+
+    def capacity(self) -> int:
+        return int(self._cap_override if self._cap_override is not None
+                   else config.RESULT_CACHE_SIZE.get())
+
+    def min_at_least(self) -> int:
+        return int(self._min_override if self._min_override is not None
+                   else config.RESULT_CACHE_MIN_AT_LEAST.get())
+
+    def enabled(self) -> bool:
+        return bool(config.RESULT_CACHE_ENABLED.get()) and self.capacity() > 0
+
+    # -- the hot-set admission view -------------------------------------------
+
+    def _hot_view(self) -> Tuple[dict, dict]:
+        ttl = float(self._ttl_override if self._ttl_override is not None
+                    else config.RESULT_CACHE_HOTSET_TTL_S.get())
+        now = _pc()
+        if now - self._hot_at > ttl:
+            from geomesa_tpu.obs.workload import WORKLOAD
+            try:
+                hs = WORKLOAD.hot_set()
+            except Exception:
+                hs = {"plans": [], "cells": []}
+            self._hot_plans = {e["key"]: e["at_least"] for e in hs["plans"]}
+            self._hot_cells = {e["key"]: e["at_least"] for e in hs["cells"]}
+            self._hot_at = now
+        return self._hot_plans, self._hot_cells
+
+    def admissible(self, plan_hash: str, cell: Optional[str]) -> bool:
+        """True when the plan hash or the query cell is guaranteed hot
+        enough (``at_least`` >= the threshold). Threshold 0 admits all."""
+        floor = self.min_at_least()
+        if floor <= 0:
+            return True
+        plans, cells = self._hot_view()
+        if plans.get(plan_hash, 0) >= floor:
+            return True
+        return cell is not None and cells.get(cell, 0) >= floor
+
+    # -- core ops -------------------------------------------------------------
+
+    def _sweep(self, epoch, type_name, generation) -> None:
+        """Called under the lock: drop every entry of (epoch, type) with an
+        older generation once a newer one is observed."""
+        pair = (epoch, type_name)
+        seen = self._gen_seen.get(pair)
+        if seen is not None and generation <= seen:
+            return
+        self._gen_seen[pair] = generation
+        if seen is None:
+            return
+        dead = [k for k in self._d
+                if k[0] == epoch and k[1] == type_name and k[2] < generation]
+        for k in dead:
+            self._drop(k)
+            self.invalidations += 1
+        if dead:
+            _metrics.inc("result_cache.invalidations", len(dead))
+
+    def _drop(self, key) -> None:
+        _count, cell = self._d.pop(key)
+        if cell is not None:
+            n = self._cell_entries.get(cell, 0) - 1
+            if n > 0:
+                self._cell_entries[cell] = n
+            else:
+                self._cell_entries.pop(cell, None)
+
+    def get(self, key):
+        """Cached count for the full (epoch, type, generation, filter,
+        auths) key, or the module ``MISS`` sentinel."""
+        epoch, type_name, generation = key[0], key[1], key[2]
+        with self._lock:
+            self._sweep(epoch, type_name, generation)
+            ent = self._d.get(key)
+            if ent is not None:
+                self._d.move_to_end(key)
+                self.hits += 1
+                hit = True
+                out = ent[0]
+            else:
+                self.misses += 1
+                hit = False
+                out = MISS
+        _metrics.inc("result_cache.hits" if hit else "result_cache.misses")
+        return out
+
+    def peek(self, key) -> bool:
+        """Membership probe WITHOUT touching counters or LRU order (the
+        explain provenance overlay must not skew cache stats)."""
+        with self._lock:
+            return key in self._d
+
+    def put(self, key, count: int, plan_hash: str,
+            cell: Optional[str]) -> bool:
+        """Insert if the query clears hot-set admission; returns whether it
+        was cached. Rejections are counted (``rejected_cold``)."""
+        if not self.enabled():
+            return False
+        if not self.admissible(plan_hash, cell):
+            with self._lock:
+                self.rejected_cold += 1
+            _metrics.inc("result_cache.rejected_cold")
+            return False
+        epoch, type_name, generation = key[0], key[1], key[2]
+        with self._lock:
+            self._sweep(epoch, type_name, generation)
+            if generation < self._gen_seen.get((epoch, type_name), generation):
+                return False  # a newer generation already exists: stillborn
+            if key not in self._d and cell is not None:
+                self._cell_entries[cell] = self._cell_entries.get(cell, 0) + 1
+            self._d[key] = (int(count), cell)
+            self._d.move_to_end(key)
+            self.insertions += 1
+            cap = self.capacity()
+            while len(self._d) > cap:
+                old = next(iter(self._d))
+                self._drop(old)
+        _metrics.inc("result_cache.insertions")
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+            self._cell_entries.clear()
+            self._gen_seen.clear()
+            self._hot_at = 0.0
+            self._hot_plans = {}
+            self._hot_cells = {}
+
+    def stats(self) -> dict:
+        """The ``GET /cache`` / ``debug cache`` surface: counters plus
+        per-cell warmth (live entries per Morton hot cell)."""
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "enabled": self.enabled(),
+                "size": len(self._d),
+                "capacity": self.capacity(),
+                "min_at_least": self.min_at_least(),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+                "insertions": self.insertions,
+                "invalidations": self.invalidations,
+                "rejected_cold": self.rejected_cold,
+                "cells": dict(sorted(self._cell_entries.items(),
+                                     key=lambda kv: -kv[1])),
+            }

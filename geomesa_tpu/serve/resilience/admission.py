@@ -18,12 +18,20 @@ Two classes:
 Accounting is in-flight based (admitted minus completed, counted via a
 future done-callback), so the bound covers queued AND executing work — the
 quantity that actually determines how long a newly admitted request waits.
+
+Tenant QoS (GEOMESA_TPU_QOS_*): within each class, weighted-fair per-tenant
+shares bound how much of the class limit one tenant may hold while other
+tenants are active — a noisy tenant saturates its own share and sheds 429
+while the victims' requests keep landing in the reserved headroom. The cap
+is work-conserving: a lone tenant (no other tenant admitted inside the
+QOS_ACTIVE_S window) may use the full class limit.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict
+import time
+from typing import Dict, Optional
 
 from geomesa_tpu import config
 from geomesa_tpu.metrics import REGISTRY as _metrics
@@ -45,14 +53,18 @@ class ShedError(Exception):
     the Retry-After the client should honor."""
 
     def __init__(self, priority: str, in_flight: int, limit: int,
-                 retry_after_s: float):
+                 retry_after_s: float, tenant: Optional[str] = None):
+        who = f"tenant {tenant} " if tenant else ""
         super().__init__(
-            f"overloaded: {in_flight}/{limit} {priority} queries in flight; "
-            f"retry after {retry_after_s:g}s")
+            f"overloaded: {who}{in_flight}/{limit} {priority} queries in "
+            f"flight; retry after {retry_after_s:g}s")
         self.priority = priority
         self.in_flight = in_flight
         self.limit = limit
         self.retry_after_s = retry_after_s
+        # set when the shed was a per-tenant QoS share cap, not the class
+        # limit: THIS tenant is over its fair share, the class has headroom
+        self.tenant = tenant
 
 
 class AdmissionController:
@@ -65,6 +77,14 @@ class AdmissionController:
         self._in_flight: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._admitted: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self._shed: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        # tenant QoS state (all guarded by the lock): per-class per-tenant
+        # in-flight, last-admit timestamps (the activity window), and the
+        # per-tenant QoS shed tally for the stats surface
+        self._tenant_flight: Dict[str, Dict[str, int]] = \
+            {p: {} for p in PRIORITIES}
+        self._tenant_seen: Dict[str, Dict[str, float]] = \
+            {p: {} for p in PRIORITIES}
+        self._qos_shed: Dict[str, int] = {}
         self._draining = False
         _metrics.set_gauge("admission.in_flight.interactive",
                            lambda: self._in_flight["interactive"])
@@ -79,11 +99,41 @@ class AdmissionController:
             else config.ADMIT_BATCH
         return int(prop.get())
 
-    def admit(self, priority: str) -> str:
+    def _share(self, limit: int) -> int:
+        """Per-tenant in-flight share of a class limit while fairness is
+        engaged: share-fraction of the limit, floored so a tenant is never
+        starved to zero slots."""
+        frac = float(config.QOS_TENANT_SHARE.get())
+        floor = int(config.QOS_TENANT_MIN.get())
+        return max(1, floor, int(limit * frac))
+
+    def _admit_tenant_locked(self, p: str, tenant: str, limit: int):
+        """Under the lock: the QoS verdict for one tenant. Returns None to
+        admit, or (tenant_in_flight, share) to shed. Also maintains the
+        activity window."""
+        now = time.monotonic()
+        seen = self._tenant_seen[p]
+        window = float(config.QOS_ACTIVE_S.get())
+        if len(seen) > 256:  # bound the window map under tenant churn
+            for t in [t for t, ts in seen.items() if now - ts > window]:
+                del seen[t]
+        seen[tenant] = now
+        others_active = any(t != tenant and now - ts <= window
+                            for t, ts in seen.items())
+        if not others_active:
+            return None  # lone tenant: work-conserving, full class limit
+        mine = self._tenant_flight[p].get(tenant, 0)
+        share = self._share(limit)
+        if mine >= share:
+            return mine, share
+        return None
+
+    def admit(self, priority: str, tenant: Optional[str] = None) -> str:
         """Admit one request of ``priority`` (returns the normalized class)
         or raise ShedError. The caller MUST pair a successful admit with
-        exactly one ``release`` (the scheduler wires it to the request
-        future's done-callback, covering every resolution path)."""
+        exactly one ``release`` — same tenant label — (the scheduler wires
+        it to the request future's done-callback, covering every
+        resolution path)."""
         p = normalize_priority(priority)
         if self._draining:
             # rolling restart / failover drain: shed EVERYTHING (even with
@@ -100,17 +150,39 @@ class AdmissionController:
             with self._lock:
                 self._in_flight[p] += 1
                 self._admitted[p] += 1
+                if tenant is not None:
+                    tf = self._tenant_flight[p]
+                    tf[tenant] = tf.get(tenant, 0) + 1
             _metrics.inc("admission.admitted")
             return p
         limit = self._limit(p)
+        qos = tenant is not None and bool(config.QOS_ENABLED.get())
         with self._lock:
+            verdict = self._admit_tenant_locked(p, tenant, limit) \
+                if qos else None
             n = self._in_flight[p]
-            if n >= limit:
+            if verdict is not None:
+                # over the fair share while other tenants are active: shed
+                # THIS tenant even though the class may have headroom —
+                # that headroom is the victims' protection
+                self._shed[p] += 1
+                self._qos_shed[tenant] = self._qos_shed.get(tenant, 0) + 1
+            elif n >= limit:
                 self._shed[p] += 1
             else:
                 self._in_flight[p] = n + 1
                 self._admitted[p] += 1
+                if tenant is not None:
+                    tf = self._tenant_flight[p]
+                    tf[tenant] = tf.get(tenant, 0) + 1
                 n = -1
+        if verdict is not None:
+            _metrics.inc("admission.shed")
+            _metrics.inc(f"admission.shed.{p}")
+            _metrics.inc("admission.shed.qos")
+            raise ShedError(p, verdict[0], verdict[1],
+                            float(config.ADMIT_RETRY_AFTER_S.get()),
+                            tenant=tenant)
         if n >= 0:
             _metrics.inc("admission.shed")
             _metrics.inc(f"admission.shed.{p}")
@@ -119,10 +191,17 @@ class AdmissionController:
         _metrics.inc("admission.admitted")
         return p
 
-    def release(self, priority: str) -> None:
+    def release(self, priority: str, tenant: Optional[str] = None) -> None:
         with self._lock:
             self._in_flight[priority] = max(
                 0, self._in_flight[priority] - 1)
+            if tenant is not None:
+                tf = self._tenant_flight.get(priority, {})
+                left = tf.get(tenant, 0) - 1
+                if left > 0:
+                    tf[tenant] = left
+                else:
+                    tf.pop(tenant, None)
 
     def drain(self, draining: bool = True) -> None:
         """Enter (or leave) drain mode: every new request sheds with 429 +
@@ -150,4 +229,15 @@ class AdmissionController:
                 "admitted": dict(self._admitted),
                 "shed": dict(self._shed),
                 "retry_after_s": float(config.ADMIT_RETRY_AFTER_S.get()),
+                "qos": {
+                    "enabled": bool(config.QOS_ENABLED.get()),
+                    "tenant_share": float(config.QOS_TENANT_SHARE.get()),
+                    "tenant_min": int(config.QOS_TENANT_MIN.get()),
+                    "share_limits": {p: self._share(self._limit(p))
+                                     for p in PRIORITIES},
+                    "tenant_in_flight": {p: dict(self._tenant_flight[p])
+                                         for p in PRIORITIES
+                                         if self._tenant_flight[p]},
+                    "qos_shed": dict(self._qos_shed),
+                },
             }

@@ -20,7 +20,16 @@ Reads that need read-your-writes freshness pin to the primary
 (``freshness="strong"``); bounded reads accept any non-demoted node.
 Failover = ``promote()``: drain the old primary via admission control,
 pick the replica with the highest applied seq, and promote it under a new
-fencing epoch."""
+fencing epoch.
+
+Cell affinity (GEOMESA_TPU_AFFINITY): each routed count is stamped with
+its coarse Morton cell (obs/sketches.cell_key — the same Z2 bit interleave
+the curves use) and, when the workload plane marks that cell hot, the
+rotation is re-ordered so the SAME healthy endpoint always leads for that
+cell — its result/plan/cover caches stay warm for the hot region instead
+of the heat smearing round-robin across the fleet. Cold cells keep the
+plain rotation; ``freshness="strong"`` pins and demotion are never
+overridden (affinity only re-orders the healthy tier)."""
 
 from __future__ import annotations
 
@@ -30,6 +39,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import zlib
 from typing import Dict, List, Optional
 
 from geomesa_tpu import config
@@ -350,6 +360,12 @@ class ReplicaRouter:
         self._n_requests = 0
         self._n_failovers = 0
         self._n_promotions = 0
+        # cell affinity: bounded cql -> Morton cell memo + a short-TTL
+        # snapshot of the workload plane's hot cells (at_least floored)
+        self._n_affinity = 0
+        self._cell_memo: Dict[str, Optional[str]] = {}
+        self._hot_cells: Dict[str, int] = {}
+        self._hot_at = 0.0
 
     # -- selection ------------------------------------------------------------
 
@@ -374,10 +390,50 @@ class ReplicaRouter:
                 return ep
         return None
 
-    def candidates(self, freshness: str = "bounded") -> List[Endpoint]:
+    def _query_cell(self, cql: str) -> Optional[str]:
+        """The query's coarse Morton cell (memoized per cql string; the
+        memo is bounded and None results are cached too)."""
+        if cql in self._cell_memo:
+            return self._cell_memo[cql]
+        from geomesa_tpu.filter.parser import parse_ecql
+        from geomesa_tpu.serve.scheduler import _query_cell
+        try:
+            cell = _query_cell(parse_ecql(cql))
+        except Exception:
+            cell = None
+        with self._lock:
+            if len(self._cell_memo) > 1024:
+                self._cell_memo.clear()
+            self._cell_memo[cql] = cell
+        return cell
+
+    def _cell_is_hot(self, cell: str) -> bool:
+        """Whether the workload plane guarantees (at_least) enough hits on
+        the cell to justify pinning it (short-TTL snapshot of hot_set())."""
+        floor = int(config.AFFINITY_MIN_AT_LEAST.get())
+        if floor <= 0:
+            return True
+        now = time.monotonic()
+        if now - self._hot_at > \
+                float(config.RESULT_CACHE_HOTSET_TTL_S.get()):
+            from geomesa_tpu.obs.workload import WORKLOAD
+            try:
+                hs = WORKLOAD.hot_set()
+                self._hot_cells = {e["key"]: e["at_least"]
+                                   for e in hs["cells"]}
+            except Exception:
+                self._hot_cells = {}
+            self._hot_at = now
+        return self._hot_cells.get(cell, 0) >= floor
+
+    def candidates(self, freshness: str = "bounded",
+                   cell: Optional[str] = None) -> List[Endpoint]:
         """Ordered endpoints to try. strong → the primary only (read-your-
         writes); bounded → healthy nodes in rotation, then demoted nodes
-        (stale replicas are demoted, never dropped), down nodes skipped."""
+        (stale replicas are demoted, never dropped), down nodes skipped.
+        A hot ``cell`` re-orders the healthy tier so the same endpoint
+        leads for that cell every time (cache warmth); demotion and
+        strong pins are never overridden."""
         if freshness == "strong":
             prim = self._primary()
             if prim is None:
@@ -392,6 +448,19 @@ class ReplicaRouter:
                 healthy.append(ep)
             elif c == DEMOTED:
                 demoted.append(ep)
+        if cell is not None and healthy \
+                and bool(config.AFFINITY_ENABLED.get()) \
+                and self._cell_is_hot(cell):
+            # consistent choice over a STABLE ordering (by name), so the
+            # pick survives rotation state, probe order and healthy-set
+            # membership of the other endpoints
+            stable = sorted(healthy, key=lambda e: e.name)
+            pin = stable[zlib.crc32(cell.encode()) % len(stable)]
+            with self._lock:
+                self._n_affinity += 1
+            _metrics.inc("router.affinity_pins")
+            out = [pin] + [e for e in healthy if e is not pin] + demoted
+            return out
         with self._lock:
             self._rr += 1
             rot = self._rr
@@ -416,8 +485,11 @@ class ReplicaRouter:
         _metrics.inc("router.requests")
         if freshness == "strong":
             _metrics.inc("router.strong_pins")
+        cell = self._query_cell(cql) \
+            if freshness != "strong" and config.AFFINITY_ENABLED.get() \
+            else None
         last: Optional[Exception] = None
-        for i, ep in enumerate(self.candidates(freshness)):
+        for i, ep in enumerate(self.candidates(freshness, cell=cell)):
             try:
                 n = ep.count(type_name, cql, auths=auths,
                              deadline_ms=deadline_ms, priority=priority)
@@ -484,6 +556,8 @@ class ReplicaRouter:
             "requests": self._n_requests,
             "read_failovers": self._n_failovers,
             "promotions": self._n_promotions,
+            "affinity_pins": self._n_affinity,
+            "affinity_enabled": bool(config.AFFINITY_ENABLED.get()),
             "endpoints": {
                 name: {"state": ep.classify(staleness),
                        "role": ep.role,

@@ -75,6 +75,8 @@ from geomesa_tpu.metrics import REGISTRY as _metrics
 from geomesa_tpu.obs import attrib as _attrib
 from geomesa_tpu.obs import flight as _flight
 from geomesa_tpu.obs import workload as _workload
+from geomesa_tpu.serve.cache import MISS as _RC_MISS
+from geomesa_tpu.serve.cache import ResultCache
 from geomesa_tpu.serve.resilience import deadline as _rdl
 from geomesa_tpu.serve.resilience import degrade as _degrade
 from geomesa_tpu.serve.resilience.admission import (AdmissionController,
@@ -239,7 +241,10 @@ class Request:
                  "plan_cache_hit", "cover_cache_hit", "batch_id",
                  "rows_scanned", "shed", "breaker_open", "retries",
                  # workload-analytics dimensions (obs/workload.py)
-                 "tenant", "cell")
+                 "tenant", "cell",
+                 # hot-result cache (serve/cache.py): True = served from
+                 # memory with no device round trip
+                 "result_cache_hit")
 
     def __init__(self, type_name, f_ir, f_key, auths, auths_key,
                  planner, delta, generation, epoch,
@@ -280,6 +285,7 @@ class Request:
         self.retries = 0
         self.tenant = tenant
         self.cell: Optional[str] = None
+        self.result_cache_hit: Optional[bool] = None
 
     def result(self, timeout: Optional[float] = None) -> int:
         return self.future.result(timeout=timeout)
@@ -307,7 +313,8 @@ class QueryScheduler:
                  window_us: Optional[float] = None,
                  min_window_us: Optional[float] = None,
                  plan_cache: Optional[int] = None,
-                 cover_cache: Optional[int] = None):
+                 cover_cache: Optional[int] = None,
+                 result_cache: Optional[int] = None):
         self.binding = binding
         self._flush_size = int(flush_size or config.SCHED_FLUSH_SIZE.get())
         self._max_window_us = float(window_us or config.SCHED_WINDOW_US.get())
@@ -319,6 +326,9 @@ class QueryScheduler:
         cap_c = config.SCHED_COVER_CACHE.get() if cover_cache is None else cover_cache
         self.plans = LruCache(cap_p, "scheduler.plan_cache")
         self.covers = LruCache(cap_c, "scheduler.cover_cache")
+        # hot-result cache: same (epoch, type, generation, filter, auths)
+        # keying as the plan cache, admission gated by the workload plane
+        self.results = ResultCache(capacity=result_cache)
         # priority queue: (rank, seq, request) — interactive before batch,
         # FIFO within a class, _STOP after all queued work
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
@@ -413,6 +423,19 @@ class QueryScheduler:
                 # whose result cannot be delivered in time)
                 self._cancel(req, "submit")
                 return req
+        # hot-result cache: a warm hot query resolves HERE — no admission
+        # slot, no queue, no plan, no device round trip. The flight
+        # callback above fires on the resolution with cache="result"
+        # provenance and zero device-ms, so attribution stays honest.
+        if self.results.enabled():
+            rkey = (epoch, type_name, gen, req.f_key, req.auths_key)
+            cached = self.results.get(rkey)
+            if cached is not _RC_MISS:
+                req.result_cache_hit = True
+                _metrics.inc("scheduler.result_cache_serves")
+                self._resolve(req, cached)
+                return req
+            req.result_cache_hit = False
         # retry_after_s > 0 means the breaker is open AND still cooling
         # down (probe-free check: allow() would consume a half-open slot)
         if self.breaker.retry_after_s() > 0 and config.BREAKER_DEGRADE.get():
@@ -423,7 +446,8 @@ class QueryScheduler:
                 req.future.set_result(approx)
                 return req
         try:
-            cls = self.admission.admit(req.priority)  # ShedError sheds
+            # tenant rides along for QoS fair-share accounting
+            cls = self.admission.admit(req.priority, tenant=req.tenant)
         except ShedError as e:
             # resolve the (unreturned) future so the flight event records
             # the shed before the raise reaches the caller
@@ -480,6 +504,10 @@ class QueryScheduler:
                     _trace.record("cancel", "cancel", 0.0)
                 if req.degraded:
                     _trace.record("degrade", "degrade", 0.0)
+                if req.result_cache_hit:
+                    # trace-visible proof the hot answer came from memory:
+                    # a cache leaf and NO queue_wait/plan/scan spans
+                    _trace.record("result_cache", "cache_hit", 0.0)
 
     # -- resilience plumbing -------------------------------------------------
 
@@ -491,11 +519,24 @@ class QueryScheduler:
             self._outstanding.add(req)
 
         def _done(_f, req=req, cls=cls):
-            self.admission.release(cls)
+            self.admission.release(cls, tenant=req.tenant)
             with self._out_lock:
                 self._outstanding.discard(req)
 
         req.future.add_done_callback(_done)
+
+    def _maybe_cache(self, req: Request, value: int) -> None:
+        """Offer a freshly-computed exact count to the result cache (the
+        cache applies its own hot-set admission gate). Degraded/cancelled
+        answers are never cacheable."""
+        if not self.results.enabled() or req.degraded or req.cancelled:
+            return
+        key = (req.epoch, req.type_name, req.generation, req.f_key,
+               req.auths_key)
+        self.results.put(
+            key, int(value),
+            _flight.plan_hash(req.type_name, req.f_key, req.auths_key),
+            req.cell)
 
     @staticmethod
     def _resolve(req: Request, value) -> None:
@@ -569,6 +610,7 @@ class QueryScheduler:
                                 sorted(self._batch_hist.items())},
             "plan_cache": self.plans.stats(),
             "cover_cache": self.covers.stats(),
+            "result_cache": self.results.stats(),
             "healthy": self.healthy(),
             "admission": self.admission.stats(),
             "breaker": self.breaker.stats(),
@@ -875,7 +917,9 @@ class QueryScheduler:
             r.batched = True
             r.batch_size = len(grp)
             r.scan_s = scan_s
-            self._resolve(r, int(counts[i]) + extras[i])
+            n = int(counts[i]) + extras[i]
+            self._maybe_cache(r, n)
+            self._resolve(r, n)
 
     def _complete_single(self, r: Request) -> None:
         """Fallback execution for plans the fused kernel can't serve (host
@@ -907,4 +951,5 @@ class QueryScheduler:
             self._fail(r, e)
             return
         r.scan_s = _pc() - t0
+        self._maybe_cache(r, int(n))
         self._resolve(r, int(n))

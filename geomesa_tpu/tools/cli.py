@@ -12,7 +12,7 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|admission|wal|replication|workload
+    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|cache|admission|wal|replication|workload
                               [--format prometheus] [--slow MS] [--errors]
                               [--kind K] [--addr HOST:PORT ...] [-s STORE -f NAME -q ECQL]
                               [--id TRACE_ID --fleet]   (debug trace: stitched tree)
@@ -252,7 +252,7 @@ def cmd_debug(args):
     if args.store:
         store = _load(args.store, must_exist=True)
         if args.feature and args.cql:
-            if args.what in ("scheduler", "workload"):
+            if args.what in ("scheduler", "workload", "cache"):
                 ns = store.count_many(args.feature, [args.cql] * 8)
                 print(f"# ran 8x count({args.feature!r}, {args.cql!r}) "
                       f"through the scheduler -> {ns[0]}", file=sys.stderr)
@@ -296,6 +296,17 @@ def cmd_debug(args):
             "gauges": {k: v for k, v in snap["gauges"].items()
                        if k.startswith(("scheduler.", "kernels."))},
         }
+        print(json.dumps(out, indent=2, default=str))
+    elif args.what == "cache":
+        # the hot-result cache: hit/miss/invalidation counters + per-cell
+        # warmth (cross-check against `debug workload` hot cells and the
+        # doctor's hot_skew suspects). With -s/-f/-q the repeated count
+        # warms the cache first, so the dump shows a real hit.
+        out = {}
+        if store is not None:
+            out["result_cache"] = store.scheduler().results.stats()
+        snap = REGISTRY.snapshot_prefixed("result_cache.")
+        out["metrics"] = {k: v for k, v in snap.items() if v}
         print(json.dumps(out, indent=2, default=str))
     elif args.what == "events":
         # the flight recorder: one wide event per query/count/batch, with
@@ -748,7 +759,7 @@ def build_parser() -> argparse.ArgumentParser:
                       "scheduler state, admission/overload state, doctor "
                       "incidents, or the WAL segment inspector")
     sp.add_argument("what", choices=("metrics", "traces", "trace", "events",
-                                     "slo", "kernels", "scheduler",
+                                     "slo", "kernels", "scheduler", "cache",
                                      "admission", "wal", "replication",
                                      "workload", "incidents"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")

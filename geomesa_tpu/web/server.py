@@ -266,6 +266,12 @@ class GeoJsonApi:
             return 200, {"progress": PROGRESS.snapshot()}
         if parts == ["scheduler"]:
             return 200, self.store.scheduler().stats()
+        if parts == ["cache"]:
+            # the hot-result cache surface: counters + per-cell warmth, so
+            # the doctor's hot_skew suspects can be cross-checked against
+            # what is actually cached on this node
+            return 200, {"result_cache":
+                         self.store.scheduler().results.stats()}
         if parts == ["durability"]:
             d = getattr(self.store, "durability", None)
             if d is None:

@@ -1,0 +1,539 @@
+"""Hot-result cache + tenant QoS + cell-affinity routing (ISSUE 12).
+
+The self-optimizing serving loop: hot_set-gated result-cache admission,
+exact invalidation through generations/epochs (primary AND follower), the
+mutation-interleaving staleness property, flight/attribution honesty for
+cache hits (zero device-ms, no double-counting), weighted-fair tenant
+admission with the Zipf tenant-storm drill, consistent hot-cell routing,
+and the web/CLI surfaces."""
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.table import FeatureTable
+from geomesa_tpu.metrics import REGISTRY
+from geomesa_tpu.obs import workload as wl
+from geomesa_tpu.obs.flight import RECORDER, plan_hash
+from geomesa_tpu.obs.workload import WORKLOAD
+from geomesa_tpu.serve.cache import MISS, ResultCache
+from geomesa_tpu.serve.resilience.admission import (AdmissionController,
+                                                    ShedError)
+from geomesa_tpu.serve.router import LocalEndpoint, ReplicaRouter
+from geomesa_tpu.serve.scheduler import QueryScheduler, StoreBinding
+
+DURING = "dtg DURING 2020-01-01T00:00:00Z/2020-02-01T00:00:00Z"
+BOX = f"BBOX(geom, -5, -5, 5, 5) AND {DURING}"
+
+
+@pytest.fixture(autouse=True)
+def _defaults():
+    """Fresh workload plane / recorder and pristine knobs per test."""
+    WORKLOAD.clear()
+    RECORDER.clear()
+    yield
+    for p in (config.RESULT_CACHE_ENABLED, config.RESULT_CACHE_SIZE,
+              config.RESULT_CACHE_MIN_AT_LEAST,
+              config.RESULT_CACHE_HOTSET_TTL_S,
+              config.QOS_ENABLED, config.QOS_TENANT_SHARE,
+              config.QOS_TENANT_MIN, config.QOS_ACTIVE_S,
+              config.AFFINITY_ENABLED, config.AFFINITY_MIN_AT_LEAST,
+              config.ADMIT_INTERACTIVE, config.WORKLOAD_ENABLED):
+        p.unset()
+    wl._enabled_cache[1] = 0
+    WORKLOAD.clear()
+    RECORDER.clear()
+
+
+def _mk_store(n=20_000, seed=3, expiry=None):
+    rng = np.random.default_rng(seed)
+    ds = TpuDataStore()
+    spec = "v:Int,name:String,dtg:Date,*geom:Point"
+    if expiry:
+        spec += f";geomesa.feature.expiry={expiry}"
+    ds.create_schema("t", spec)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    ds.load("t", FeatureTable.build(ds.get_schema("t"), {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "name": rng.choice(["a", "b", "c"], n).astype(object),
+        "dtg": base + rng.integers(0, 30 * 86400000, n),
+        "geom": (rng.uniform(-60, 60, n), rng.uniform(-40, 40, n))}))
+    return ds
+
+
+def _batch(ds, k=10, seed=0, t0="2020-01-10T00:00:00"):
+    rng = np.random.default_rng(seed)
+    base = np.datetime64(t0, "ms").astype(np.int64)
+    data = {
+        "v": rng.integers(0, 100, k).astype(np.int32),
+        "name": rng.choice(["a", "b", "c"], k).astype(object),
+        "dtg": base + rng.integers(0, 86400000, k),
+        "geom": (rng.uniform(-4, 4, k), rng.uniform(-4, 4, k))}
+    for attr in ds.get_schema("t").attributes:  # schema-evolved columns
+        if attr.name not in data:
+            data[attr.name] = np.zeros(k, dtype=np.int32)
+    return FeatureTable.build(ds.get_schema("t"), data)
+
+
+# -- ResultCache unit behavior ------------------------------------------------
+
+
+def test_admission_gated_by_hot_set_at_least():
+    """Cold plans are rejected; a plan the workload plane guarantees hot
+    (at_least >= threshold) admits. Same for hot cells."""
+    config.RESULT_CACHE_MIN_AT_LEAST.set(3)
+    rc = ResultCache(capacity=16, hot_ttl_s=0.0)
+    key = (1, "t", 0, "f", None)
+    assert rc.put(key, 7, "deadbeef", None) is False
+    assert rc.get(key) is MISS
+    assert rc.stats()["rejected_cold"] == 1
+    # make the plan hash hot in the workload plane, then re-offer
+    for _ in range(5):
+        WORKLOAD.offer({"kind": "count.scheduled", "type": "t",
+                        "plan_hash": "deadbeef", "tenant": "x",
+                        "priority": "interactive", "ts_ms": 1e9})
+    assert rc.put(key, 7, "deadbeef", None) is True
+    assert rc.get(key) == 7
+    # cell-hot admission: a DIFFERENT plan over a hot cell also admits
+    for _ in range(5):
+        WORKLOAD.offer({"kind": "count.scheduled", "type": "t",
+                        "plan_hash": "other", "cell": "b6:c21",
+                        "tenant": "x", "priority": "interactive",
+                        "ts_ms": 1e9})
+    rc2 = ResultCache(capacity=16, hot_ttl_s=0.0)
+    assert rc2.put((1, "t", 0, "g", None), 9, "nothot", "b6:c21") is True
+    assert rc2.put((1, "t", 0, "h", None), 9, "nothot", "b6:fff") is False
+
+
+def test_generation_sweep_counts_invalidations_and_cell_warmth():
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    rc = ResultCache(capacity=16)
+    rc.put((1, "t", 0, "a", None), 1, "p", "b6:001")
+    rc.put((1, "t", 0, "b", None), 2, "p", "b6:001")
+    rc.put((1, "u", 0, "a", None), 3, "p", "b6:002")
+    assert rc.stats()["cells"] == {"b6:001": 2, "b6:002": 1}
+    # a newer generation of "t" sweeps t's entries only
+    assert rc.get((1, "t", 1, "a", None)) is MISS
+    s = rc.stats()
+    assert s["invalidations"] == 2 and s["size"] == 1
+    assert s["cells"] == {"b6:002": 1}
+    # a put against a superseded generation is stillborn
+    assert rc.put((1, "t", 0, "a", None), 1, "p", None) is False \
+        or rc.get((1, "t", 0, "a", None)) is MISS
+
+
+def test_lru_bound_holds():
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    rc = ResultCache(capacity=4)
+    for i in range(10):
+        rc.put((1, "t", 0, f"f{i}", None), i, "p", None)
+    s = rc.stats()
+    assert s["size"] == 4
+    assert rc.get((1, "t", 0, "f9", None)) == 9
+    assert rc.get((1, "t", 0, "f0", None)) is MISS
+
+
+# -- scheduled serving path ---------------------------------------------------
+
+
+def test_warm_hit_skips_device_and_is_trace_visible():
+    """Second identical count resolves from memory: no queue/plan/scan
+    spans, a result_cache trace leaf, and a cache="result" flight event
+    with zero device-ms."""
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    ds = _mk_store()
+    try:
+        sched = ds.scheduler()
+        n1 = sched.count("t", BOX)
+        n2 = sched.count("t", BOX)
+        assert n1 == n2
+        st = sched.results.stats()
+        assert st["hits"] == 1 and st["insertions"] >= 1
+        # flight provenance
+        evs = [e for e in RECORDER.recent(10)
+               if e.get("kind") == "count.scheduled"]
+        hits = [e for e in evs if e.get("cache") == "result"]
+        assert len(hits) == 1
+        assert not hits[0]["device_ms"] and not hits[0]["rows_scanned"]
+        assert hits[0]["rows_matched"] == n1
+        # trace visibility: the hit's root trace carries a result_cache
+        # leaf and NO scan leaf
+        root = _trace.RING.recent(1)[0]
+        flat = json.dumps(root)
+        assert "result_cache" in flat and '"scan"' not in flat
+    finally:
+        ds.close()
+
+
+def test_cold_queries_never_pollute_under_default_threshold():
+    ds = _mk_store()
+    try:
+        sched = ds.scheduler()
+        # default MIN_AT_LEAST=3: a one-off query must not insert
+        sched.count("t", BOX)
+        assert sched.results.stats()["size"] == 0
+        assert sched.results.stats()["rejected_cold"] >= 1
+    finally:
+        ds.close()
+
+
+def test_degraded_answers_never_cached():
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    config.BREAKER_DEGRADE.set(True)
+    ds = _mk_store()
+    try:
+        sched = ds.scheduler()
+        # force the breaker open so eligible counts degrade at submit
+        for _ in range(64):
+            sched.breaker.record_failure()
+        n = sched.count("t", BOX)
+        from geomesa_tpu.serve.resilience.degrade import ApproximateCount
+        assert isinstance(n, ApproximateCount)
+        assert sched.results.stats()["size"] == 0
+    finally:
+        config.BREAKER_DEGRADE.unset()
+        ds.close()
+
+
+# -- staleness: the mutation-interleaving property ----------------------------
+
+
+def test_every_mutation_invalidates_interleaved_cached_reads():
+    """Property: interleave append / update / remove / age-off / schema
+    mutations with cached reads — every post-mutation read misses the
+    cache and matches the uncached oracle (store.count, which never
+    touches the scheduler)."""
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    # TTL long enough that the 2020 fixture survives TODAY's load-time
+    # age-off pass; age_off(now_ms=...) below moves the cutoff explicitly
+    ds = _mk_store(expiry="dtg(3000 days)")
+    ttl_ms = 3000 * 86400000
+    now0 = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    try:
+        sched = ds.scheduler()
+        queries = [BOX, f"BBOX(geom, -20, -20, 20, 20) AND {DURING}",
+                   "v < 50"]
+        mutations = [
+            lambda i: ds.load("t", _batch(ds, k=5 + i, seed=i)),
+            lambda i: ds.update_features("t", f"v = {i}", {"v": 100 + i}),
+            lambda i: ds.remove_features("t", f"v = {50 + i}"),
+            lambda i: ds.age_off(
+                "t", now_ms=int(now0 + (1 + i) * 86400000 + ttl_ms)),
+            lambda i: ds.update_schema("t", add_attributes=f"x{i}:Int"),
+        ]
+        for i, mutate in enumerate(mutations * 2):
+            # warm: second read must be a hit (the cache works at all)
+            a = sched.count("t", queries[i % len(queries)])
+            h0 = sched.results.stats()["hits"]
+            assert sched.count("t", queries[i % len(queries)]) == a
+            assert sched.results.stats()["hits"] == h0 + 1
+            mutate(i)
+            m0 = sched.results.stats()["misses"]
+            got = sched.count("t", queries[i % len(queries)])
+            st = sched.results.stats()
+            assert st["misses"] == m0 + 1, \
+                f"post-mutation read {i} served stale cache"
+            oracle = ds.count("t", queries[i % len(queries)])
+            assert got == oracle, f"mutation {i}: {got} != oracle {oracle}"
+    finally:
+        ds.close()
+
+
+def test_follower_applies_invalidate_replica_result_cache(tmp_path):
+    """PR 7 integration: shipped applies bump the follower's generations
+    through the ordinary mutation paths, so the replica's RESULT cache
+    invalidates exactly like the primary's."""
+    from geomesa_tpu.replication import Follower, LogShipper
+    from geomesa_tpu.replication.drills import SPEC, make_batch
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    role0 = _trace.node_role()
+    q = ("BBOX(geom, -5, -5, 8, 8) AND "
+         "dtg DURING 2024-01-01T00:00:00Z/2024-01-02T00:00:00Z")
+    p = TpuDataStore.open(str(tmp_path / "primary"),
+                          params={"wal.fsync": "off"})
+    p.create_schema("t", SPEC)
+    p.load("t", make_batch(p.schemas["t"], 0))
+    ship = LogShipper(p)
+    f = Follower(str(tmp_path / "replica"), ship.address, follower_id="r1")
+    try:
+        assert f.wait_for_seq(p.durability.wal.last_seq)
+        sched = f.store.scheduler()
+        n1 = sched.count("t", q)
+        assert sched.count("t", q) == n1
+        assert sched.results.stats()["hits"] == 1
+        p.load("t", make_batch(p.schemas["t"], 1))
+        p.remove_features("t", "v < 5")
+        assert f.wait_for_seq(p.durability.wal.last_seq)
+        n2 = sched.count("t", q)
+        st = sched.results.stats()
+        assert st["hits"] == 1, "replica served a stale cached result"
+        assert n2 == p.count("t", q)
+    finally:
+        f.close()
+        p.close()
+        # a closed follower's lag gauges age forever (the apply loop no
+        # longer proves freshness) — neutralize them, or every later
+        # doctor/federation test inherits a phantom replication_lag
+        REGISTRY.set_gauge("replication.lag_seqs", lambda: 0)
+        REGISTRY.set_gauge("replication.lag_ms", lambda: 0.0)
+        # and drop this run's repl exemplars: they point at apply traces
+        # evicted long before test_federation's pipeline test looks one up
+        with REGISTRY._lock:
+            for k in ("repl.e2e", "repl.ship_to_apply", "repl.ship_to_ack"):
+                REGISTRY._exemplars.pop(k, None)
+        _trace.set_node_role(role0)
+
+
+# -- attribution honesty ------------------------------------------------------
+
+
+def test_cache_hits_not_double_counted_in_tenant_metering():
+    """Regression: replayed cache hits must not re-bill the original
+    dispatch's device time / rows against the tenant (same pattern as the
+    kind=="batch" drain skip)."""
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    ds = _mk_store()
+    try:
+        sched = ds.scheduler()
+        sched.count("t", BOX, tenant="acme")
+        WORKLOAD.drain()
+        snap = REGISTRY.snapshot()["counters"]
+        dms0 = snap.get("tenant.acme.device_ms", 0.0)
+        rows0 = snap.get("tenant.acme.rows_scanned", 0)
+        q0 = snap.get("tenant.acme.queries", 0)
+        for _ in range(5):
+            assert sched.count("t", BOX, tenant="acme") is not None
+        assert sched.results.stats()["hits"] == 5
+        WORKLOAD.drain()
+        snap = REGISTRY.snapshot()["counters"]
+        # the 5 hits COUNT as queries but bill zero device time / rows
+        assert snap.get("tenant.acme.queries", 0) == q0 + 5
+        assert snap.get("tenant.acme.device_ms", 0.0) == dms0
+        assert snap.get("tenant.acme.rows_scanned", 0) == rows0
+        # and the hit events fold into rollups like any query
+        hits = [e for e in RECORDER.recent(20)
+                if e.get("cache") == "result"]
+        assert len(hits) == 5
+    finally:
+        ds.close()
+
+
+# -- tenant QoS ---------------------------------------------------------------
+
+
+def test_qos_share_caps_noisy_tenant_only_when_others_active():
+    config.QOS_TENANT_SHARE.set(0.5)
+    config.QOS_TENANT_MIN.set(2)
+    ac = AdmissionController(interactive_limit=8)
+    # lone tenant: work-conserving — fills the whole class limit
+    for _ in range(8):
+        ac.admit("interactive", tenant="noisy")
+    with pytest.raises(ShedError) as ei:
+        ac.admit("interactive", tenant="noisy")
+    assert ei.value.tenant is None  # class-limit shed, not QoS
+    for _ in range(8):
+        ac.release("interactive", tenant="noisy")
+    # second tenant becomes active: noisy now capped at share (4)
+    ac.admit("interactive", tenant="victim")
+    for _ in range(4):
+        ac.admit("interactive", tenant="noisy")
+    with pytest.raises(ShedError) as ei:
+        ac.admit("interactive", tenant="noisy")
+    assert ei.value.tenant == "noisy"
+    assert ei.value.retry_after_s > 0
+    # the victim keeps admitting into the protected headroom
+    for _ in range(3):
+        ac.admit("interactive", tenant="victim")
+    s = ac.stats()["qos"]
+    assert s["qos_shed"]["noisy"] >= 1
+    assert s["tenant_in_flight"]["interactive"]["victim"] == 4
+
+
+def test_qos_disabled_restores_fifo_admission():
+    config.QOS_ENABLED.set(False)
+    ac = AdmissionController(interactive_limit=4)
+    ac.admit("interactive", tenant="a")
+    for _ in range(3):
+        ac.admit("interactive", tenant="b")  # over any fair share: fine
+    with pytest.raises(ShedError) as ei:
+        ac.admit("interactive", tenant="b")
+    assert ei.value.tenant is None
+
+
+def test_zipf_tenant_storm_victim_p99_holds():
+    """The tenant-storm drill: one tenant floods ever-cold queries while a
+    victim tenant probes its (hot, cached) query. This is the PR's whole
+    story composed: QoS fair-share sheds the storm at its in-flight share,
+    and the victim's hot probe serves from the result cache — bypassing the
+    contended device — so its p99 holds. (Uncached + un-QoS'd, the same
+    probe degrades >10x; the pure-admission fairness mechanics are pinned
+    in test_qos_share_caps_noisy_tenant_only_when_others_active.)"""
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    config.ADMIT_INTERACTIVE.set(8)
+    config.QOS_TENANT_SHARE.set(0.5)
+    config.QOS_ACTIVE_S.set(10.0)
+    ds = _mk_store()
+    try:
+        sched = ds.scheduler()
+        sched.count("t", BOX, tenant="victim")  # warm the hot probe
+
+        def _probe(k=40):
+            lat = []
+            for _ in range(k):
+                t0 = time.perf_counter()
+                sched.count("t", BOX, tenant="victim", timeout=30)
+                lat.append(time.perf_counter() - t0)
+            return np.percentile(np.array(lat) * 1000.0, 99)
+
+        p99_unloaded = _probe()
+        stop = threading.Event()
+
+        def _storm(tid):
+            # every query unique → permanently cold → sustained device load
+            i = 0
+            while not stop.is_set():
+                try:
+                    sched.count(
+                        "t", f"BBOX(geom, {-10 - tid - i * 1e-4:.4f}, -10, "
+                             f"{10 + tid}, 10) AND {DURING}",
+                        tenant="noisy", timeout=30)
+                except ShedError:
+                    pass
+                i += 1
+
+        storms = [threading.Thread(target=_storm, args=(t,), daemon=True)
+                  for t in range(8)]
+        for th in storms:
+            th.start()
+        try:
+            time.sleep(0.1)  # let the storm saturate its share
+            p99_storm = _probe()
+        finally:
+            stop.set()
+            for th in storms:
+                th.join(timeout=10)
+        qos = sched.admission.stats()["qos"]
+        assert qos["qos_shed"].get("noisy", 0) > 0, \
+            "the storm was never fair-share shed"
+        assert "victim" not in qos["qos_shed"]
+        assert sched.results.stats()["hits"] >= 80  # probes served warm
+        # 2x-with-floor: both sides are sub-ms cache serves, so the floor
+        # absorbs GIL jitter; the floor itself is ~10x below the UNPROTECTED
+        # storm p99 (~1s), so it still proves isolation
+        assert p99_storm <= max(2 * p99_unloaded, 100.0), \
+            (p99_storm, p99_unloaded)
+    finally:
+        ds.close()
+
+
+# -- cell-affinity routing ----------------------------------------------------
+
+
+def test_affinity_pins_hot_cell_to_one_healthy_endpoint():
+    config.AFFINITY_MIN_AT_LEAST.set(0)  # every cell counts as hot
+    a, b = _mk_store(n=2000, seed=1), _mk_store(n=2000, seed=1)
+    try:
+        router = ReplicaRouter([LocalEndpoint("a", a),
+                                LocalEndpoint("b", b)])
+        firsts = {router.candidates(cell="b6:c21")[0].name
+                  for _ in range(8)}
+        assert len(firsts) == 1  # consistent across rotation state
+        # strong stays primary-only — affinity never sneaks a replica in
+        # (no LogShipper here, so no primary: strong must refuse, not pin)
+        from geomesa_tpu.serve.router import NoEndpointAvailable
+        with pytest.raises(NoEndpointAvailable):
+            router.candidates("strong", cell="b6:c21")
+        assert router.stats()["affinity_pins"] >= 8
+        # routed counts concentrate on the pinned endpoint
+        pinned = firsts.pop()
+        c0 = REGISTRY.snapshot()["counters"].get(f"router.served.{pinned}", 0)
+        for _ in range(4):
+            router.count("t", BOX)
+        assert REGISTRY.snapshot()["counters"].get(
+            f"router.served.{pinned}", 0) >= c0 + 4
+    finally:
+        a.close()
+        b.close()
+
+
+def test_affinity_never_overrides_demotion_and_cold_cells_rotate():
+    config.AFFINITY_MIN_AT_LEAST.set(0)
+    a, b = _mk_store(n=2000, seed=1), _mk_store(n=2000, seed=1)
+    try:
+        router = ReplicaRouter([LocalEndpoint("a", a),
+                                LocalEndpoint("b", b)])
+        pinned = router.candidates(cell="b6:c21")[0]
+        other = [e for e in router.endpoints.values()
+                 if e is not pinned][0]
+        # demote the pinned endpoint (draining counts as demoted)
+        pinned.store.scheduler().admission.drain(True)
+        router.probe_all(force=True)
+        cands = router.candidates(cell="b6:c21")
+        assert cands[0] is other and cands[-1].name == pinned.name
+        pinned.store.scheduler().admission.drain(False)
+        # affinity off: rotation varies the first endpoint again
+        config.AFFINITY_ENABLED.set(False)
+        router.probe_all(force=True)
+        firsts = {router.candidates(cell="b6:c21")[0].name
+                  for _ in range(8)}
+        assert len(firsts) == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_router_stamps_cells_from_cql():
+    a = _mk_store(n=2000, seed=1)
+    try:
+        router = ReplicaRouter([LocalEndpoint("a", a)])
+        from geomesa_tpu.filter.parser import parse_ecql
+        from geomesa_tpu.serve.scheduler import _query_cell
+        assert router._query_cell(BOX) == _query_cell(parse_ecql(BOX))
+        assert router._query_cell("v < 5") is None
+        assert router._query_cell("NONSENSE(((") is None
+    finally:
+        a.close()
+
+
+# -- surfaces -----------------------------------------------------------------
+
+
+def test_web_cache_route_and_explain_provenance():
+    config.RESULT_CACHE_MIN_AT_LEAST.set(0)
+    from geomesa_tpu.web import serve
+    ds = _mk_store(n=5000)
+    httpd = serve(ds, port=0, background=True)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        q = urllib.parse.quote(BOX)
+        for _ in range(2):
+            with urllib.request.urlopen(
+                    f"{base}/types/t/count?cql={q}") as r:
+                assert r.status == 200
+        with urllib.request.urlopen(f"{base}/cache") as r:
+            body = json.loads(r.read())
+        rc = body["result_cache"]
+        assert rc["hits"] >= 1 and rc["size"] >= 1 and rc["cells"]
+        # explain overlays live result-cache provenance (peek only)
+        out = ds.explain("t", BOX, analyze=True)
+        assert out["analyze"]["provenance"]["result_cache"] == "hit"
+        assert rc["hits"] == ds.scheduler().results.stats()["hits"], \
+            "explain must not skew serving hit rates"
+    finally:
+        httpd.shutdown()
+        ds.close()
+
+
+def test_cli_debug_cache(capsys):
+    from geomesa_tpu.tools.cli import main
+    assert main(["debug", "cache"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert "metrics" in payload

@@ -84,9 +84,16 @@ def test_wide_event_per_direct_count(store):
 
 def test_wide_event_per_scheduled_count(store):
     q = "BBOX(geom, -6, -6, 6, 6)"
-    n1 = store.count_coalesced("obs_t", q)
-    RECORDER.clear()
-    n2 = store.count_coalesced("obs_t", q)  # second pass: plan cache hit
+    # the repeat pass must REACH the dispatch boundary (its wide event pins
+    # rows_scanned / batch_id), not resolve from the hot-result cache
+    store.scheduler().results.clear()
+    config.RESULT_CACHE_ENABLED.set(False)
+    try:
+        n1 = store.count_coalesced("obs_t", q)
+        RECORDER.clear()
+        n2 = store.count_coalesced("obs_t", q)  # second pass: plan cache hit
+    finally:
+        config.RESULT_CACHE_ENABLED.unset()
     assert n1 == n2
     evs = RECORDER.recent(kind="count.scheduled")
     assert evs, "a scheduled count must emit one wide event"

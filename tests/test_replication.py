@@ -131,6 +131,10 @@ def test_generations_bump_on_replica_like_primary(tmp_path):
     replica's serving caches invalidate exactly as the primary's do."""
     p, ship = _primary(tmp_path)
     f = Follower(str(tmp_path / "replica"), ship.address)
+    # this test pins PLAN-cache invalidation: keep the hot-result cache
+    # out so the repeat count consults the plan cache (tests/test_cache.py
+    # covers the follower-side result-cache invalidation)
+    config.RESULT_CACHE_ENABLED.set(False)
     try:
         f.wait_for_seq(p.durability.wal.last_seq)
         sched = f.store.scheduler()
@@ -144,6 +148,7 @@ def test_generations_bump_on_replica_like_primary(tmp_path):
         n2 = sched.count("t", BBOX_Q)
         assert n2 == p.count("t", BBOX_Q)  # not the stale cached plan
     finally:
+        config.RESULT_CACHE_ENABLED.unset()
         f.close()
         p.close()
 

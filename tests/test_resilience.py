@@ -360,7 +360,10 @@ def test_breaker_opens_on_dispatch_failures_then_degrades(store):
     config.RETRY_ATTEMPTS.set(1)       # every failure reaches the breaker
     config.BREAKER_THRESHOLD.set(2)
     config.BREAKER_COOLDOWN_MS.set(60_000)
-    s = QueryScheduler(StoreBinding(store), flush_size=4, window_us=200)
+    # result_cache=0: the repeated BOX count must REACH the faulty
+    # dispatch boundary, not resolve from the hot-result cache
+    s = QueryScheduler(StoreBinding(store), flush_size=4, window_us=200,
+                       result_cache=0)
     try:
         s.count("t", BOX)  # warm + prove healthy
         faults.arm_serve_error("sched.dispatch", n=2)
@@ -390,7 +393,8 @@ def test_breaker_half_open_recovers_through_probes(store):
     config.BREAKER_COOLDOWN_MS.set(50)
     config.BREAKER_PROBES.set(1)
     config.BREAKER_DEGRADE.set(False)  # fail fast instead of degrading
-    s = QueryScheduler(StoreBinding(store), flush_size=4, window_us=200)
+    s = QueryScheduler(StoreBinding(store), flush_size=4, window_us=200,
+                       result_cache=0)
     try:
         ref = s.count("t", BOX)
         faults.arm_serve_error("sched.dispatch", n=1)
@@ -452,10 +456,17 @@ def test_killed_completer_fails_outstanding_futures(store):
 def test_store_replaces_unhealthy_scheduler(store):
     s = store.scheduler()
     ref = s.count("t", BOX)
+    # the probe submit must travel through the (crashing) collector, not
+    # resolve from the hot-result cache
+    s.results.clear()
+    config.RESULT_CACHE_ENABLED.set(False)
     faults.arm_serve_crash("sched.collect", at=1)
     req = s.submit("t", BOX)
-    with pytest.raises(SchedulerCrashed):
-        req.result(timeout=2.0)
+    try:
+        with pytest.raises(SchedulerCrashed):
+            req.result(timeout=2.0)
+    finally:
+        config.RESULT_CACHE_ENABLED.unset()
     faults.reset()
     s2 = store.scheduler()          # a fresh, healthy scheduler
     assert s2 is not s and s2.healthy()

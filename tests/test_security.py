@@ -197,6 +197,11 @@ def test_plan_cache_keyed_by_auths(store):
     q = "BBOX(geom, -50, -50, 50, 50)"
     expect = {tuple(a): int(_visible(vis, list(a)).sum())
               for a in ((), ("admin",), ("admin", "ops"))}
+    # the warm passes must exercise the PLAN cache — keep the hot-result
+    # cache out of the way (its own auths keying: tests/test_cache.py)
+    from geomesa_tpu import config
+    sched.results.clear()
+    config.RESULT_CACHE_ENABLED.set(False)
     try:
         # cold pass (fills the cache per auths), then two warm passes that
         # must hit the cache and still answer per-context
@@ -211,6 +216,7 @@ def test_plan_cache_keyed_by_auths(store):
         cached_auth_keys = {k[-1] for k in sched.plans._d}
         assert {(), ("admin",), ("admin", "ops"), None} <= cached_auth_keys
     finally:
+        config.RESULT_CACHE_ENABLED.unset()
         sched.shutdown()
         ds._scheduler = None
 
