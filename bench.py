@@ -244,7 +244,7 @@ def main(args=None) -> int:
 
     n = int(os.environ.get("GEOMESA_TPU_BENCH_N", 100_000_000))
     reps = int(os.environ.get("GEOMESA_TPU_BENCH_REPS", 20))
-    default_configs = "0,1,2,3,4,5,6,7,8,9"
+    default_configs = "0,1,2,3,4,5,6,7,8,9,10"
     if args.mini:
         from geomesa_tpu import config as _gcfg
         n = min(n, int(_gcfg.BENCH_MINI_N.get()))
@@ -1248,6 +1248,233 @@ def main(args=None) -> int:
             _cfg.RESULT_CACHE_ENABLED.unset()
             _cfg.ADMIT_INTERACTIVE.unset()
             sched9.shutdown()
+
+    if "10" in configs:
+        import threading as _th
+
+        from geomesa_tpu import config as _cfg
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.obs.flight import RECORDER as _flight10
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress10
+
+        # a floor of 600k rows: below it the full rebuild is so cheap on
+        # host that the merge-vs-full ratio measures python overhead, not
+        # the O(n) vs O(delta) asymmetry the gate pins
+        n10 = max(min(n, 1_000_000), 600_000)
+        if n10 <= n:
+            x10, y10, dtg10 = x[:n10], y[:n10], dtg[:n10]
+        else:
+            x10 = rng.uniform(-180, 180, n10)
+            y10 = rng.uniform(-90, 90, n10)
+            base10 = np.datetime64("2020-01-01T00:00:00",
+                                   "ms").astype(np.int64)
+            dtg10 = base10 + rng.integers(0, 30 * 86400000, n10)
+        n_base10 = int(n10 * 0.97)
+        n_delta10 = n10 - n_base10  # ~3% delta flush (the ≤10% regime)
+        spec10 = "dtg:Date,*geom:Point;geomesa.z3.interval=week"
+        q10 = (f"BBOX(geom, {qx0}, {qy0}, {qx1}, {qy1}) AND dtg "
+               "DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
+
+        try:
+            # keep the delta pending so the flush below is the timed one
+            _cfg.LSM_MAX_FRACTION.set(1.0)
+            _cfg.MERGE_BUILD.set(True)
+            _cfg.SHARD_SORT.set(False)  # measured separately in (b)
+            st10 = TpuDataStore()
+            st10.create_schema("inc", spec10)
+            sft10 = st10.get_schema("inc")
+            st10.load("inc", FeatureTable.build(
+                sft10, {"dtg": dtg10[:n_base10],
+                        "geom": (x10[:n_base10], y10[:n_base10])}))
+            old10 = st10.planners["inc"].indexes[0]
+            icls10 = type(old10)
+            st10.load("inc", FeatureTable.build(
+                sft10, {"dtg": dtg10[n_base10:],
+                        "geom": (x10[n_base10:], y10[n_base10:])}))
+            assert st10.deltas["inc"] is not None, "delta flushed early"
+
+            # (a) incremental merge-build vs full rebuild of the primary
+            # index over the SAME merged table (2 reps, min — rep one
+            # carries jit compiles on both sides)
+            merged10 = FeatureTable.concat([st10.tables["inc"],
+                                            st10.deltas["inc"]])
+            merged10.fids  # materialize once, like a settled table
+            icls10(sft10, merged10)                        # warm full
+            icls10.merge_from(old10, merged10, n_base10)   # warm merge
+            full_b, merge_b = [], []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                icls10(sft10, merged10)
+                full_b.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                icls10.merge_from(old10, merged10, n_base10)
+                merge_b.append(time.perf_counter() - t0)
+            speedup10 = min(full_b) / max(1e-9, min(merge_b))
+            detail["cfg10_n"] = n10
+            detail["cfg10_delta_fraction"] = round(n_delta10 / n_base10, 3)
+            detail["cfg10_full_build_s"] = round(min(full_b), 3)
+            detail["cfg10_merge_build_s"] = round(min(merge_b), 3)
+            detail["cfg10_incremental_speedup"] = round(speedup10, 1)
+            assert speedup10 >= 5.0, \
+                (f"incremental merge build {min(merge_b):.3f}s not 5x "
+                 f"under full rebuild {min(full_b):.3f}s")
+            # the real store flush through the merge path, checked exact
+            # against a brute-force host count
+            t0 = time.perf_counter()
+            st10.flush("inc")
+            detail["cfg10_merge_flush_s"] = round(time.perf_counter() - t0,
+                                                  3)
+            assert st10.count("inc", q10) == cpu_query(x10, y10, dtg10)
+
+            # (b) mesh-sharded sort vs single-device sort (exactness always;
+            # the speedup is a perfwatch-gated metric on >=2-device meshes)
+            if len(jax.devices()) >= 2:
+                from geomesa_tpu.index.spatial import device_sort_perm
+                from geomesa_tpu.parallel import dist as _dist
+                kb10 = rng.integers(0, 1 << 14, n10).astype(np.int32)
+                k110 = rng.integers(0, 1 << 21, n10).astype(np.int32)
+                k210 = rng.integers(0, 1 << 21, n10).astype(np.int32)
+                planes10 = [kb10, k110, k210]
+                _cfg.SHARD_SORT.set(True)
+                _cfg.SHARD_SORT_MIN.set(1)
+
+                def _mesh10():
+                    return np.asarray(_dist.mesh_sort_perm(
+                        [p.copy() for p in planes10]))
+
+                perm_mesh = _mesh10()  # warm (compiles)
+                mesh_sort_s = min(_time_reps(_mesh10, 2))
+                _cfg.SHARD_SORT.set(False)
+
+                def _single10():
+                    return np.asarray(device_sort_perm(planes10))
+
+                perm_single = _single10()  # warm
+                single_sort_s = min(_time_reps(_single10, 2))
+                ref10 = np.lexsort(tuple(reversed(planes10)))
+                assert np.array_equal(perm_mesh, ref10.astype(np.int32))
+                assert np.array_equal(perm_single, ref10.astype(np.int32))
+                detail["cfg10_shard_sort_devices"] = len(
+                    _dist.shard_devices())
+                detail["cfg10_single_sort_s"] = round(single_sort_s, 3)
+                detail["cfg10_mesh_sort_s"] = round(mesh_sort_s, 3)
+                detail["cfg10_shard_sort_speedup"] = round(
+                    single_sort_s / max(1e-9, mesh_sort_s), 2)
+
+            # (c) ingest-while-serving: Zipf counts + sustained appends
+            # DURING a background build-then-swap reindex; serving p99 must
+            # hold within 2x steady-state (no install cliff)
+            _cfg.SHARD_SORT.unset()
+            n_shapes10 = 40
+            shapes10 = [
+                f"BBOX(geom, {qx0 + (i % 8) * 0.5:.2f}, "
+                f"{qy0 + (i // 8) * 0.5:.2f}, "
+                f"{qx1 + (i % 8) * 0.5:.2f}, {qy1 + (i // 8) * 0.5:.2f}) "
+                "AND dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z"
+                for i in range(n_shapes10)]
+            wz10 = 1.0 / (np.arange(n_shapes10) + 1) ** 1.1
+            draw10 = rng.choice(n_shapes10, size=4096, p=wz10 / wz10.sum())
+            for s10 in shapes10:
+                st10.count("inc", s10)  # warm plans/kernels
+
+            def _probe10(k: int, i0: int = 0) -> list:
+                lat = []
+                for i in range(k):
+                    t0 = time.perf_counter()
+                    st10.count("inc", shapes10[draw10[(i0 + i)
+                                                      % len(draw10)]])
+                    lat.append(time.perf_counter() - t0)
+                return lat
+
+            k10 = 150 if args.mini else 400
+            stop10 = _th.Event()
+
+            def _ingest10() -> None:
+                i = 0
+                while not stop10.is_set():
+                    st10.load("inc", FeatureTable.build(
+                        st10.get_schema("inc"),
+                        {"dtg": dtg[:2000], "geom": (x[:2000], y[:2000])}))
+                    i += 1
+                    time.sleep(0.05)
+
+            def _spawn_ingest10() -> "_th.Thread":
+                t = _th.Thread(target=_ingest10, daemon=True)
+                t.start()
+                return t
+
+            # steady-state is measured WITH the ingest stream running so
+            # the gate isolates the reindex build's effect on serving,
+            # not the (constant) cost of concurrent appends
+            ing10 = _spawn_ingest10()
+            try:
+                p99_steady = float(np.percentile(
+                    np.asarray(_probe10(k10)) * 1000.0, 99))
+            finally:
+                stop10.set()
+                ing10.join(timeout=60)
+            # settle the delta accumulated during the steady window and
+            # re-warm the shapes on the settled table: a table swap
+            # changes the padded kernel shapes, and the first query after
+            # one pays a jit compile — that flush-time cost exists with
+            # or without reindex, so it must not pollute either window
+            st10.flush("inc")
+            for s10 in shapes10:
+                st10.count("inc", s10)
+            st10.reindex("inc")
+            # let the worker pass its (no-op: empty delta) entry flush
+            # before restarting ingest, so the during-probe window holds
+            # one table generation until the swap_install itself
+            for _ in range(400):
+                if _flight10.recent(limit=None, kind="reindex"):
+                    break
+                time.sleep(0.005)
+            stop10.clear()
+            ing10 = _spawn_ingest10()
+            lat_during = []
+            try:
+                while st10._reindex_threads["inc"].is_alive():
+                    lat_during.extend(_probe10(20, i0=len(lat_during)))
+            finally:
+                stop10.set()
+                ing10.join(timeout=60)
+            st10._reindex_threads["inc"].join(timeout=300)
+            rs10 = st10.reindex_status("inc")
+            assert rs10["state"] == "installed", rs10
+            p99_during = float(np.percentile(
+                np.asarray(lat_during) * 1000.0, 99)) \
+                if lat_during else p99_steady
+            detail["cfg10_reindex_s"] = rs10["seconds"]
+            detail["cfg10_reindex_rows"] = rs10["rows"]
+            detail["cfg10_serving_p99_steady_ms"] = round(p99_steady, 3)
+            detail["cfg10_serving_p99_during_reindex_ms"] = round(
+                p99_during, 3)
+            detail["cfg10_serving_queries_during_reindex"] = len(lat_during)
+            # 2x steady with a 40ms absolute floor: at mini scale both
+            # sides sit at host-jitter latencies (~3-5ms) where the probes
+            # share cores AND the GIL with the host-side build thread, so
+            # the raw ratio is scheduler noise (observed up to ~22ms p99
+            # on a loaded host with NO cliff) — a real install cliff
+            # (mid-build table swap, cold kernel recompile) measures
+            # 200-1000ms and still fails this loudly; the perfwatch
+            # baseline on cfg10_serving_p99_during_reindex_ms tracks the
+            # finer-grained trend
+            assert p99_during <= max(2.0 * p99_steady, 40.0), \
+                (p99_during, p99_steady)
+
+            # phase-breakdown artifact (CI uploads it): every recent build/
+            # reindex phase with durations + throughput
+            phases10 = [e for e in _progress10.snapshot()["recent"]
+                        if e.get("op") in ("index_build", "reindex")]
+            with open(os.path.join(REPO, "BENCH_reindex_phases.json"),
+                      "w") as fh:
+                json.dump({"phases": phases10,
+                           "reindex_status": rs10}, fh, indent=1)
+        finally:
+            _cfg.MERGE_BUILD.unset()
+            _cfg.LSM_MAX_FRACTION.unset()
+            _cfg.SHARD_SORT.unset()
+            _cfg.SHARD_SORT_MIN.unset()
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

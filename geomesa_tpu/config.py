@@ -623,6 +623,59 @@ AFFINITY_MIN_AT_LEAST = _register(
     "plane guarantees (at_least) this many hits on it in the current "
     "window. 0 pins every cell (useful in tests).")
 
+# -- incremental / mesh-parallel index builds + online reindex (ISSUE 13) -----
+
+MERGE_BUILD = _register(
+    "GEOMESA_TPU_MERGE_BUILD", True, _parse_bool,
+    "Master switch for delta-incremental merge builds: an LSM delta-tier "
+    "flush merges the already-sorted resident run with the freshly-sorted "
+    "delta run (merge-by-key; block metadata rebuilt from the merge, not "
+    "a re-sort) instead of re-sorting the full table. Destructive paths "
+    "(remove/update/upsert-collision/age-off drops/schema change) always "
+    "fall back to a full rebuild.")
+
+MERGE_MAX_FRACTION = _register(
+    "GEOMESA_TPU_MERGE_MAX_FRACTION", 0.25, float,
+    "Largest delta-to-resident row fraction the merge build accepts; a "
+    "flush above it (bulk load through the delta tier) takes the full "
+    "rebuild, whose O(n log n) sort amortizes better at that scale.")
+
+SHARD_SORT = _register(
+    "GEOMESA_TPU_SHARD_SORT", True, _parse_bool,
+    "Master switch for the mesh-sharded index-key sort: shards the build "
+    "sort across jax.devices() (per-shard lax.sort + sample splitter "
+    "exchange + per-partition merge sort), falling back to the "
+    "single-device sort on a 1-device mesh. Bitwise-identical permutation "
+    "either way.")
+
+SHARD_SORT_MIN = _register(
+    "GEOMESA_TPU_SHARD_SORT_MIN", 500_000, int,
+    "Row threshold for the mesh-sharded sort: below it the splitter "
+    "exchange + cross-device copies cost more than the single-device "
+    "sort saves.")
+
+SHARD_SORT_DEVICES = _register(
+    "GEOMESA_TPU_SHARD_SORT_DEVICES", 0, int,
+    "Device count for the mesh-sharded sort (0 = every local device). "
+    "1 disables sharding regardless of GEOMESA_TPU_SHARD_SORT.")
+
+SHARD_SORT_SAMPLES = _register(
+    "GEOMESA_TPU_SHARD_SORT_SAMPLES", 64, int,
+    "Sorted-key samples drawn per shard for the splitter exchange; more "
+    "samples = better partition balance at a few KB extra download.")
+
+REINDEX_THROTTLE_MS = _register(
+    "GEOMESA_TPU_REINDEX_THROTTLE_MS", 0.0, float,
+    "Sleep between background-reindex build stages, yielding the device "
+    "and the GIL to serving queries. 0 builds flat out.")
+
+REINDEX_SNAPSHOT = _register(
+    "GEOMESA_TPU_REINDEX_SNAPSHOT", True, _parse_bool,
+    "Write a durability snapshot right after a reindex generation "
+    "installs (when the store is durable), so followers converge to the "
+    "rebuilt generation through the ordinary snapshot catch-up path "
+    "instead of waiting for the next threshold crossing.")
+
 
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property

@@ -141,6 +141,10 @@ class TpuDataStore:
         # data it described has changed. Monotonic per NAME — it survives
         # remove_schema so a re-created type can't resurrect stale plans.
         self._generations: Dict[str, int] = {}
+        # online build-then-swap reindex bookkeeping: per-type status dicts
+        # plus the background worker threads (joinable by tests/shutdown)
+        self._reindex_status: Dict[str, dict] = {}
+        self._reindex_threads: Dict[str, object] = {}
         # incarnation epoch: salts scheduler cache keys (see _next_epoch)
         self.epoch = _next_epoch()
         self._scheduler = None  # lazy QueryScheduler (serve/scheduler.py)
@@ -321,15 +325,20 @@ class TpuDataStore:
             # land its cached sketches against the merged table)
             _metrics.inc("ingest.flushes")
             self.deltas[type_name] = None
+            n_old = len(current)
             merged = FeatureTable.concat([current, merged_delta])
             merged, n_exp = self._apply_age_off(type_name, merged)
             if n_exp:
                 # checkpointed sketches describe rows age-off just dropped —
                 # re-observe rather than restore an overcounting battery
                 stats_cached = None
-            self.tables[type_name] = merged
             with _trace.span("ingest.index_build", kind="aggregate"):
-                self._rebuild_indexes(type_name, stats_cached)
+                # age-off drops invalidate the resident sorted run's row
+                # identity — only a clean append merges incrementally
+                if n_exp or not self._merge_rebuild(type_name, merged, n_old,
+                                                    stats_cached):
+                    self.tables[type_name] = merged
+                    self._rebuild_indexes(type_name, stats_cached)
         else:
             _metrics.inc("ingest.delta_appends")
             # stat sketches stay main-table-only while a delta is pending
@@ -350,12 +359,18 @@ class TpuDataStore:
                              type=type_name):
                 self._bump_generation(type_name)
                 self.deltas[type_name] = None
-                merged = FeatureTable.concat([self.tables[type_name], delta])
+                current = self.tables[type_name]
+                n_old = len(current)
+                merged = FeatureTable.concat([current, delta])
                 # dtg age-off rides the flush (≙ compaction-time age-off
                 # iterators): rows whose TTL lapsed since ingest drop here
-                merged, _ = self._apply_age_off(type_name, merged)
-                self.tables[type_name] = merged
-                self._rebuild_indexes(type_name)
+                merged, n_exp = self._apply_age_off(type_name, merged)
+                # a pure append merges the sorted delta run into the
+                # resident sorted run; age-off drops force a full rebuild
+                if n_exp or not self._merge_rebuild(type_name, merged,
+                                                    n_old):
+                    self.tables[type_name] = merged
+                    self._rebuild_indexes(type_name)
 
     def upsert(self, type_name: str, batch: FeatureTable) -> int:
         """Atomic put-by-fid: remove existing rows whose fids collide with
@@ -501,12 +516,15 @@ class TpuDataStore:
             rows = rows[np.isin(delta.visibility.codes[rows], allowed)]
         return rows
 
-    def _rebuild_indexes(self, type_name: str,
-                         stats_cached: Optional[dict] = None) -> None:
+    def _build_planner(self, type_name: str, table: FeatureTable,
+                       stats_cached: Optional[dict] = None):
+        """Construct a fresh (planner, stats) pair over ``table`` WITHOUT
+        touching store state — the pure build half of build-then-swap. Safe
+        to run off-lock against a captured table (background reindex); the
+        caller installs the result under the lock."""
         from geomesa_tpu.stats.store import GeoMesaStats
 
         sft = self.schemas[type_name]
-        table = self.tables[type_name]
         names = sft.configured_indices
         indexes: List[object] = []
         for c in INDEX_CLASSES:
@@ -534,6 +552,11 @@ class TpuDataStore:
             stats.cached = stats_cached  # checkpoint restore
         else:
             stats.update(table)  # ≙ statUpdater flush on write
+        return planner, stats
+
+    def _install_planner(self, type_name: str, table: FeatureTable,
+                         planner, stats) -> None:
+        """Swap a fully-built planner in (callers hold the lock)."""
         self._stats[type_name] = stats
         self.planners[type_name] = planner
         from geomesa_tpu.index import prune as _prune
@@ -541,6 +564,196 @@ class TpuDataStore:
         _metrics.set_gauge(f"store.rows.{type_name}", len(table))
         _metrics.set_gauge(f"store.index_blocks.{type_name}",
                            -(-len(table) // _prune.BLOCK_SIZE))
+
+    def _rebuild_indexes(self, type_name: str,
+                         stats_cached: Optional[dict] = None) -> None:
+        table = self.tables[type_name]
+        planner, stats = self._build_planner(type_name, table, stats_cached)
+        self._install_planner(type_name, table, planner, stats)
+
+    def _merge_rebuild(self, type_name: str, merged: FeatureTable,
+                       n_old: int,
+                       stats_cached: Optional[dict] = None) -> bool:
+        """Incremental flush: merge the freshly-sorted delta run into each
+        resident index's already-sorted run (index.merge_from) instead of
+        re-sorting the whole table. Returns False when ineligible — caller
+        falls back to the full rebuild. Callers hold the lock and have NOT
+        yet installed ``merged`` into self.tables."""
+        from geomesa_tpu import config
+        if not config.MERGE_BUILD.get():
+            return False
+        n_new = len(merged)
+        n_delta = n_new - n_old
+        if n_old <= 0 or n_delta <= 0:
+            return False
+        if n_delta > config.MERGE_MAX_FRACTION.get() * max(1, n_old):
+            return False  # big deltas amortize better through a full sort
+        old_planner = self.planners.get(type_name)
+        current = self.tables.get(type_name)
+        if old_planner is None or current is None or len(current) != n_old:
+            return False
+        sft = self.schemas[type_name]
+        from geomesa_tpu.index.attribute import indexed_attributes
+        if indexed_attributes(sft):
+            # attribute indexes sort by value, not append order — a suffix
+            # delta is not a sorted run for them, so no incremental path
+            return False
+        old_indexes = getattr(old_planner, "indexes", None) or []
+        for old in old_indexes:
+            if getattr(type(old), "merge_from", None) is None:
+                return False
+            if getattr(old, "table", None) is not current:
+                return False  # stale planner (shouldn't happen under lock)
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        from geomesa_tpu.stats.store import GeoMesaStats
+        with _trace.span("ingest.merge_build", kind="aggregate",
+                         type=type_name):
+            indexes = [type(old).merge_from(old, merged, n_old)
+                       for old in old_indexes]
+            stats = GeoMesaStats(sft)
+            timeout = sft.user_data.get("geomesa.query.timeout")
+            planner = QueryPlanner(
+                sft, merged, indexes, stats=stats,
+                interceptors=self._interceptors.setdefault(type_name, []),
+                audit=self.audit,
+                timeout_ms=float(timeout) if timeout else None)
+            stats.planner = planner
+            old_stats = self._stats.get(type_name)
+            if stats_cached is not None:
+                stats.cached = stats_cached  # checkpoint restore
+            elif old_stats is not None and \
+                    getattr(old_stats, "cached", None) is not None:
+                # carry the pre-flush battery: it under-describes only the
+                # delta rows (≤ MERGE_MAX_FRACTION) — the same bounded drift
+                # readers already accept while a delta run is pending
+                stats.cached = old_stats.cached
+            else:
+                stats.update(merged)
+            self.tables[type_name] = merged
+            self._install_planner(type_name, merged, planner, stats)
+        _metrics.inc("ingest.merge_builds")
+        return True
+
+    # -- online build-then-swap reindex --------------------------------------
+
+    def reindex(self, type_name: str, background: bool = True):
+        """Rebuild the type's indexes OFF the serving path and atomically
+        swap the new generation in (build-then-swap made explicit — the
+        maintenance analogue of the reference's offline reindex jobs).
+        Readers keep querying the old planner until the install instant;
+        the generation bump invalidates every (epoch, type, generation)-
+        keyed serving cache for free. ``background=True`` returns
+        immediately with a status dict; the worker thread is joinable via
+        ``self._reindex_threads[type_name]``."""
+        if type_name not in self.schemas:
+            raise KeyError(type_name)
+        if not background:
+            self._reindex_run(type_name)
+            return self.reindex_status(type_name)
+        import threading
+        with self._lock:
+            t = self._reindex_threads.get(type_name)
+            if t is not None and t.is_alive():
+                return self.reindex_status(type_name)  # already running
+            self._reindex_status[type_name] = {"state": "running",
+                                               "attempts": 0}
+            t = threading.Thread(target=self._reindex_run,
+                                 args=(type_name,),
+                                 name=f"reindex-{type_name}", daemon=True)
+            self._reindex_threads[type_name] = t
+        t.start()
+        return self.reindex_status(type_name)
+
+    def reindex_status(self, type_name: str) -> dict:
+        with self._lock:
+            st = dict(self._reindex_status.get(type_name,
+                                               {"state": "idle"}))
+            t = self._reindex_threads.get(type_name)
+            st["running"] = bool(t is not None and t.is_alive())
+            return st
+
+    def _reindex_run(self, type_name: str, max_retries: int = 3) -> None:
+        import time as _time
+
+        from geomesa_tpu import config
+        from geomesa_tpu.metrics import REGISTRY as _metrics
+        from geomesa_tpu.obs.flight import RECORDER as _flight
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress
+        throttle = max(0.0, config.REINDEX_THROTTLE_MS.get()) / 1000.0
+        status = {"state": "running", "attempts": 0}
+        with self._lock:
+            self._reindex_status[type_name] = status
+        t0 = _time.perf_counter()
+        try:
+            for attempt in range(1, max_retries + 1):
+                status["attempts"] = attempt
+                # land any pending delta first so the rebuilt generation
+                # covers every row readers can currently see
+                self.flush(type_name)
+                with self._lock:
+                    base_table = self.tables.get(type_name)
+                if base_table is None:
+                    status["state"] = "failed"
+                    status["error"] = "no table"
+                    return
+                _flight.record({"kind": "reindex", "type": type_name,
+                                "phase": "build_started",
+                                "rows": len(base_table),
+                                "attempt": attempt})
+                if throttle:
+                    _time.sleep(throttle)  # yield to serving traffic
+                # the expensive part runs entirely OFF-lock against the
+                # captured immutable table — queries proceed unimpeded
+                planner, stats = self._build_planner(type_name, base_table)
+                if throttle:
+                    _time.sleep(throttle)
+                with self._lock:
+                    if self.tables.get(type_name) is not base_table:
+                        # a concurrent flush/upsert swapped the table while
+                        # we built — this generation describes stale rows;
+                        # discard and retry against the new table
+                        _metrics.inc("reindex.aborts")
+                        _flight.record({"kind": "reindex",
+                                        "type": type_name,
+                                        "phase": "aborted",
+                                        "attempt": attempt})
+                        continue
+                    with _progress.phase("swap_install",
+                                         rows=len(base_table),
+                                         op="reindex",
+                                         type_name=type_name):
+                        self._install_planner(type_name, base_table,
+                                              planner, stats)
+                        self._bump_generation(type_name)
+                    gen = self._generations.get(type_name, 0)
+                status["state"] = "installed"
+                status["generation"] = gen
+                status["rows"] = len(base_table)
+                status["seconds"] = round(_time.perf_counter() - t0, 3)
+                _metrics.inc("reindex.installs")
+                _flight.record({"kind": "reindex", "type": type_name,
+                                "phase": "installed", "generation": gen,
+                                "rows": len(base_table),
+                                "attempt": attempt,
+                                "seconds": status["seconds"]})
+                # ship the rebuilt generation fleet-wide: a fresh snapshot
+                # makes follower catch-up land it byte-identically
+                if self.durability is not None and \
+                        config.REINDEX_SNAPSHOT.get():
+                    try:
+                        self.durability.snapshot()
+                    except Exception:  # noqa: BLE001 - snapshot is advisory
+                        pass
+                return
+            status["state"] = "aborted"
+            status["seconds"] = round(_time.perf_counter() - t0, 3)
+        except Exception as e:  # noqa: BLE001 - surfaced via status
+            status["state"] = "failed"
+            status["error"] = f"{type(e).__name__}: {e}"
+            status["seconds"] = round(_time.perf_counter() - t0, 3)
+            _metrics.inc("reindex.failures")
+            _flight.record({"kind": "reindex", "type": type_name,
+                            "phase": "failed", "error": status["error"]})
 
     def _fid_counter(self, type_name: str) -> int:
         with self._lock:  # read-modify-write: two writers must never share a fid

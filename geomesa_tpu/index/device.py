@@ -156,6 +156,106 @@ class DeviceTable:
         cols = gather(unsorted, dev_perm)
         return cls(len(table), cols)
 
+    @classmethod
+    def merge_scatter(cls, old: "DeviceTable",
+                      delta_planes: Dict[str, np.ndarray],
+                      r: np.ndarray,
+                      stale=(),
+                      full_codes: Optional[Dict[str, np.ndarray]] = None,
+                      perm_pair=None,
+                      host_perm: Optional[np.ndarray] = None):
+        """Incremental merge of ``old``'s sorted columns with a sorted delta
+        run (the device half of the LSM merge build).
+
+        ``r[j]`` = merged rank of sorted-delta row j among the resident rows
+        (count of resident keys ≤ the delta key — residents win ties), host
+        int, non-decreasing. The resident shift is derived ON DEVICE from
+        ``r`` (searchsorted against iota), so per column only the
+        delta-sized values cross the host link — never the resident side.
+
+        ``stale`` columns (dictionary codes whose vocab changed under the
+        union-vocab concat) can't reuse the resident device codes; they
+        rebuild from ``full_codes`` via one full-length gather through
+        ``host_perm`` (host merge) or the merged device perm. ``perm_pair``
+        = (old device perm, delta perm values) merges the permutation as
+        one more int32 column. Returns (DeviceTable, merged device perm or
+        None)."""
+        import jax
+
+        from geomesa_tpu.obs import attrib as _attrib
+
+        n_old = old.n
+        n_delta = len(r)
+        n_new = n_old + n_delta
+        full_codes = full_codes or {}
+
+        names = [k for k in old.columns
+                 if k in delta_planes and k not in stale]
+        old_cols = {k: old.columns[k] for k in names}
+        delta_cols = {
+            k: jnp.asarray(np.ascontiguousarray(
+                np.asarray(delta_planes[k], dtype=old.columns[k].dtype)))
+            for k in names}
+        if perm_pair is not None:
+            old_cols["__perm__"] = perm_pair[0]
+            delta_cols["__perm__"] = jnp.asarray(
+                np.asarray(perm_pair[1], dtype=np.int32))
+        r32 = jnp.asarray(np.asarray(r, dtype=np.int32))
+        _attrib.record_transfer(
+            "device_table.merge_scatter", 1,
+            sum(int(np.asarray(delta_planes[k]).nbytes) for k in names)
+            + int(r32.nbytes)
+            + sum(int(v.nbytes) for v in full_codes.values()))
+
+        key = (n_old, n_delta,
+               tuple(sorted((k, str(v.dtype)) for k, v in old_cols.items())))
+        fn = _merge_cache().get(
+            key, lambda: _build_merge_scatter(n_old, n_delta))
+        out = fn(old_cols, delta_cols, r32)
+        new_perm = out.pop("__perm__", None)
+
+        for name in stale:
+            codes = full_codes[name]
+            if host_perm is not None:
+                out[name] = jnp.asarray(codes[host_perm])
+            else:
+                g = _merge_cache().get(
+                    ("stale_gather", n_new, str(codes.dtype)),
+                    lambda: jax.jit(lambda c, p: c[p]))
+                out[name] = g(jnp.asarray(codes), new_perm)
+        return cls(n_new, out), new_perm
+
+
+_MERGE_CACHE = None
+
+
+def _merge_cache():
+    # lazy: index.scan imports are deferred so device.py stays import-light
+    global _MERGE_CACHE
+    if _MERGE_CACHE is None:
+        from geomesa_tpu.index.scan import ModuleKernelCache
+        _MERGE_CACHE = ModuleKernelCache("build.merge_scatter")
+    return _MERGE_CACHE
+
+
+def _build_merge_scatter(n_old: int, n_delta: int):
+    import jax
+
+    def fn(old_cols, delta_cols, r):
+        shift = jnp.searchsorted(
+            r, jnp.arange(n_old, dtype=jnp.int32),
+            side="right").astype(jnp.int32)
+        pos_res = jnp.arange(n_old, dtype=jnp.int32) + shift
+        pos_del = r + jnp.arange(n_delta, dtype=jnp.int32)
+        out = {}
+        for k, o in old_cols.items():
+            d = delta_cols[k]
+            buf = jnp.zeros((n_old + n_delta,) + tuple(o.shape[1:]), o.dtype)
+            out[k] = buf.at[pos_res].set(o).at[pos_del].set(d)
+        return out
+
+    return jax.jit(fn)
+
 
 def host_planes(table: FeatureTable,
                 period: Optional[TimePeriod] = None,

@@ -485,6 +485,47 @@ def _register_kernel_gauge() -> None:
 _KERNEL_GAUGE_REGISTERED = False
 
 
+class ModuleKernelCache:
+    """Bounded LRU for module-level jitted kernels (sort / gather / merge).
+
+    The build-path jits in ``index/spatial.py`` used to live in module
+    globals keyed by nothing — one padded-shape compile pinned forever, and
+    a long-running ingester visiting many pow2 tiers accumulated them all.
+    Routing them through this cache bounds residency by
+    ``GEOMESA_TPU_KERNEL_CACHE`` (shape-keyed entries, LRU eviction) and —
+    because instances register in ``_KERNEL_INSTANCES`` exactly like
+    ``ScanKernels`` — counts them in the ``kernels.compiled`` gauge and the
+    recompile detector."""
+
+    def __init__(self, kernel_id: str):
+        self.kernel_id = kernel_id
+        from collections import OrderedDict
+        self._jitted: "OrderedDict[tuple, Callable]" = OrderedDict()
+        self._sig_seen: Dict[str, set] = {}
+        _KERNEL_INSTANCES.add(self)
+        _register_kernel_gauge()
+
+    def get(self, key: tuple, builder):
+        """Return the cached kernel for ``key`` or build+insert it.
+
+        ``builder`` is a zero-arg callable returning the jitted fn; it runs
+        only on a miss. Eviction drops the least-recently-used shape — an
+        evicted shape simply recompiles on next use."""
+        hit = self._jitted.get(key)
+        if hit is not None:
+            self._jitted.move_to_end(key)
+            return hit
+        jitted = builder()
+        if _prof.enabled():
+            _prof.note_signature(self._sig_seen, self.kernel_id, key)
+        self._jitted[key] = jitted
+        from geomesa_tpu import config
+        lru_cap = max(1, config.KERNEL_CACHE.get())
+        while len(self._jitted) > lru_cap:
+            self._jitted.popitem(last=False)
+        return jitted
+
+
 class ScanKernels:
     """Compiled-scan cache for one DeviceTable (one index).
 

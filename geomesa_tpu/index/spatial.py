@@ -34,7 +34,9 @@ from geomesa_tpu.filter import extract, ir
 from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
 from geomesa_tpu.index.api import IndexScanPlan
 from geomesa_tpu.index.device import DeviceTable, fp62_lat, fp62_lon, host_planes
-from geomesa_tpu.index.scan import ScanKernels, pad_boxes, pad_windows, split_residual, compile_residual
+from geomesa_tpu.index.scan import (ModuleKernelCache, ScanKernels, pad_boxes,
+                                    pad_windows, split_residual,
+                                    compile_residual)
 
 # Above this row count the index-key sort and row reorder run on the
 # accelerator (3×21-bit int32 key planes through lax.sort + one fused gather)
@@ -52,7 +54,9 @@ def __getattr__(name: str):
 _MASK21 = (1 << 21) - 1
 
 
-def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int):
+def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int,
+                          key_names: Optional[List[str]] = None,
+                          shard_devices=None):
     """Chunked native encode overlapped with host→device upload.
 
     ≙ the latency-hiding of the reference's ``AbstractBatchScan`` pipeline
@@ -62,15 +66,28 @@ def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int):
     overlap instead of summing. Per-plane chunks concatenate ON DEVICE
     (transient ~2x HBM for the planes, freed before the sort gather).
 
+    With ``shard_devices`` (≥2) the sort-key planes of chunk i additionally
+    land round-robin on ``shard_devices[i % D]`` so the mesh-sharded sort
+    starts with its inputs already distributed — upload and shard-sort
+    pipeline instead of re-scattering after a single-device concat. The
+    sort-only planes (zhi/zlo) then skip the default device entirely.
+
     ``encode_chunk(lo, hi)`` → plane dict or None (native decline).
-    Returns ({plane: device array}, [host-kept chunk dicts]) or None when
-    any chunk declines — the caller falls back to the single-shot path.
+    Returns ({plane: device array}, [host-kept chunk dicts], key_shards)
+    where key_shards is None without sharding, else a per-device list of
+    ``(row_offset, [key plane arrays])`` chunks; returns None when any
+    chunk declines — the caller falls back to the single-shot path.
     """
     import queue
     import threading
 
     import jax
     import jax.numpy as jnp
+
+    sharding = (shard_devices is not None and len(shard_devices) >= 2
+                and key_names is not None)
+    key_shards: Optional[List[list]] = \
+        [[] for _ in shard_devices] if sharding else None
 
     q: "queue.Queue" = queue.Queue(maxsize=2)
     uploaded: List[dict] = []
@@ -86,9 +103,19 @@ def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int):
                 return
             if state["error"] is not None:
                 continue
+            off, enc = item
             try:
-                uploaded.append({k: jax.device_put(v)
-                                 for k, v in item.items()})
+                if sharding:
+                    d = (off // chunk_rows) % len(shard_devices)
+                    key_shards[d].append((off, [
+                        jax.device_put(enc[k], shard_devices[d])
+                        for k in key_names]))
+                    uploaded.append({k: jax.device_put(v)
+                                     for k, v in enc.items()
+                                     if k not in ("zhi", "zlo")})
+                else:
+                    uploaded.append({k: jax.device_put(v)
+                                     for k, v in enc.items()})
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 state["error"] = e
 
@@ -109,7 +136,7 @@ def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int):
             host_kept.append({k: enc[k] for k in ("z", "bin16")
                               if k in enc})
             enc.pop("z", None)
-            q.put(enc)
+            q.put((a, enc))
     finally:
         q.put(None)
         th.join()
@@ -120,7 +147,7 @@ def _stream_encode_upload(encode_chunk, n: int, chunk_rows: int):
     dev = {k: (uploaded[0][k] if len(uploaded) == 1
                else jnp.concatenate([u[k] for u in uploaded]))
            for k in uploaded[0]}
-    return dev, host_kept
+    return dev, host_kept, key_shards
 
 
 def _split63(v: np.ndarray) -> List[np.ndarray]:
@@ -143,29 +170,40 @@ def _sort_perm_fn(ks):
     return out[-1]
 
 
-_sort_perm_jit = None
+# Build-path jit caches: previously bare module globals that pinned one
+# compilation per padded signature forever; now bounded shape-keyed LRUs
+# (GEOMESA_TPU_KERNEL_CACHE) counted in the kernels.compiled gauge.
+_SORT_PERM_CACHE = ModuleKernelCache("build.sort_perm")
+_ROW_GATHER_CACHE = ModuleKernelCache("build.row_gather")
+_SORT_GATHER_CACHE = ModuleKernelCache("build.sort_gather")
 
 
 def _sort_perm(padded_keys):
-    """Module-level jit (one compilation per padded signature, shared across
-    every index build in the process — the per-call-closure version re-traced
-    on each build)."""
-    global _sort_perm_jit
-    if _sort_perm_jit is None:
-        import jax
-        _sort_perm_jit = jax.jit(_sort_perm_fn)
-    return _sort_perm_jit(tuple(padded_keys))
+    """Shape-keyed jit shared across every index build in the process (the
+    per-call-closure version re-traced on each build); one cache entry per
+    (plane count, padded length) signature."""
+    import jax
+    key = (len(padded_keys), int(padded_keys[0].shape[0]))
+    fn = _SORT_PERM_CACHE.get(key, lambda: jax.jit(_sort_perm_fn))
+    return fn(tuple(padded_keys))
 
 
-def device_sort_perm(keys: List[np.ndarray]):
+def device_sort_perm(keys: List[np.ndarray], type_name: Optional[str] = None):
     """Sort permutation computed on device from int32 key planes.
 
+    On a multi-device mesh (and above GEOMESA_TPU_SHARD_SORT_MIN rows) the
+    sort shards across devices (parallel.dist.mesh_sort_perm) — bitwise the
+    same permutation; a 1-device mesh takes the single-device path below.
     Keys are padded to a power of two with int32-max sentinels (shared jit
     signatures across sizes).
     """
     import jax.numpy as jnp
 
     n = len(keys[0])
+    from geomesa_tpu.parallel import dist as _dist
+    if _dist.mesh_sort_enabled(n):
+        return _dist.mesh_sort_perm([np.ascontiguousarray(k) for k in keys],
+                                    type_name=type_name)
     cap = 1 << max(0, (n - 1)).bit_length()
     padded = []
     for k in keys:
@@ -175,24 +213,20 @@ def device_sort_perm(keys: List[np.ndarray]):
     return _sort_perm(padded)[:n]
 
 
-_ROW_GATHER_JIT = None
-
-
 def _row_gather(dev_perm, idx: np.ndarray) -> np.ndarray:
     """Gather table rows for sorted positions on device (pow2-padded so
     compilations and transfer shapes are shared across result sizes)."""
-    global _ROW_GATHER_JIT
     import jax
     import jax.numpy as jnp
 
-    if _ROW_GATHER_JIT is None:
-        _ROW_GATHER_JIT = jax.jit(lambda p, i: p[i])
     if len(idx) == 0:
         return np.empty(0, dtype=np.int64)
     cap = max(8, 1 << max(0, len(idx) - 1).bit_length())
     pad = np.zeros(cap, np.int32)
     pad[: len(idx)] = idx
-    out = np.asarray(_ROW_GATHER_JIT(dev_perm, jnp.asarray(pad)))
+    key = (int(dev_perm.shape[0]), cap)
+    fn = _ROW_GATHER_CACHE.get(key, lambda: jax.jit(lambda p, i: p[i]))
+    out = np.asarray(fn(dev_perm, jnp.asarray(pad)))
     return out[: len(idx)].astype(np.int64)
 
 
@@ -207,20 +241,16 @@ def _as_query_column(name: str, gathered, xp):
     return name, gathered
 
 
-_native_sort_gather_jit = None
-
-
 def _native_sort_gather(keys, cols, n: int):
     """One fused device program: sort padded keys → perm, gather every query
-    column through it, cast bin16 → int32. Module-level jit keyed by
-    (shapes, n) so repeated builds share compilations."""
-    global _native_sort_gather_jit
-    if _native_sort_gather_jit is None:
-        import functools
+    column through it, cast bin16 → int32. Cached per (shapes, n) signature
+    so repeated builds share compilations without pinning every size tier."""
+    import functools
 
-        import jax
-        import jax.numpy as jnp
+    import jax
+    import jax.numpy as jnp
 
+    def build():
         @functools.partial(jax.jit, static_argnames=("n",))
         def fn(keys, cols, n):
             cap = 1 << max(0, (n - 1)).bit_length()
@@ -237,8 +267,34 @@ def _native_sort_gather(keys, cols, n: int):
                     out[out_name] = g
             return perm, out
 
-        _native_sort_gather_jit = fn
-    return _native_sort_gather_jit(keys, cols, n)
+        return fn
+
+    key = (n, len(keys),
+           tuple(sorted((name, str(v.dtype)) for name, v in cols.items())))
+    return _SORT_GATHER_CACHE.get(key, build)(keys, cols, n)
+
+
+def _perm_gather_cols(dev_perm, cols, n: int):
+    """Gather query columns through an already-computed device permutation
+    (the mesh-sharded sort path, where the perm comes from
+    parallel.dist.mesh_sort_perm instead of the fused sort_gather program)."""
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        def fn(perm, cols):
+            out = {}
+            for name, v in cols.items():
+                out_name, g = _as_query_column(name, v[perm], jnp)
+                if out_name is not None:
+                    out[out_name] = g
+            return out
+
+        return jax.jit(fn)
+
+    key = ("perm_gather", n,
+           tuple(sorted((name, str(v.dtype)) for name, v in cols.items())))
+    return _SORT_GATHER_CACHE.get(key, build)(dev_perm, cols)
 
 
 def _strip_handled(f: ir.Filter, geom: Optional[str], dtg: Optional[str],
@@ -290,6 +346,20 @@ def _boxes_fp62(boxes) -> np.ndarray:
     return out
 
 
+class _DeltaKeyShim:
+    """Minimal stand-in passed to an index class's ``_sort_keys`` to compute
+    a delta run's key planes without building a full index over the delta
+    table (``_sort_keys`` reads table/sft/dtg/period/geom and writes its key
+    arrays — ``_z``/``_xz``/``_bins``/``_sfc`` — onto ``self``)."""
+
+    def __init__(self, sft, table, geom, dtg, period):
+        self.sft = sft
+        self.table = table
+        self.geom = geom
+        self.dtg = dtg
+        self.period = period
+
+
 class BaseSpatialIndex:
     """Shared machinery: device table, kernels, plan construction."""
 
@@ -317,7 +387,8 @@ class BaseSpatialIndex:
                     k.dtype == np.int32 for k in keys):
                 with _progress.phase("device_sort", rows=n,
                                      type_name=sft.name):
-                    self._dev_perm = device_sort_perm(keys)
+                    self._dev_perm = device_sort_perm(keys,
+                                                      type_name=sft.name)
                 with _progress.phase("upload_gather", rows=n,
                                      type_name=sft.name):
                     self.device = DeviceTable.build_on_device(
@@ -448,29 +519,37 @@ class BaseSpatialIndex:
             return None
         import time as _time
         from geomesa_tpu.obs.profiling import PROGRESS as _progress
+        from geomesa_tpu.parallel import dist as _dist
+        shard_devices = _dist.shard_devices() \
+            if _dist.mesh_sort_enabled(n) else None
         t0 = _time.perf_counter()
         with _progress.phase("encode_upload", rows=n,
                              type_name=self.sft.name):
-            res = _stream_encode_upload(encode_chunk, n, chunk)
+            res = _stream_encode_upload(encode_chunk, n, chunk,
+                                        key_names=key_names,
+                                        shard_devices=shard_devices)
         if res is None:
             return False
-        dev, host_kept = res
+        dev, host_kept, key_shards = res
         self._z = np.concatenate([h["z"] for h in host_kept])
         if "bin16" in host_kept[0]:
             self._bins = np.concatenate([h["bin16"] for h in host_kept])
         self.build_stages = {"encode_upload_overlap_s": round(
             _time.perf_counter() - t0, 2)}
-        self._finish_native(dev, key_names, extra)
+        self._finish_native(dev, key_names, extra, key_shards=key_shards)
         return True
 
     def _finish_native(self, enc: dict, key_names: List[str],
-                       extra: Dict[str, np.ndarray]) -> None:
+                       extra: Dict[str, np.ndarray],
+                       key_shards=None) -> None:
         """Upload native-encoded planes, sort on device, gather.
 
         ``enc``: native encode output; ``key_names``: sort-key entries of
         ``enc`` major→minor (padded host-side to a power of two with max
         sentinels so jit signatures are shared per size tier); ``extra``:
-        remaining host planes (attributes, visibility)."""
+        remaining host planes (attributes, visibility); ``key_shards``:
+        key planes already distributed across the sort mesh by the streamed
+        upload (round-robin chunks) — triggers the mesh-sharded sort."""
         import jax
         import jax.numpy as jnp
 
@@ -491,6 +570,11 @@ class BaseSpatialIndex:
                 if out_name is not None:
                     cols[out_name] = jnp.asarray(g)
             self.device = DeviceTable(n, cols)
+            return
+
+        from geomesa_tpu.parallel import dist as _dist
+        if key_shards is not None or _dist.mesh_sort_enabled(n):
+            self._finish_native_mesh(upload, key_names, key_shards, n)
             return
 
         keys = [upload.pop(name) if name in ("zhi", "zlo") else upload[name]
@@ -524,6 +608,217 @@ class BaseSpatialIndex:
             "sort_gather_s": round(t2 - t1, 2)})
         self.device = DeviceTable(n, cols)
         self._prefetch_perm()
+
+    def _finish_native_mesh(self, upload: dict, key_names: List[str],
+                            key_shards, n: int) -> None:
+        """Mesh-sharded variant of the native finish: the sort permutation
+        comes from parallel.dist.mesh_sort_perm (per-shard lax.sort +
+        splitter exchange + per-partition merge), then the query columns
+        gather through it on the default device. Bitwise the same
+        permutation as the single-device program."""
+        import time as _time
+
+        import jax
+
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress
+        from geomesa_tpu.parallel import dist as _dist
+
+        stages: Dict[str, float] = {}
+        if key_shards is not None:
+            # streamed path: key planes are already shard-resident; zhi/zlo
+            # never touched the default device
+            upload.pop("zhi", None)
+            upload.pop("zlo", None)
+            perm = _dist.mesh_sort_perm(shards=key_shards, n=n,
+                                        type_name=self.sft.name,
+                                        stages=stages)
+        else:
+            planes = [np.asarray(upload.pop(name)) if name in ("zhi", "zlo")
+                      else np.asarray(upload[name]) for name in key_names]
+            perm = _dist.mesh_sort_perm(planes, type_name=self.sft.name,
+                                        stages=stages)
+        t0 = _time.perf_counter()
+        with _progress.phase("upload", rows=n, type_name=self.sft.name):
+            dev_cols = {k: jax.device_put(v) for k, v in upload.items()}
+            jax.block_until_ready(list(dev_cols.values()))
+        t1 = _time.perf_counter()
+        with _progress.phase("upload_gather", rows=n,
+                             type_name=self.sft.name):
+            self._dev_perm = perm
+            cols = _perm_gather_cols(perm, dev_cols, n)
+            jax.block_until_ready(self._dev_perm)
+        t2 = _time.perf_counter()
+        mb = sum(v.nbytes for v in upload.values()) / 1e6
+        self.build_stages = dict(getattr(self, "build_stages", {}))
+        self.build_stages.update(stages)
+        self.build_stages.update({
+            "upload_s": round(t1 - t0, 2), "upload_mb": round(mb, 1),
+            "mesh_gather_s": round(t2 - t1, 2)})
+        self.device = DeviceTable(n, cols)
+        self._prefetch_perm()
+
+    # incremental merge builds ----------------------------------------------
+
+    @classmethod
+    def merge_from(cls, old: "BaseSpatialIndex", merged_table: FeatureTable,
+                   n_old: int) -> "BaseSpatialIndex":
+        """Incremental (LSM-merge) build: ``merged_table`` = ``old.table``
+        followed by ``n_delta`` appended rows. Instead of re-sorting all
+        ``n_old + n_delta`` keys, sort only the delta run, rank it into the
+        resident sorted run (per-bin searchsorted — only the touched bin
+        segments are walked), and scatter both runs into the merged layout:
+        host block metadata by direct placement, device columns through one
+        merge-scatter program that moves only delta-sized data over the
+        host link. The result is bitwise identical (perm, sorted planes,
+        device columns) to a full rebuild, because the merged order equals
+        the stable lexsort of the concatenated keys: residents keep their
+        relative order, delta rows keep theirs, and ties go to residents
+        (smaller original row index)."""
+        from geomesa_tpu.obs.profiling import PROGRESS as _progress
+
+        import time as _time
+
+        n_new = len(merged_table)
+        n_delta = n_new - n_old
+        sft = old.sft
+
+        self = cls.__new__(cls)
+        self.sft = sft
+        self.table = merged_table
+        self.geom = old.geom
+        self.dtg = old.dtg
+        self.period = old.period
+        self._perm_cache = None
+        self._dev_perm = None
+        self._bin_segs = None
+
+        with _progress.phase("merge", rows=n_new, type_name=sft.name):
+            t0 = _time.perf_counter()
+            delta_table = merged_table.take(
+                np.arange(n_old, n_new, dtype=np.int64))
+            shim = _DeltaKeyShim(sft, delta_table, old.geom, old.dtg,
+                                 old.period)
+            keys_d = cls._sort_keys(shim)
+            if hasattr(shim, "_sfc"):
+                self._sfc = shim._sfc
+
+            touched_bins = 0
+            if keys_d is None:
+                # natural order (FullScanIndex): delta appends after residents
+                p_d = np.arange(n_delta, dtype=np.int64)
+                r = np.full(n_delta, n_old, dtype=np.int64)
+            else:
+                p_d = np.lexsort(tuple(reversed(keys_d))).astype(np.int64)
+                z_d = getattr(shim, "_z", None)
+                xz_d = getattr(shim, "_xz", None)
+                sec_d = np.asarray(z_d if z_d is not None else xz_d)
+                sec_sorted_d = sec_d[p_d]
+                bins_d = getattr(shim, "_bins", None)
+                old_sec = old.sorted_z if z_d is not None else old.sorted_xz
+                if bins_d is not None:
+                    bins_d = np.asarray(bins_d)
+                    bins_sorted_d = bins_d[p_d]
+                    old_bins = old.sorted_bins
+                    r = np.empty(n_delta, dtype=np.int64)
+                    ub = np.unique(bins_sorted_d)
+                    touched_bins = len(ub)
+                    for b in ub:
+                        ds = np.searchsorted(bins_sorted_d, b, side="left")
+                        de = np.searchsorted(bins_sorted_d, b, side="right")
+                        rs = np.searchsorted(old_bins, b, side="left")
+                        re_ = np.searchsorted(old_bins, b, side="right")
+                        r[ds:de] = rs + np.searchsorted(
+                            old_sec[rs:re_], sec_sorted_d[ds:de],
+                            side="right")
+                else:
+                    r = np.searchsorted(old_sec, sec_sorted_d,
+                                        side="right").astype(np.int64)
+
+            # merged positions: resident i shifts by the count of delta rows
+            # ranked at-or-before it; delta j lands right after its rank
+            shift = np.searchsorted(r, np.arange(n_old, dtype=np.int64),
+                                    side="right")
+            pos_res = np.arange(n_old, dtype=np.int64) + shift
+            pos_del = r + np.arange(n_delta, dtype=np.int64)
+
+            if keys_d is not None:
+                if z_d is not None:
+                    self._z = np.concatenate([np.asarray(old._z), sec_d])
+                else:
+                    self._xz = np.concatenate([np.asarray(old._xz), sec_d])
+                sorted_sec = np.empty(n_new, dtype=old_sec.dtype)
+                sorted_sec[pos_res] = old_sec
+                sorted_sec[pos_del] = sec_sorted_d
+                setattr(self, "_sorted_z" if z_d is not None else
+                        "_sorted_xz", sorted_sec)
+                if bins_d is not None:
+                    self._bins = np.concatenate(
+                        [np.asarray(old._bins), bins_d])
+                    sorted_bins = np.empty(n_new, dtype=old_bins.dtype)
+                    sorted_bins[pos_res] = old_bins
+                    sorted_bins[pos_del] = bins_sorted_d
+                    self._sorted_bins = sorted_bins
+
+            # permutation: merged on device when the resident perm is
+            # device-resident (avoids an O(n_old) download), else on host
+            perm_pair = None
+            if old._perm_cache is None and old._dev_perm is not None:
+                perm_pair = (old._dev_perm,
+                             (n_old + p_d).astype(np.int32))
+            else:
+                new_perm = np.empty(n_new, dtype=np.int64)
+                new_perm[pos_res] = old.perm
+                new_perm[pos_del] = n_old + p_d
+                self._perm_cache = new_perm
+
+            # dictionary columns whose vocab grew under the union-vocab
+            # concat: resident device codes are invalid — rebuild those
+            # columns from the merged full plane (everything else merges
+            # with delta-sized uploads only)
+            merged_vocabs = {
+                name: col.vocab
+                for name, col in merged_table.columns.items()
+                if isinstance(col, StringColumn)}
+            stale = set()
+            full_codes: Dict[str, np.ndarray] = {}
+            for name in old.device.columns:
+                if name in merged_vocabs \
+                        and old.vocabs.get(name) != merged_vocabs[name]:
+                    stale.add(name)
+                    full_codes[name] = np.asarray(
+                        merged_table.columns[name].codes, dtype=np.int32)
+            old_vis = old.table.visibility
+            new_vis = merged_table.visibility
+            if new_vis is not None and (
+                    "__vis__" not in old.device.columns
+                    or old_vis is None or old_vis.vocab != new_vis.vocab):
+                stale.add("__vis__")
+                full_codes["__vis__"] = np.asarray(new_vis.codes,
+                                                   dtype=np.int32)
+
+            # device columns live in SORTED order — gather the delta planes
+            # into delta-sorted order so pos_del scatters rows against the
+            # right keys
+            delta_planes = {k: np.asarray(v)[p_d]
+                            for k, v in host_planes(delta_table,
+                                                    old.period).items()}
+            self.device, new_dev_perm = DeviceTable.merge_scatter(
+                old.device, delta_planes, r, stale=stale,
+                full_codes=full_codes, perm_pair=perm_pair,
+                host_perm=self._perm_cache)
+            if new_dev_perm is not None:
+                self._dev_perm = new_dev_perm
+
+            self.kernels = ScanKernels(self.device.columns)
+            self.vocabs = merged_vocabs
+            self.build_stages = {
+                "merge_s": round(_time.perf_counter() - t0, 3),
+                "merge_rows": int(n_delta),
+                "merge_fraction": round(n_delta / max(1, n_old), 4),
+                "merge_touched_bins": int(touched_bins),
+                "merge_stale_cols": sorted(stale),
+            }
+        return self
 
     @classmethod
     def supports(cls, sft) -> bool:

@@ -54,7 +54,7 @@ KEEP_SAMPLES = 12
 # -- metric directions --------------------------------------------------------
 
 _HIGHER = ("_qps", "_per_s", "_per_chip", "_mbps", "_hit_rate",
-           "_gb_per_s", "upload_mbps")
+           "_gb_per_s", "upload_mbps", "_speedup")
 _EXACT = ("_matched", "_mass", "_pairs", "_blocks", "_submitted")
 _LOWER = ("_ms", "_s", "_us", "_bytes", "_kb", "_pct", "_seconds",
           "_slop", "_fraction")

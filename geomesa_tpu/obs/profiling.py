@@ -35,10 +35,14 @@ path (everything lands at compile/build time):
 
   build phase progress
       ``PROGRESS.phase(...)`` wraps the long-running index-build stages
-      (encode/upload/sort) with row throughput; live phases and a bounded
-      history surface at ``GET /progress``, finished phases emit
+      (encode/upload/sort, plus the mesh-parallel/incremental stages
+      ``shard_sort`` / ``splitter_exchange`` / ``merge`` and the online
+      reindex's ``swap_install``) with row throughput; live phases and a
+      bounded history surface at ``GET /progress``, finished phases emit
       ``progress`` flight events and ``build.<phase>`` registry timers,
       and ``explain`` carries the owning index's stage breakdown.
+      Background reindex runs set ``op="reindex"`` and additionally emit
+      ``reindex`` flight events (build_started/aborted/installed/failed).
 
 A deterministic fault hook (``arm_kernel_handicap``) stretches matching
 kernels' device time by a factor — the regression gate's self-test

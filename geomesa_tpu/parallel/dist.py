@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from geomesa_tpu.aggregates.density import density_kernel
-from geomesa_tpu.index.scan import PRIMARY_FNS, _time_mask
+from geomesa_tpu.index.scan import ModuleKernelCache, PRIMARY_FNS, _time_mask
 from geomesa_tpu.parallel.mesh import ShardedTable
 
 
@@ -167,3 +167,203 @@ class DistributedScan:
 
         fn = self._fn(key, build)
         return np.asarray(fn(self.sharded.columns, boxes, windows, rparams))[: self.sharded.n]
+
+
+# -- mesh-sharded index-key sort ---------------------------------------------
+#
+# ≙ the reference's distributed write path: each tablet server sorts its own
+# key range and the split points define the ranges (SNIPPETS partitioner
+# pattern). Here: per-shard lax.sort of the key planes (+ a row-id plane so
+# ties break on original row order, exactly like the single-device program's
+# iota tie-break), a sample-based splitter exchange on the host, per-shard
+# lexicographic partition counts on device, then a per-partition merge sort
+# on the partition's owner device. Partitioning is by KEY ONLY (rows with
+# equal keys all land in one partition, where the row-id plane orders them),
+# so the concatenated result is bitwise identical to a single stable sort.
+
+_I32_MAX = np.iinfo(np.int32).max
+
+_MESH_SORT_CACHE = ModuleKernelCache("build.mesh_sort")
+
+
+def shard_devices():
+    """Devices participating in the mesh-sharded sort
+    (GEOMESA_TPU_SHARD_SORT_DEVICES caps the count; 0 = all local)."""
+    from geomesa_tpu import config
+    devs = jax.devices()
+    cap = config.SHARD_SORT_DEVICES.get()
+    if cap and cap > 0:
+        devs = devs[:cap]
+    return devs
+
+
+def mesh_sort_enabled(n: int) -> bool:
+    """True when the mesh-sharded sort should run for an n-row build."""
+    from geomesa_tpu import config
+    if not config.SHARD_SORT.get():
+        return False
+    if n < config.SHARD_SORT_MIN.get():
+        return False
+    return len(shard_devices()) >= 2
+
+
+def _sort_jit(nargs: int, cap: int):
+    """Full sort of ``nargs`` equal-length int32 planes, every plane a key
+    (major → minor; the last plane is the row-id tie-break)."""
+    def build():
+        def fn(args):
+            from jax import lax
+            return lax.sort(tuple(args), num_keys=len(args))
+        return jax.jit(fn)
+    return _MESH_SORT_CACHE.get(("sort", nargs, cap), build)
+
+
+def _count_lt_jit(nplanes: int, cap: int, nspl: int):
+    """Per-splitter count of rows with key lexicographically < splitter.
+    Pad rows (all planes int32-max) always compare ≥ any real splitter, so
+    they never count."""
+    def build():
+        def fn(planes, spl):
+            lt = planes[-1][:, None] < spl[-1][None, :]
+            for p, s in zip(reversed(planes[:-1]), reversed(spl[:-1])):
+                lt = (p[:, None] < s[None, :]) \
+                    | ((p[:, None] == s[None, :]) & lt)
+            return jnp.sum(lt, axis=0, dtype=jnp.int32)
+        return jax.jit(fn)
+    return _MESH_SORT_CACHE.get(("count_lt", nplanes, cap, nspl), build)
+
+
+def _pad_sorted(args, cap: int):
+    return [jnp.pad(a, (0, cap - a.shape[0]), constant_values=_I32_MAX)
+            if a.shape[0] < cap else a for a in args]
+
+
+def mesh_sort_perm(planes=None, shards=None, n: Optional[int] = None,
+                   type_name: Optional[str] = None,
+                   stages: Optional[dict] = None):
+    """Stable sort permutation of int32 key planes, sharded across devices.
+
+    Either ``planes`` (host int32 arrays, split contiguously here) or
+    ``shards`` (per-device lists of ``(row_offset, [plane arrays])`` chunks,
+    e.g. from the round-robin streaming upload) supplies the keys. Returns
+    the int32 permutation on the default device — bitwise identical to
+    ``np.lexsort(tuple(reversed(planes)))``.
+    """
+    import time as _time
+
+    from geomesa_tpu import config
+    from geomesa_tpu.obs.profiling import PROGRESS as _progress
+
+    devs = shard_devices()
+    ndev = len(devs)
+    if planes is not None:
+        from geomesa_tpu.parallel.mesh import shard_spans
+        n = len(planes[0])
+        nplanes = len(planes)
+        shards = [[(off, [jax.device_put(p[off:off + m], devs[i])
+                          for p in planes])]
+                  for i, (off, m) in enumerate(shard_spans(n, ndev))]
+    else:
+        nplanes = len(shards[0][0][1]) if any(shards) else 0
+        for chunks in shards:
+            if chunks:
+                nplanes = len(chunks[0][1])
+                break
+    if stages is None:
+        stages = {}
+    stages["shards"] = ndev
+
+    # phase 1: per-shard stable sort (planes + row-id plane)
+    t0 = _time.perf_counter()
+    shard_sorted = []   # per shard: list of sorted arrays (planes + rowid)
+    shard_valid = []
+    with _progress.phase("shard_sort", rows=n, type_name=type_name):
+        for i in range(ndev):
+            chunks = shards[i] if i < len(shards) else []
+            parts = [[] for _ in range(nplanes + 1)]
+            valid = 0
+            for off, arrs in chunks:
+                m = int(arrs[0].shape[0])
+                valid += m
+                for k in range(nplanes):
+                    parts[k].append(arrs[k])
+                parts[nplanes].append(jax.device_put(
+                    np.arange(off, off + m, dtype=np.int32), devs[i]))
+            if valid == 0:
+                shard_sorted.append(None)
+                shard_valid.append(0)
+                continue
+            args = [p[0] if len(p) == 1 else jnp.concatenate(p)
+                    for p in parts]
+            cap = 1 << max(0, (valid - 1)).bit_length()
+            args = _pad_sorted(args, cap)
+            out = _sort_jit(nplanes + 1, cap)(tuple(args))
+            shard_sorted.append(list(out))
+            shard_valid.append(valid)
+        jax.block_until_ready([a for s in shard_sorted if s for a in s])
+    stages["shard_sort_s"] = round(_time.perf_counter() - t0, 3)
+
+    # phase 2: sample-based splitter exchange + partition bounds
+    t0 = _time.perf_counter()
+    with _progress.phase("splitter_exchange", rows=n, type_name=type_name):
+        k_samples = max(2, config.SHARD_SORT_SAMPLES.get())
+        sample_cols = [[] for _ in range(nplanes)]
+        for i in range(ndev):
+            if shard_valid[i] == 0:
+                continue
+            pos = np.unique(np.linspace(
+                0, shard_valid[i] - 1,
+                num=min(k_samples, shard_valid[i])).astype(np.int64))
+            for k in range(nplanes):
+                sample_cols[k].append(
+                    np.asarray(shard_sorted[i][k][pos]))
+        samples = [np.concatenate(c) for c in sample_cols]
+        order = np.lexsort(tuple(reversed(samples)))
+        total = len(order)
+        spl_idx = [order[(total * j) // ndev] for j in range(1, ndev)]
+        splitters = [np.asarray([samples[k][i] for i in spl_idx],
+                                dtype=np.int32) for k in range(nplanes)]
+        bounds = []   # per shard: partition boundaries [0, ..., valid]
+        for i in range(ndev):
+            if shard_valid[i] == 0:
+                bounds.append([0] * (ndev + 1))
+                continue
+            cap = int(shard_sorted[i][0].shape[0])
+            spl_dev = tuple(jax.device_put(s, devs[i]) for s in splitters)
+            counts = np.asarray(_count_lt_jit(nplanes, cap, ndev - 1)(
+                tuple(shard_sorted[i][:nplanes]), spl_dev))
+            bounds.append([0] + [int(c) for c in counts] + [shard_valid[i]])
+    stages["splitter_exchange_s"] = round(_time.perf_counter() - t0, 3)
+
+    # phase 3: per-partition merge sort on the partition's owner device,
+    # then concatenate the row-id planes in splitter order on device 0
+    t0 = _time.perf_counter()
+    with _progress.phase("merge", rows=n, type_name=type_name):
+        perm_parts = []
+        for j in range(ndev):
+            pieces = [[] for _ in range(nplanes + 1)]
+            m_j = 0
+            for i in range(ndev):
+                if shard_valid[i] == 0:
+                    continue
+                b0, b1 = bounds[i][j], bounds[i][j + 1]
+                if b1 <= b0:
+                    continue
+                m_j += b1 - b0
+                for k in range(nplanes + 1):
+                    pieces[k].append(jax.device_put(
+                        shard_sorted[i][k][b0:b1], devs[j]))
+            if m_j == 0:
+                continue
+            args = [p[0] if len(p) == 1 else jnp.concatenate(p)
+                    for p in pieces]
+            cap = 1 << max(0, (m_j - 1)).bit_length()
+            args = _pad_sorted(args, cap)
+            out = _sort_jit(nplanes + 1, cap)(tuple(args))
+            perm_parts.append(jax.device_put(out[-1][:m_j],
+                                             jax.devices()[0]))
+        perm = perm_parts[0] if len(perm_parts) == 1 \
+            else jnp.concatenate(perm_parts)
+        jax.block_until_ready(perm)
+    stages["merge_s"] = round(_time.perf_counter() - t0, 3)
+    return perm

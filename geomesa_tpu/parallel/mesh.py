@@ -72,6 +72,22 @@ class ShardedTable:
         return jax.device_put(np.asarray(arr), NamedSharding(self.mesh, P()))
 
 
+def shard_spans(n: int, n_devices: int):
+    """Contiguous, maximally balanced [offset, offset+len) row spans for an
+    n-row table over ``n_devices`` shards (first ``n % n_devices`` shards
+    take the extra row). The build-sort sharding analogue of the row-quantile
+    split above — used by parallel.dist.mesh_sort_perm to scatter unsorted
+    key planes."""
+    base, rem = divmod(n, n_devices)
+    spans = []
+    off = 0
+    for i in range(n_devices):
+        m = base + (1 if i < rem else 0)
+        spans.append((off, m))
+        off += m
+    return spans
+
+
 def split_points(sorted_keys: np.ndarray, n_devices: int) -> np.ndarray:
     """Per-device key boundaries of the row-quantile sharding (≙ the split
     points DefaultSplitter derives from stat histograms; here they are read

@@ -206,6 +206,18 @@ def cmd_age_off(args):
     print(f"Aged off {n} features")
 
 
+def cmd_reindex(args):
+    """Rebuild a type's device indexes build-then-swap (the maintenance
+    analogue of the reference's offline reindex jobs). Runs in the
+    foreground here — against a live server use POST /types/{t}/reindex,
+    which builds off the serving path and swaps atomically."""
+    import json as _json
+    store = _load(args.store, must_exist=True)
+    st = store.reindex(args.feature, background=False)
+    _save(store, args.store)
+    print(_json.dumps(st, indent=2, default=str))
+
+
 def cmd_recover(args):
     """Crash recovery (the runbook command): load the newest valid snapshot
     under the durability dir, replay the WAL suffix past it (truncating a
@@ -740,6 +752,13 @@ def build_parser() -> argparse.ArgumentParser:
         "age-off", help="drop features past their geomesa.feature.expiry TTL")
     common(sp)
     sp.set_defaults(fn=cmd_age_off)
+
+    sp = sub.add_parser(
+        "reindex",
+        help="rebuild a type's device indexes build-then-swap (bumps the "
+             "serving-cache generation)")
+    common(sp)
+    sp.set_defaults(fn=cmd_reindex)
 
     sp = sub.add_parser("config", help="list system properties")
     sp.set_defaults(fn=cmd_config)

@@ -31,6 +31,8 @@ Routes:
                                          and cache provenance)
   GET  /types/{t}/stats?stat=<dsl>     → stat sketch JSON
   POST /types/{t}/features             → ingest a GeoJSON FeatureCollection
+  POST /types/{t}/reindex              → background build-then-swap reindex
+                                         (GET polls its status)
   GET  /metrics                        → metrics snapshot (JSON)
   GET  /metrics?format=prometheus      → Prometheus text exposition (native
                                          _bucket lines carry exemplar trace
@@ -413,6 +415,13 @@ class GeoJsonApi:
                 fc = json.loads(body or b"{}")
                 n = self._ingest_geojson(t, fc)
                 return 200, {"ingested": n}
+            if rest == ["reindex"]:
+                # POST kicks a background build-then-swap reindex (serving
+                # continues against the old generation until the atomic
+                # install); GET polls its status
+                if method == "POST":
+                    return 200, self.store.reindex(t, background=True)
+                return 200, self.store.reindex_status(t)
         return 404, {"error": f"no route {method} {path}"}
 
     def _route_replication(self, rest, method, query):
