@@ -556,6 +556,20 @@ DOCTOR_TIMELINE_EVENTS = _register(
     "(matched with the flight recorder's shared predicate, newest "
     "first).")
 
+DOCTOR_REINDEX_PER_MIN = _register(
+    "GEOMESA_TPU_DOCTOR_REINDEX_PER_MIN", 3.0, float,
+    "reindex_churn bar: background-build aborts + failed installs per "
+    "minute over the doctor window before an incident opens (a build "
+    "that keeps losing its race with ingest never converges). "
+    "0 disables the detector.")
+
+DOCTOR_MERGE_BREACHES_PER_MIN = _register(
+    "GEOMESA_TPU_DOCTOR_MERGE_BREACHES_PER_MIN", 6.0, float,
+    "merge_fraction_breach bar: incremental merge-builds falling back "
+    "to the full rebuild (delta over GEOMESA_TPU_MERGE_MAX_FRACTION) "
+    "per minute before the reindex_churn rule flags the ingest shape. "
+    "0 disables the cause.")
+
 # -- self-optimizing serving: result cache / affinity / QoS (ISSUE 12) --------
 
 RESULT_CACHE_ENABLED = _register(
@@ -675,6 +689,40 @@ REINDEX_SNAPSHOT = _register(
     "installs (when the store is durable), so followers converge to the "
     "rebuilt generation through the ordinary snapshot catch-up path "
     "instead of waiting for the next threshold crossing.")
+
+# -- fleet soak scoreboard (ISSUE 14) -----------------------------------------
+
+SOAK_PHASE_S = _register(
+    "GEOMESA_TPU_SOAK_PHASE_S", 6.0, float,
+    "Wall-clock drive window for the fleet soak's steady and recovery "
+    "phases (fault phases run event-driven: inject, wait for the "
+    "incident, wait for resolution). The full nightly soak multiplies "
+    "this; --mini keeps it.")
+
+SOAK_WAIT_S = _register(
+    "GEOMESA_TPU_SOAK_WAIT_S", 60.0, float,
+    "Per-condition timeout inside the fleet soak (node healthy, "
+    "incident open, incident resolved, catch-up complete). A blown "
+    "wait fails that phase's checks instead of hanging the run.")
+
+SOAK_FOLLOWERS = _register(
+    "GEOMESA_TPU_SOAK_FOLLOWERS", 2, int,
+    "Follower count in the soak fleet (primary + N replicas + router, "
+    "each a real subprocess over localhost shipping sockets). The "
+    "chaos timeline needs at least 2: one to kill, one to promote.")
+
+SOAK_CATCHUP_BUDGET_S = _register(
+    "GEOMESA_TPU_SOAK_CATCHUP_BUDGET_S", 30.0, float,
+    "Budget for a restarted/re-pointed replica to fully catch up "
+    "(applied seq == primary WAL seq). Scored per fault phase as "
+    "catchup_s; a breach fails the phase, not the process.")
+
+SOAK_STRETCH = _register(
+    "GEOMESA_TPU_SOAK_STRETCH", 1.0, float,
+    "Multiplier on the injected chaos magnitudes (lag-spike delay per "
+    "frame and frame count). The perfwatch gate self-test runs the "
+    "soak with a stretch > 1 and requires the cfg11 check to flag the "
+    "regressed catch-up/burn metrics — proving the fleet gate trips.")
 
 
 def describe() -> Dict[str, dict]:

@@ -587,7 +587,18 @@ class TpuDataStore:
         if n_old <= 0 or n_delta <= 0:
             return False
         if n_delta > config.MERGE_MAX_FRACTION.get() * max(1, n_old):
-            return False  # big deltas amortize better through a full sort
+            # big deltas amortize better through a full sort — but a flush
+            # shape that breaches EVERY time means the incremental path is
+            # dead weight, so the fallback is counted and flight-logged for
+            # the doctor's merge_fraction_breach cause
+            from geomesa_tpu.metrics import REGISTRY as _m
+            _m.inc("ingest.merge_fraction_breaches")
+            _m.inc(f"ingest.merge_fraction_breaches.{type_name}")
+            from geomesa_tpu.obs.flight import RECORDER as _rec
+            _rec.record({"kind": "reindex", "type": type_name,
+                         "phase": "merge_fraction_breach",
+                         "delta_fraction": round(n_delta / max(1, n_old), 3)})
+            return False
         old_planner = self.planners.get(type_name)
         current = self.tables.get(type_name)
         if old_planner is None or current is None or len(current) != n_old:
@@ -713,6 +724,7 @@ class TpuDataStore:
                         # we built — this generation describes stale rows;
                         # discard and retry against the new table
                         _metrics.inc("reindex.aborts")
+                        _metrics.inc(f"reindex.aborts.{type_name}")
                         _flight.record({"kind": "reindex",
                                         "type": type_name,
                                         "phase": "aborted",
@@ -752,6 +764,7 @@ class TpuDataStore:
             status["error"] = f"{type(e).__name__}: {e}"
             status["seconds"] = round(_time.perf_counter() - t0, 3)
             _metrics.inc("reindex.failures")
+            _metrics.inc(f"reindex.failures.{type_name}")
             _flight.record({"kind": "reindex", "type": type_name,
                             "phase": "failed", "error": status["error"]})
 

@@ -58,6 +58,8 @@ RULES: Dict[str, Tuple[str, str]] = {
     "breaker_flapping": ("ticket", "breaker open/close edges in window"),
     "wal_fsync_stall": ("page", "new WAL fsync errors/retries"),
     "hot_skew": ("ticket", "single plan/cell/tenant dominates window"),
+    "reindex_churn": ("ticket", "build aborts/failed installs or "
+                                "merge-fraction breaches over bar"),
 }
 
 
@@ -250,6 +252,70 @@ class DoctorEngine:
             "match": {"errors": True},
         }]
 
+    def _top_type(self, counters: dict, families: Tuple[str, ...],
+                  now: float, window: float) -> dict:
+        """Dominant per-type delta across the given counter families —
+        the suspect names the TYPE whose builds are churning."""
+        types: Dict[str, float] = {}
+        for fam in families:
+            prefix = fam + "."
+            for k, v in counters.items():
+                if k.startswith(prefix):
+                    _r, d = self._delta(k, v, now, window)
+                    if d > 0:
+                        t = k[len(prefix):]
+                        types[t] = types.get(t, 0) + d
+        if not types:
+            return {}
+        top = max(types.items(), key=lambda kv: kv[1])
+        return {"type": top[0], "events_in_window": int(top[1])}
+
+    def _check_reindex(self, now: float, counters: dict) -> List[dict]:
+        """reindex_churn: the background build machinery is spinning
+        without converging — repeated build aborts / failed installs
+        (reindex:churn), or the incremental merge path falling back to
+        full rebuilds every flush (build:merge_fraction_breach)."""
+        window = float(config.DOCTOR_WINDOW_S.get())
+        alerts: List[dict] = []
+        # per-type deltas sample every tick (not just on firing) so the
+        # suspect's baseline exists by the time a bar is crossed
+        churn_suspect = self._top_type(
+            counters, ("reindex.aborts", "reindex.failures"), now, window)
+        breach_suspect = self._top_type(
+            counters, ("ingest.merge_fraction_breaches",), now, window)
+        churn = counters.get("reindex.aborts", 0) \
+            + counters.get("reindex.failures", 0)
+        rate, delta = self._delta("reindex.churn", churn, now, window)
+        bar = float(config.DOCTOR_REINDEX_PER_MIN.get())
+        if bar > 0 and delta > 0 and rate >= bar:
+            alerts.append({
+                "rule": "reindex_churn", "severity": "ticket",
+                "cause": "reindex:churn",
+                "detail": {"rate_per_min": round(rate, 2),
+                           "delta": int(delta), "bar_per_min": bar,
+                           "aborts": int(counters.get("reindex.aborts", 0)),
+                           "failures": int(
+                               counters.get("reindex.failures", 0))},
+                "suspect": churn_suspect,
+                "match": {"kind": "reindex"},
+            })
+        breaches = counters.get("ingest.merge_fraction_breaches", 0)
+        rate, delta = self._delta("ingest.merge_fraction_breaches",
+                                  breaches, now, window)
+        bar = float(config.DOCTOR_MERGE_BREACHES_PER_MIN.get())
+        if bar > 0 and delta > 0 and rate >= bar:
+            alerts.append({
+                "rule": "reindex_churn", "severity": "ticket",
+                "cause": "build:merge_fraction_breach",
+                "detail": {"rate_per_min": round(rate, 2),
+                           "delta": int(delta), "bar_per_min": bar,
+                           "max_fraction":
+                               float(config.MERGE_MAX_FRACTION.get())},
+                "suspect": breach_suspect,
+                "match": {"kind": "reindex"},
+            })
+        return alerts
+
     def _check_breakers(self, now: float, counters: dict) -> List[dict]:
         window = float(config.DOCTOR_WINDOW_S.get())
         bar = int(config.DOCTOR_BREAKER_FLAPS.get())
@@ -386,6 +452,7 @@ class DoctorEngine:
                           lambda: self._check_shed(now, counters),
                           lambda: self._check_breakers(now, counters),
                           lambda: self._check_wal(now, counters),
+                          lambda: self._check_reindex(now, counters),
                           lambda: self._check_skew(now)):
                 try:
                     alerts.extend(check())

@@ -63,6 +63,14 @@ _LOWER = ("_ms", "_s", "_us", "_bytes", "_kb", "_pct", "_seconds",
 _OVERRIDES = {
     "cfg7_overload_shed_rate": "skip",
     "n_points": "skip", "host_cores": "skip", "value": "skip",
+    # fleet-soak scoreboard (cfg11): the doctor's precision/recall and
+    # the conservation checks are correctness axes — ANY drift from the
+    # baselined 1.0 / 0 is a gate failure, not statistical noise
+    "cfg11_doctor_precision": "exact",
+    "cfg11_doctor_recall": "exact",
+    "cfg11_acked_write_loss": "exact",
+    "cfg11_clean_incidents": "exact",
+    "cfg11_worst_phase_burn_rate": "lower",
 }
 
 

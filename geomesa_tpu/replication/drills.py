@@ -83,6 +83,21 @@ def fingerprint(store) -> dict:
     return out
 
 
+def fingerprint_dir(path: str) -> dict:
+    """Open a (shut-down) node's durability dir read-only, recover its
+    state, and fingerprint it. The fleet soak's conservation check runs
+    this over every node dir AFTER the subprocesses exit — byte-identical
+    fingerprints across primary and followers close the loop that no
+    acked write was lost or reordered anywhere in the fleet."""
+    from geomesa_tpu.datastore import TpuDataStore
+    store = TpuDataStore.open(path, params={"wal.fsync": "off",
+                                            "scheduler": False})
+    try:
+        return fingerprint(store)
+    finally:
+        store.close()
+
+
 def _mk_primary(path: str):
     from geomesa_tpu.datastore import TpuDataStore
     from geomesa_tpu.replication.shipper import LogShipper

@@ -102,7 +102,8 @@ class Endpoint:
     def count(self, type_name: str, cql: str = "INCLUDE",
               auths: Optional[list] = None,
               deadline_ms: Optional[float] = None,
-              priority: str = "interactive") -> int:
+              priority: str = "interactive",
+              tenant: Optional[str] = None) -> int:
         raise NotImplementedError
 
     def promote(self, port: int = 0) -> dict:
@@ -229,13 +230,13 @@ class LocalEndpoint(Endpoint):
         return _health_from_parts(role, repl_stats, sched)
 
     def count(self, type_name, cql="INCLUDE", auths=None, deadline_ms=None,
-              priority="interactive") -> int:
+              priority="interactive", tenant=None) -> int:
         from geomesa_tpu.serve.resilience.admission import ShedError
         from geomesa_tpu.serve.resilience.breaker import CircuitOpenError
         try:
             return self.store.count_coalesced(
                 type_name, cql, auths=auths, deadline_ms=deadline_ms,
-                priority=priority)
+                priority=priority, tenant=tenant)
         except ShedError as e:
             raise EndpointOverloaded(
                 str(e), status=429,
@@ -322,13 +323,15 @@ class HttpEndpoint(Endpoint):
         return out
 
     def count(self, type_name, cql="INCLUDE", auths=None, deadline_ms=None,
-              priority="interactive") -> int:
+              priority="interactive", tenant=None) -> int:
         from geomesa_tpu import trace as _t
         q = {"cql": cql, "priority": priority}
         if auths:
             q["auths"] = ",".join(auths)
         if deadline_ms:
             q["deadline_ms"] = str(deadline_ms)
+        if tenant:
+            q["tenant"] = tenant
         # the proxy span is the remote half's parent: its span id rides
         # X-Span-Id, and its wall time minus the remote root's wall time
         # is the hop's network cost in the stitched tree
@@ -477,10 +480,12 @@ class ReplicaRouter:
               auths: Optional[list] = None,
               deadline_ms: Optional[float] = None,
               priority: str = "interactive",
-              freshness: str = "bounded") -> int:
+              freshness: str = "bounded",
+              tenant: Optional[str] = None) -> int:
         """Route one count; fails over across candidates on transport
         errors and overload sheds. Raises the last error when every
-        candidate refuses."""
+        candidate refuses. ``tenant`` rides through to the serving
+        node's QoS admission, so per-tenant fairness holds fleet-wide."""
         self._n_requests += 1
         _metrics.inc("router.requests")
         if freshness == "strong":
@@ -492,7 +497,8 @@ class ReplicaRouter:
         for i, ep in enumerate(self.candidates(freshness, cell=cell)):
             try:
                 n = ep.count(type_name, cql, auths=auths,
-                             deadline_ms=deadline_ms, priority=priority)
+                             deadline_ms=deadline_ms, priority=priority,
+                             tenant=tenant)
                 _metrics.inc(f"router.served.{ep.name}")
                 if i > 0:
                     self._n_failovers += 1
@@ -677,6 +683,15 @@ class RouterApi:
             return 200, {"slo": self.federator.slo()}, {}
         if parts == ["fleet", "incidents"]:
             return 200, self.federator.fleet_incidents(), {}
+        if parts == ["fleet", "soak"]:
+            # last fleet-soak scoreboard (this process's run, or the
+            # scoreboard file a previous run left behind)
+            from geomesa_tpu.obs import soakfleet as _soak
+            board = _soak.last_run()
+            if board is None:
+                return 404, {"error": "no soak run recorded "
+                                      "(geomesa-tpu soak)"}, {}
+            return 200, board, {}
         if parts == ["incidents"]:
             # the router process's OWN doctor (it has breakers/demotions
             # worth diagnosing too)
@@ -712,6 +727,9 @@ class RouterApi:
                 raw_dl = headers.get("X-Deadline-Ms")
             deadline_ms = float(raw_dl) if raw_dl else None
             priority = query.get("priority", ["interactive"])[0]
+            tenant = query.get("tenant", [None])[0]
+            if tenant is None and headers is not None:
+                tenant = headers.get("X-Tenant")
             # the routed query's ROOT trace: the proxy span inside it
             # (HttpEndpoint.count) parents the remote half
             with _t.trace("router.count", type=t, filter=cql,
@@ -719,6 +737,7 @@ class RouterApi:
                 n = self.router.count(t, cql, auths=auths,
                                       deadline_ms=deadline_ms,
                                       priority=priority,
+                                      tenant=tenant,
                                       freshness=freshness)
                 gid = tr.global_id if tr is not None else None
             return 200, {"count": int(n), "trace": gid}, {}

@@ -20,6 +20,7 @@ mutating commands load → act → save.
     geomesa-tpu replica       --dir DIR --follow HOST:PORT [--port W] [--id ID]
     geomesa-tpu router        --endpoint NAME=HOST:PORT ... [--port P]
     geomesa-tpu fleet         status --addr HOST:PORT [--addr ...] [--json]
+    geomesa-tpu soak          [--mini] [--scoreboard PATH] [--half chaos|clean]
     geomesa-tpu perfwatch     check|update|show [--run BENCH_summary.json]
                               [--baseline perf/baselines.json] [--k 3]
                               [--report out.json]
@@ -625,6 +626,21 @@ def cmd_fleet(args):
         print(_render_fleet(fl))
 
 
+def cmd_soak(args):
+    """Run the fleet soak: launch a real primary+replicas+router fleet
+    as subprocesses, drive Zipf multi-tenant traffic through the router,
+    execute the chaos timeline (unless --half clean), and write the
+    scored scoreboard (JSON + markdown). Exits nonzero when any
+    scoreboard check fails."""
+    from geomesa_tpu.obs import soakfleet
+    halves = ("chaos", "clean") if args.half == "both" else (args.half,)
+    board = soakfleet.run(mini=args.mini, scoreboard_path=args.scoreboard,
+                          base_dir=args.dir, halves=halves)
+    print(soakfleet.render_scoreboard(board))
+    if not board.get("ok"):
+        raise SystemExit(2)
+
+
 def cmd_doctor(args):
     """The fleet doctor's verdicts: evaluate the anomaly detectors and
     print ONE line per incident — what fired, since when, suspected
@@ -881,6 +897,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="print the raw merged JSON instead of the table")
     sp.set_defaults(fn=cmd_fleet)
+
+    sp = sub.add_parser(
+        "soak",
+        help="chaos-scored fleet soak: spawn primary+replicas+router as "
+             "subprocesses, drive Zipf traffic through the router, run "
+             "the chaos timeline, score the scoreboard")
+    sp.add_argument("--mini", action="store_true",
+                    help="CI-sized run (short phases); omit for the "
+                         "nightly-length soak")
+    sp.add_argument("--scoreboard", default=None, metavar="PATH",
+                    help="scoreboard JSON path (default "
+                         "SOAK_scoreboard.json; markdown lands beside it)")
+    sp.add_argument("--half", choices=("both", "chaos", "clean"),
+                    default="both",
+                    help="run only one half (default: both)")
+    sp.add_argument("--dir", default=None,
+                    help="scratch directory for the fleet's durable "
+                         "stores (default: a temp dir)")
+    sp.set_defaults(fn=cmd_soak)
 
     sp = sub.add_parser(
         "replica",

@@ -281,6 +281,39 @@ class GeoJsonApi:
             return 200, d.status()
         if parts and parts[0] == "replication":
             return self._route_replication(parts[1:], method, query)
+        if parts == ["debug", "fault"] and method == "POST":
+            # deterministic chaos for subprocess drills: the fleet soak
+            # arms mid-run faults (e.g. a repl.apply delay = lag spike)
+            # in a child it cannot reach in-process. Hard-gated off by
+            # default — the env flag is only set by drill spawners.
+            import os as _os
+            if _os.environ.get("GEOMESA_TPU_FAULT_API", "").lower() \
+                    not in ("1", "true", "on"):
+                return 403, {"error": "fault API disabled (spawn with "
+                                      "GEOMESA_TPU_FAULT_API=1)",
+                             "kind": "forbidden"}
+            from geomesa_tpu.durability import faults as _faults
+            if query.get("reset", [None])[0]:
+                _faults.reset()
+                return 200, {"reset": True}
+            point = query.get("point", [None])[0]
+            if not point:
+                return 400, {"error": "missing ?point=",
+                             "kind": "bad_request"}
+            delay_s = float(query.get("delay_s", [0.0])[0])
+            n = int(query.get("n", [1])[0])
+            _faults.arm_serve_delay(point, seconds=delay_s, n=n)
+            return 200, {"armed": point, "delay_s": delay_s, "n": n}
+        if parts == ["fleet", "soak"]:
+            # last fleet-soak scoreboard: readable WITHOUT a federator
+            # (the orchestrator runs out-of-process; any node can serve
+            # the summary it wrote to disk)
+            from geomesa_tpu.obs import soakfleet as _soak
+            board = _soak.last_run()
+            if board is None:
+                return 404, {"error": "no soak run recorded "
+                                      "(geomesa-tpu soak)"}
+            return 200, board
         if parts and parts[0] == "fleet":
             # the single pane of glass — served by whichever node carries
             # a configured federator (the router/primary, typically)
@@ -415,6 +448,12 @@ class GeoJsonApi:
                 fc = json.loads(body or b"{}")
                 n = self._ingest_geojson(t, fc)
                 return 200, {"ingested": n}
+            if rest == ["flush"] and method == "POST":
+                # force the delta tier into main — lets operators (and the
+                # soak orchestrator) provoke the table swap that reindex
+                # builds race against
+                self.store.flush(t)
+                return 200, {"flushed": t}
             if rest == ["reindex"]:
                 # POST kicks a background build-then-swap reindex (serving
                 # continues against the old generation until the atomic

@@ -434,6 +434,61 @@ def test_zipf_tenant_storm_victim_p99_holds():
         ds.close()
 
 
+def test_storm_isolation_property_fake_clock(monkeypatch):
+    """The cfg9/storm property with the wall clock taken out: while the
+    victim is inside the QOS_ACTIVE_S activity window the storm tenant is
+    hard-capped at its fair share (every excess admit sheds with the
+    storm tenant named), the victim's protected headroom never sheds, and
+    once the fake clock leaves the window the storm gets the full class
+    limit back (work-conserving). This is what the timing-based drill
+    above measures through latency percentiles — pinned here without a
+    single sleep, so bench flakes can never hide a real fairness break."""
+    from geomesa_tpu.serve.resilience import admission as adm
+
+    class _Clock:
+        now = 1000.0
+
+        @staticmethod
+        def monotonic():
+            return _Clock.now
+
+    # swap the module's time reference, not the global time module —
+    # background threads elsewhere keep the real clock
+    monkeypatch.setattr(adm, "time", _Clock)
+    config.QOS_TENANT_SHARE.set(0.5)
+    config.QOS_TENANT_MIN.set(2)
+    config.QOS_ACTIVE_S.set(10.0)
+    ac = AdmissionController(interactive_limit=8)
+
+    ac.admit("interactive", tenant="victim")     # victim becomes active
+    for _ in range(4):                           # storm fills its share
+        ac.admit("interactive", tenant="noisy")
+    for _ in range(20):                          # every excess admit sheds
+        with pytest.raises(ShedError) as ei:
+            ac.admit("interactive", tenant="noisy")
+        assert ei.value.tenant == "noisy"
+        assert ei.value.retry_after_s > 0
+    # the victim admits freely up to its own guaranteed share — the
+    # storm's 24 attempts took none of it
+    for _ in range(3):
+        ac.admit("interactive", tenant="victim")
+    s = ac.stats()["qos"]
+    assert s["qos_shed"]["noisy"] == 20
+    assert "victim" not in s["qos_shed"]
+    assert s["tenant_in_flight"]["interactive"]["victim"] == 4
+
+    # drain, then advance PAST the activity window: the victim's
+    # activity expires and the lone storm is work-conserving again
+    for _ in range(4):
+        ac.release("interactive", tenant="noisy")
+    for _ in range(4):
+        ac.release("interactive", tenant="victim")
+    _Clock.now += 10.1
+    for _ in range(8):
+        ac.admit("interactive", tenant="noisy")  # full class limit, no shed
+    assert ac.stats()["qos"]["qos_shed"]["noisy"] == 20
+
+
 # -- cell-affinity routing ----------------------------------------------------
 
 

@@ -55,7 +55,8 @@ _KNOBS = (config.DOCTOR_ENABLED, config.DOCTOR_WINDOW_S,
           config.DOCTOR_RECOMPILES_PER_MIN, config.DOCTOR_SHED_PER_MIN,
           config.DOCTOR_BREAKER_FLAPS, config.DOCTOR_FSYNC_ERRORS,
           config.DOCTOR_SKEW_FRACTION, config.DOCTOR_SKEW_MIN,
-          config.DOCTOR_CLEAR_TICKS, config.DOCTOR_TIMELINE_EVENTS)
+          config.DOCTOR_CLEAR_TICKS, config.DOCTOR_TIMELINE_EVENTS,
+          config.DOCTOR_REINDEX_PER_MIN, config.DOCTOR_MERGE_BREACHES_PER_MIN)
 
 
 @pytest.fixture(autouse=True)
@@ -142,6 +143,73 @@ def test_shed_storm_names_dominant_priority_class():
     assert alert["suspect"] == {"priority": "interactive",
                                 "shed_in_window": 15}
     assert alert["detail"]["by_class"] == {"interactive": 15, "batch": 5}
+
+
+def test_reindex_churn_fires_names_type_and_resolves():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    config.DOCTOR_WINDOW_S.set(60.0)
+    config.DOCTOR_CLEAR_TICKS.set(2)
+    for k in ("reindex.aborts", "reindex.aborts.trips",
+              "reindex.failures", "reindex.failures.trips"):
+        reg.inc(k, 0)
+    doc.evaluate()                          # baseline sample
+    clock.advance(10)
+    reg.inc("reindex.aborts", 3)            # 3 aborts + 1 failed install
+    reg.inc("reindex.aborts.trips", 3)      # in 10s = 24/min > bar 3
+    reg.inc("reindex.failures", 1)
+    reg.inc("reindex.failures.trips", 1)
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "reindex_churn"
+    assert alert["severity"] == "ticket"
+    assert alert["cause"] == "reindex:churn"
+    assert alert["suspect"] == {"type": "trips", "events_in_window": 4}
+    assert alert["detail"]["aborts"] == 3
+    (inc,) = doc.store.active()
+    assert inc["rule"] == "reindex_churn"
+    # quiet: the window ages the samples out, then clear ticks resolve
+    for _ in range(4):
+        clock.advance(61.0)
+        doc.evaluate()
+    assert not doc.store.active()
+
+
+def test_merge_fraction_breach_cause_below_then_over_bar():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    config.DOCTOR_WINDOW_S.set(60.0)
+    reg.inc("ingest.merge_fraction_breaches", 0)
+    reg.inc("ingest.merge_fraction_breaches.trips", 0)
+    doc.evaluate()                          # baseline sample
+    clock.advance(30)
+    reg.inc("ingest.merge_fraction_breaches", 2)        # 4/min < bar 6
+    reg.inc("ingest.merge_fraction_breaches.trips", 2)
+    assert doc.evaluate()["alerts"] == []
+    reg.inc("ingest.merge_fraction_breaches", 4)        # 12/min >= bar
+    reg.inc("ingest.merge_fraction_breaches.trips", 4)
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "reindex_churn"
+    assert alert["cause"] == "build:merge_fraction_breach"
+    assert alert["suspect"]["type"] == "trips"
+    assert alert["detail"]["max_fraction"] == config.MERGE_MAX_FRACTION.get()
+
+
+def test_reindex_churn_bar_zero_disables():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    doc = _mk_doctor(reg, clock)
+    config.DOCTOR_WINDOW_S.set(60.0)
+    config.DOCTOR_REINDEX_PER_MIN.set(0.0)
+    config.DOCTOR_MERGE_BREACHES_PER_MIN.set(0.0)
+    reg.inc("reindex.aborts", 0)
+    reg.inc("ingest.merge_fraction_breaches", 0)
+    doc.evaluate()
+    clock.advance(5)
+    reg.inc("reindex.aborts", 50)
+    reg.inc("ingest.merge_fraction_breaches", 50)
+    assert doc.evaluate()["alerts"] == []
 
 
 def test_breaker_flapping_counts_transition_edges():
@@ -269,7 +337,7 @@ def test_verdict_is_one_line_with_suspect_and_trace():
     assert "trace=n1-abc123" in line
     assert set(RULES) == {"slo_burn", "replication_lag", "recompile_churn",
                           "shed_storm", "breaker_flapping",
-                          "wal_fsync_stall", "hot_skew"}
+                          "wal_fsync_stall", "hot_skew", "reindex_churn"}
 
 
 # -- journal: rotation + replay (satellite) -----------------------------------
