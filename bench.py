@@ -1510,6 +1510,58 @@ def main(args=None) -> int:
                 {h: v.get("ok") for h, v in
                  (board11.get("halves") or {}).items()}
 
+    if "12" in configs:
+        # cfg12 — multi-process cluster dryrun (cluster/dryrun.py): a
+        # REAL 2-process jax.distributed fleet over localhost gloo, ONE
+        # table sharded by contiguous Morton key-range, psum-reduced
+        # counts/density and host-merged selects judged byte-equal
+        # against the single-process oracle (same code path, inactive
+        # runtime). The exactness axes are pinned exact in
+        # perfwatch._OVERRIDES; the warm timings ride the normal
+        # statistical gate. Not in the default config lists: it spawns
+        # worker processes, so it rides the dedicated cluster CI job.
+        from geomesa_tpu.cluster import dryrun as _cdry
+        n12 = int(os.environ.get("GEOMESA_TPU_BENCH_CLUSTER_N",
+                                 "8000" if args.mini else "20000"))
+        rep12 = _cdry.run_dryrun(
+            num_processes=2, n=n12,
+            out_dir=os.path.join(REPO, "BENCH_cluster_dryrun"))
+        ch12 = rep12["checks"]
+        detail["cfg12_count_mismatch"] = 0 if ch12.get("counts_equal") else 1
+        detail["cfg12_select_mismatch"] = (
+            0 if ch12.get("selects_equal") else 1)
+        detail["cfg12_density_mismatch"] = (
+            0 if ch12.get("density_equal") else 1)
+        detail["cfg12_shard_strict_subset"] = (
+            1 if ch12.get("shards_strict_subset") else 0)
+        live12 = [r for r in rep12["ranks"] if r]
+        if live12:
+            detail["cfg12_count_warm_ms"] = round(max(
+                max(r["battery"]["count_warm_ms"].values())
+                for r in live12), 3)
+            detail["cfg12_select_ms"] = round(max(
+                max(r["battery"]["select_ms"].values())
+                for r in live12), 3)
+            detail["cfg12_build_s"] = round(max(
+                r["stages"].get("index_build_s", 0.0)
+                + r["stages"].get("global_table_s", 0.0)
+                for r in live12), 3)
+        detail["cfg12_dryrun_wall_s"] = rep12["wall_s"]
+        # shard-ownership artifact (CI uploads it): who owns which
+        # Morton key-range, with how many rows
+        with open(os.path.join(REPO, "BENCH_cluster_shards.json"),
+                  "w") as fh:
+            json.dump({"checks": ch12, "n": n12,
+                       "ownership": [
+                           {"process": r["process_id"],
+                            "rows": r["local_rows"],
+                            "key_range": r["key_range"],
+                            "psum_rounds": r["psum_rounds"]}
+                           for r in sorted(live12,
+                                           key=lambda r: r["process_id"])]},
+                      fh, indent=1)
+        assert rep12["ok"], ch12
+
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",
         "value": round(headline_p50, 3) if headline_p50 is not None else None,
@@ -1520,6 +1572,12 @@ def main(args=None) -> int:
     print(json.dumps(out))
 
     # -- flat machine-stable summary + the regression gate ------------------
+    from geomesa_tpu.cluster.runtime import runtime as _cluster_runtime
+    _crt = _cluster_runtime(init=False)
+    _cluster_procs = _crt.num_processes if _crt.active() else 1
+    _cluster_shard_rows = ({t: s.get("proc_rows")
+                            for t, s in _crt.tables.items()}
+                           if _crt.active() and _crt.tables else None)
     from geomesa_tpu import trace as _trace_mod
     from geomesa_tpu.obs import attrib as _attrib
     from geomesa_tpu.obs import perfwatch as _pw
@@ -1546,6 +1604,11 @@ def main(args=None) -> int:
             # comparable per node, not just per machine class
             "node_id": _trace_mod.node_id(),
             "role": _trace_mod.node_role(),
+            # partition-plane honesty: numbers from an N-process cluster
+            # member are never comparable to single-process baselines —
+            # perfwatch treats a num_processes mismatch as new-baseline
+            "num_processes": _cluster_procs,
+            "shard_rows": _cluster_shard_rows,
             # join-input complexity (bench honesty: these numbers mean
             # nothing without the polygon set's vertex budget on record)
             "cfg3_polygons": (

@@ -1,0 +1,196 @@
+"""Cross-process partition: distributed builds land sorted-by-construction.
+
+The PR-13 mesh-sharded sort (parallel/dist.py mesh_sort_perm) runs its
+splitter exchange across LOCAL devices. This module extends exactly that
+discipline across PROCESS boundaries, on the host side:
+
+  1. local stable sort of the Morton keys (global-row-id tie-break, the
+     same iota discipline as every sort path in the repo);
+  2. sample exchange — each process contributes k evenly-spaced sorted
+     samples, every process deterministically derives the SAME
+     num_processes-1 global splitters from the merged sample set;
+  3. partition by KEY ONLY with the strictly-less-than boundary rule
+     (rows equal to a splitter all land in the splitter's right
+     partition on every process — no key ever straddles an ownership
+     boundary, ties ordered by the row-id plane);
+  4. row exchange (allgather of the sorted columns + everyone slices
+     out its own partition from each source) and a final local stable
+     merge — each process ends holding one contiguous key range,
+     sorted, which is precisely the ClusterShardedTable input contract.
+
+Result: concatenating per-process shards in rank order is bitwise
+identical to a single-process stable sort of the full corpus — the
+distributed index build needs no post-hoc global sort.
+
+Payload columns are exchanged as raw bytes (dtype-preserving), strings
+as fixed-width byte matrices, so float columns roundtrip exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.cluster.runtime import ClusterRuntime
+
+
+def _allgather_u8(rt: ClusterRuntime, arr: np.ndarray,
+                  rows: List[int]) -> List[np.ndarray]:
+    """All-gather a per-process (n_p, w) uint8 matrix; ``rows`` is every
+    process's row count (already exchanged). Returns one matrix per
+    process, unpadded."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    cap = max(1, max(rows))
+    w = arr.shape[1] if arr.ndim == 2 else 1
+    buf = np.zeros((cap, w), dtype=np.uint8)
+    if len(arr):
+        buf[:len(arr)] = arr.reshape(len(arr), w)
+    out = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(buf))).reshape(rt.num_processes, cap, w)
+    return [out[p, :rows[p]] for p in range(rt.num_processes)]
+
+
+def _cols_to_u8(cols: Dict[str, np.ndarray]) -> Tuple[Dict[str, np.ndarray],
+                                                      Dict[str, dict]]:
+    """Encode 1-D columns into (n, itemsize) uint8 matrices + the specs
+    to decode them (numeric: raw bytes; strings: fixed-width utf-8)."""
+    enc, spec = {}, {}
+    for name, arr in cols.items():
+        arr = np.asarray(arr)
+        if arr.dtype.kind in ("U", "S", "O"):
+            raw = [s.encode("utf-8") if isinstance(s, str)
+                   else bytes(s) for s in arr.tolist()]
+            w = max([len(r) for r in raw], default=0) + 1
+            m = np.zeros((len(raw), w), dtype=np.uint8)
+            for i, r in enumerate(raw):
+                m[i, :len(r)] = np.frombuffer(r, dtype=np.uint8)
+            enc[name] = m
+            spec[name] = {"kind": "str", "width": w}
+        else:
+            m = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+            enc[name] = m.reshape(len(arr), arr.dtype.itemsize) \
+                if len(arr) else m.reshape(0, arr.dtype.itemsize)
+            spec[name] = {"kind": "num", "dtype": arr.dtype.str}
+    return enc, spec
+
+
+def _u8_to_col(mat: np.ndarray, sp: dict) -> np.ndarray:
+    if sp["kind"] == "str":
+        return np.asarray([bytes(r).rstrip(b"\x00").decode("utf-8")
+                           for r in mat], dtype=object)
+    return np.frombuffer(np.ascontiguousarray(mat).tobytes(),
+                         dtype=np.dtype(sp["dtype"]))
+
+
+def cluster_partition(rt: ClusterRuntime, keys: np.ndarray,
+                      payload: Dict[str, np.ndarray],
+                      gids: np.ndarray = None,
+                      stages: dict = None):
+    """Repartition (keys, payload) so each process holds one contiguous,
+    locally-sorted Morton key range.
+
+    Collective: every process calls with its own unsorted rows. ``gids``
+    is the optional global tie-break id per row (the ORIGINAL corpus row
+    id when rows were dealt out round-robin) — with it, rows with equal
+    keys land in their original global order, so a downstream index
+    build's local-row tie-break reproduces the single-process sort
+    bitwise. Returns ``(keys_local, payload_local, (key_lo, key_hi),
+    stages)`` — the sorted local shard, its ownership bounds, and phase
+    timings."""
+    import time as _time
+
+    if stages is None:
+        stages = {}
+    keys = np.asarray(keys, dtype=np.int64)
+    n_local = len(keys)
+    if not rt.active():
+        gid = np.arange(n_local, dtype=np.int64) if gids is None \
+            else np.asarray(gids, dtype=np.int64)
+        order = np.lexsort((gid, keys))
+        keys = keys[order]
+        payload = {k: np.asarray(v)[order] for k, v in payload.items()}
+        lo = int(keys[0]) if n_local else 0
+        hi = int(keys[-1]) if n_local else -1
+        return keys, payload, (lo, hi), stages
+
+    # phase 1: local stable sort with a global-row-id tie-break plane
+    t0 = _time.perf_counter()
+    counts = [p["n"] for p in rt.exchange({"n": n_local})]
+    start = int(sum(counts[:rt.process_id]))
+    gid = np.arange(start, start + n_local, dtype=np.int64) \
+        if gids is None else np.asarray(gids, dtype=np.int64)
+    order = np.lexsort((gid, keys))
+    keys_s = keys[order]
+    gid_s = gid[order]
+    payload_s = {k: np.asarray(v)[order] for k, v in payload.items()}
+    stages["partition_local_sort_s"] = round(_time.perf_counter() - t0, 3)
+
+    # phase 2: sample exchange -> global splitters (deterministic on
+    # every process: same merged samples, same quantile picks)
+    t0 = _time.perf_counter()
+    k_samples = max(2, config.SHARD_SORT_SAMPLES.get())
+    if n_local:
+        pos = np.unique(np.linspace(0, n_local - 1,
+                                    num=min(k_samples, n_local))
+                        .astype(np.int64))
+        mine = [int(keys_s[i]) for i in pos]
+    else:
+        mine = []
+    sample_sets = [p["s"] for p in rt.exchange({"s": mine})]
+    samples = np.sort(np.asarray(
+        [s for ss in sample_sets for s in ss], dtype=np.int64))
+    total = len(samples)
+    nproc = rt.num_processes
+    splitters = np.asarray(
+        [samples[(total * j) // nproc] for j in range(1, nproc)],
+        dtype=np.int64) if total else np.zeros(nproc - 1, dtype=np.int64)
+    # strictly-less-than boundaries: rows with key < splitter[j] belong
+    # left of boundary j; equal keys all fall right (never straddle)
+    bounds = [0] + [int(c) for c in
+                    np.searchsorted(keys_s, splitters, side="left")] \
+        + [n_local]
+    stages["partition_splitters_s"] = round(_time.perf_counter() - t0, 3)
+
+    # phase 3: row exchange — allgather sorted columns, every process
+    # slices its own partition out of each source's bounds
+    t0 = _time.perf_counter()
+    all_bounds = [p["b"] for p in rt.exchange({"b": bounds})]
+    enc, spec = _cols_to_u8({"__key__": keys_s, "__gid__": gid_s,
+                             **payload_s})
+    gathered = {name: _allgather_u8(rt, mat, counts)
+                for name, mat in enc.items()}
+    me = rt.process_id
+    pieces = {name: [] for name in enc}
+    for src in range(nproc):
+        b0, b1 = all_bounds[src][me], all_bounds[src][me + 1]
+        if b1 <= b0:
+            continue
+        for name in enc:
+            pieces[name].append(gathered[name][src][b0:b1])
+    moved = int(sum(len(p) for p in pieces["__key__"]))
+    cols = {}
+    for name in enc:
+        if pieces[name]:
+            mat = np.concatenate(pieces[name])
+        else:
+            mat = np.zeros((0, enc[name].shape[1]), dtype=np.uint8)
+        cols[name] = _u8_to_col(mat, spec[name])
+    stages["partition_exchange_s"] = round(_time.perf_counter() - t0, 3)
+
+    # phase 4: final local stable merge (sources were sorted runs;
+    # row-id plane keeps ties in original order)
+    t0 = _time.perf_counter()
+    keys_f = cols.pop("__key__")
+    gid_f = cols.pop("__gid__")
+    order = np.lexsort((gid_f, keys_f))
+    keys_f = keys_f[order]
+    out_payload = {k: v[order] for k, v in cols.items()}
+    stages["partition_merge_s"] = round(_time.perf_counter() - t0, 3)
+    stages["partition_rows"] = moved
+    lo = int(keys_f[0]) if len(keys_f) else 0
+    hi = int(keys_f[-1]) if len(keys_f) else -1
+    return keys_f, out_payload, (lo, hi), stages

@@ -1,0 +1,386 @@
+"""N-process CPU-backend distributed dryrun + single-process oracle.
+
+The acceptance surface for the cluster runtime (and the engine behind
+bench cfg12 and the CI ``cluster`` job): spawn N real worker processes
+(``JAX_PLATFORMS=cpu``, gloo collectives), have each
+
+  1. deal itself a round-robin slice of a deterministic shared-seed
+     corpus (so no process ever materializes the full table),
+  2. repartition by Morton key range (cluster/build.py) so it owns one
+     contiguous, sorted shard,
+  3. build a real local store + index over the shard, assemble the
+     ClusterShardedTable global arrays, and run the query battery:
+     psum'd bbox+time counts, a psum'd density grid, and ordered-merge
+     selects,
+  4. start a web surface and auto-register the cluster in the Federator
+     (both processes must appear in /fleet with no manual --addr list),
+
+while the parent runs the IDENTICAL battery single-process (the oracle
+is the same code path with an inactive runtime — one code path, two
+cardinalities). The orchestrator then asserts byte-equality: every
+rank's psum count == oracle count, density grids sha-identical, merged
+select fids list-identical, and every rank holds strictly less than the
+full corpus.
+
+The corpus deliberately contains duplicated (point, time) rows so the
+tie-break discipline (original-gid plane through the partition, local
+row order in the index) is exercised, not just probable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.cluster.runtime import ClusterRuntime, runtime
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point"
+TYPE = "pts"
+
+COUNT_QUERIES = [
+    "BBOX(geom, -10, -10, 10, 10)",
+    "BBOX(geom, -10, -10, 10, 10) AND dtg DURING "
+    "2020-01-05T00:00:00Z/2020-01-20T00:00:00Z",
+    "val > 50",
+    "INCLUDE",
+]
+SELECT_QUERIES = [
+    "BBOX(geom, -6, -6, 6, 6)",
+    "BBOX(geom, 20, 20, 60, 60) AND dtg DURING "
+    "2020-01-02T00:00:00Z/2020-01-25T00:00:00Z",
+]
+DENSITY_QUERY = "BBOX(geom, -90, -45, 90, 45)"
+DENSITY_BBOX = (-90.0, -45.0, 90.0, 45.0)
+DENSITY_WH = (64, 32)
+
+
+def make_corpus(n: int, seed: int) -> Dict[str, np.ndarray]:
+    """Deterministic shared corpus; the tail duplicates head rows
+    (same point, same timestamp) to force key ties across processes."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    dtg = base + rng.integers(0, 30 * 86400000, n)
+    name = rng.choice(["a", "b", "c"], n)
+    val = rng.integers(0, 100, n).astype(np.int32)
+    dup = max(1, n // 64)
+    x[-dup:], y[-dup:], dtg[-dup:] = x[:dup], y[:dup], dtg[:dup]
+    return {"x": x, "y": y, "dtg": dtg, "name": name, "val": val}
+
+
+def _partition_keys(sft, table) -> np.ndarray:
+    """Morton partition key per row: a MONOTONE coarsening of the z3
+    index sort order (bin major, z high bits minor) — rows with equal
+    full keys share a partition key, so no key range ever straddles a
+    process boundary and the within-shard index sort restores the exact
+    global order."""
+    from geomesa_tpu.curves.binnedtime import TimePeriod
+    from geomesa_tpu.index.spatial import Z3Index, _DeltaKeyShim
+
+    shim = _DeltaKeyShim(sft, table, sft.geometry_attribute.name,
+                         sft.dtg_attribute.name,
+                         TimePeriod.parse(sft.z3_interval))
+    Z3Index._sort_keys(shim)
+    bins = np.asarray(shim._bins, dtype=np.int64)
+    z = np.asarray(shim._z, dtype=np.int64)
+    return (bins << 48) | (z >> 15)
+
+
+def inactive_runtime() -> ClusterRuntime:
+    """A single-process runtime for the oracle path (never touches the
+    process-global singleton or jax.distributed)."""
+    rt = ClusterRuntime()
+    rt.initialized = True
+    rt.topology = "flat"
+    return rt
+
+
+def build_local(rt: ClusterRuntime, n: int, seed: int,
+                stages: Optional[dict] = None):
+    """Slice → partition → store/index → global table. Collective when
+    the runtime is active; the complete single-process pipeline when
+    not (the oracle)."""
+    from geomesa_tpu import DataStoreFinder
+    from geomesa_tpu.cluster.build import cluster_partition
+    from geomesa_tpu.cluster.exec import ClusterScan
+    from geomesa_tpu.cluster.table import ClusterShardedTable
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.features.table import FeatureTable
+
+    if stages is None:
+        stages = {}
+    t0 = time.perf_counter()
+    corpus = make_corpus(n, seed)
+    if rt.active():
+        ids = np.arange(rt.process_id, n, rt.num_processes, dtype=np.int64)
+    else:
+        ids = np.arange(n, dtype=np.int64)
+    mine = {k: v[ids] for k, v in corpus.items()}
+    stages["corpus_s"] = round(time.perf_counter() - t0, 3)
+
+    sft = SimpleFeatureType.from_spec(TYPE, SPEC)
+    t0 = time.perf_counter()
+    key_table = FeatureTable.build(sft, {
+        "name": mine["name"], "val": mine["val"], "dtg": mine["dtg"],
+        "geom": (mine["x"], mine["y"])})
+    keys = _partition_keys(sft, key_table)
+    stages["keys_s"] = round(time.perf_counter() - t0, 3)
+
+    keys_l, part, bounds, stages = cluster_partition(
+        rt, keys, {**mine, "gid": ids}, gids=ids, stages=stages)
+
+    t0 = time.perf_counter()
+    fids = ["f%09d" % g for g in part["gid"]]
+    ds = DataStoreFinder.get_data_store(backend="tpu")
+    ds.create_schema(TYPE, SPEC)
+    ds.load(TYPE, FeatureTable.build(ds.get_schema(TYPE), {
+        "name": part["name"], "val": part["val"].astype(np.int32),
+        "dtg": part["dtg"].astype(np.int64),
+        "geom": (part["x"], part["y"])}, fids=fids))
+    planner = ds.planner(TYPE)
+    idx = next(i for i in planner.indexes if i.name == "z3")
+    stages["index_build_s"] = round(time.perf_counter() - t0, 3)
+
+    t0 = time.perf_counter()
+    host_cols = {k: np.asarray(v) for k, v in idx.device.columns.items()}
+    st = ClusterShardedTable.from_local_columns(rt, host_cols,
+                                                key_bounds=bounds)
+    stages["global_table_s"] = round(time.perf_counter() - t0, 3)
+    rt.register_table(TYPE, st.layout.summary())
+    fids_sorted = np.asarray(planner.table.fids)[np.asarray(idx.perm)]
+    return ds, planner, ClusterScan(st), fids_sorted, stages
+
+
+def run_battery(planner, scan, fids_sorted) -> dict:
+    """Counts + density + ordered-merge selects; identical output shape
+    on every rank AND on the oracle (which is how equality is judged)."""
+    out = {"counts": {}, "count_warm_ms": {}, "selects": {}}
+    for q in COUNT_QUERIES:
+        plan = planner.plan(q)
+        c = scan.count(plan)                       # compile + collective
+        t0 = time.perf_counter()
+        c2 = scan.count(plan)
+        out["count_warm_ms"][q] = round(
+            (time.perf_counter() - t0) * 1000.0, 3)
+        assert c == c2
+        out["counts"][q] = int(c)
+    plan = planner.plan(DENSITY_QUERY)
+    grid = scan.density(plan, DENSITY_BBOX, *DENSITY_WH)
+    t0 = time.perf_counter()
+    grid = scan.density(plan, DENSITY_BBOX, *DENSITY_WH)
+    out["density_warm_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+    g32 = np.ascontiguousarray(np.asarray(grid, dtype=np.float32))
+    out["density_sha"] = hashlib.sha256(g32.tobytes()).hexdigest()
+    out["density_sum"] = float(g32.sum())
+    for q in SELECT_QUERIES:
+        plan = planner.plan(q)
+        t0 = time.perf_counter()
+        merged = scan.select_merged(plan, {"fid": fids_sorted})
+        out.setdefault("select_ms", {})[q] = round(
+            (time.perf_counter() - t0) * 1000.0, 3)
+        out["selects"][q] = merged["fid"]
+    return out
+
+
+# -- worker entry (one process of the cluster) --------------------------------
+
+
+def worker_main(out_path: str) -> int:
+    n = int(os.environ.get("GEOMESA_TPU_DRYRUN_N", "20000"))
+    seed = int(os.environ.get("GEOMESA_TPU_DRYRUN_SEED", "7"))
+    with_web = os.environ.get("GEOMESA_TPU_DRYRUN_WEB", "1") != "0"
+    t_start = time.perf_counter()
+    rt = runtime()
+    stages: dict = {}
+    ds, planner, scan, fids_sorted, stages = build_local(rt, n, seed,
+                                                         stages)
+    battery = run_battery(planner, scan, fids_sorted)
+
+    fleet = None
+    if with_web:
+        from geomesa_tpu.web import serve
+        httpd = serve(ds, port=0, background=True)
+        port = httpd.server_address[1]
+        nodes = rt.register_web(port)            # collective: all bound
+        if nodes:
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/fleet", timeout=30) as r:
+                fleet = json.loads(r.read().decode())
+
+    report = {
+        "process_id": rt.process_id,
+        "num_processes": rt.num_processes,
+        "local_rows": scan.sharded.local_rows(),
+        "n_global": scan.sharded.n,
+        "key_range": scan.layout.key_ranges[rt.process_id]
+            if scan.layout.key_ranges else None,
+        "psum_rounds": rt.psum_rounds,
+        "cluster": rt.state(),
+        "battery": battery,
+        "stages": stages,
+        "fleet": fleet,
+        "wall_s": round(time.perf_counter() - t_start, 3),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f)
+    rt.barrier("dryrun-done")
+    return 0
+
+
+# -- orchestrator -------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
+               timeout_s: float = 420.0, local_devices: int = 2,
+               out_dir: Optional[str] = None, web: bool = True) -> dict:
+    """Spawn the N-process dryrun, compute the oracle in-process, and
+    return the merged report with exactness checks + timings."""
+    t_start = time.perf_counter()
+    work = out_dir or tempfile.mkdtemp(prefix="geomesa_cluster_dryrun_")
+    os.makedirs(work, exist_ok=True)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    procs: List[subprocess.Popen] = []
+    outs = []
+    for p in range(num_processes):
+        out_path = os.path.join(work, f"rank{p}.json")
+        outs.append(out_path)
+        env = os.environ.copy()
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={local_devices}",
+            "GEOMESA_TPU_CLUSTER": "1",
+            "GEOMESA_TPU_CLUSTER_COORDINATOR": coord,
+            "GEOMESA_TPU_CLUSTER_NUM_PROCESSES": str(num_processes),
+            "GEOMESA_TPU_CLUSTER_PROCESS_ID": str(p),
+            "GEOMESA_TPU_NODE_ID": f"proc{p}",
+            "GEOMESA_TPU_DRYRUN_N": str(n),
+            "GEOMESA_TPU_DRYRUN_SEED": str(seed),
+            "GEOMESA_TPU_DRYRUN_WEB": "1" if web else "0",
+        })
+        with open(os.path.join(work, f"rank{p}.log"), "w") as log:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "geomesa_tpu.cluster.dryrun",
+                 "--worker", "--out", out_path],
+                stdout=log, stderr=subprocess.STDOUT, env=env))
+
+    # oracle while the workers run: same battery, inactive runtime
+    rt0 = inactive_runtime()
+    _, planner, scan, fids_sorted, ostages = build_local(rt0, n, seed)
+    oracle = run_battery(planner, scan, fids_sorted)
+
+    deadline = time.monotonic() + timeout_s
+    rcs = [None] * num_processes
+    while time.monotonic() < deadline and any(r is None for r in rcs):
+        for i, pr in enumerate(procs):
+            if rcs[i] is None:
+                rcs[i] = pr.poll()
+        time.sleep(0.2)
+    for pr in procs:
+        if pr.poll() is None:
+            pr.kill()
+    rcs = [pr.poll() for pr in procs]
+
+    ranks = []
+    for path in outs:
+        try:
+            with open(path) as f:
+                ranks.append(json.load(f))
+        except Exception:
+            ranks.append(None)
+
+    checks = _check(oracle, ranks, n, num_processes, web)
+    report = {
+        "ok": all(checks.values()) and all(rc == 0 for rc in rcs),
+        "num_processes": num_processes,
+        "n": n,
+        "exit_codes": rcs,
+        "checks": checks,
+        "oracle": {k: oracle[k] for k in
+                   ("counts", "density_sha", "density_sum")},
+        "ranks": ranks,
+        "oracle_stages": ostages,
+        "work_dir": work,
+        "wall_s": round(time.perf_counter() - t_start, 3),
+    }
+    with open(os.path.join(work, "dryrun_report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    return report
+
+
+def _check(oracle: dict, ranks: List[Optional[dict]], n: int,
+           num_processes: int, web: bool) -> Dict[str, bool]:
+    live = [r for r in ranks if r is not None]
+    checks = {"all_ranks_reported": len(live) == num_processes}
+    if not checks["all_ranks_reported"]:
+        return checks
+    checks["counts_equal"] = all(
+        r["battery"]["counts"] == oracle["counts"] for r in live)
+    checks["density_equal"] = all(
+        r["battery"]["density_sha"] == oracle["density_sha"] for r in live)
+    checks["selects_equal"] = all(
+        r["battery"]["selects"] == oracle["selects"] for r in live)
+    checks["shards_strict_subset"] = all(
+        0 < r["local_rows"] < n for r in live) and \
+        sum(r["local_rows"] for r in live) == n
+    kr = [r["key_range"] for r in sorted(live,
+                                         key=lambda r: r["process_id"])]
+    checks["key_ranges_ordered"] = (
+        all(k is not None for k in kr)
+        and all(kr[i][1] <= kr[i + 1][0] for i in range(len(kr) - 1)))
+    checks["psum_rounds_counted"] = all(
+        r["psum_rounds"] > 0 for r in live)
+    if web:
+        def _fleet_ok(r):
+            nodes = (r["fleet"] or {}).get("nodes") or {}
+            return (len(nodes) == num_processes
+                    and all(v.get("ok") for v in nodes.values()))
+        checks["fleet_registered"] = all(_fleet_ok(r) for r in live)
+    return checks
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="N-process CPU cluster dryrun vs single-process oracle")
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run as one spawned cluster process")
+    ap.add_argument("--out", default="dryrun_report.json")
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--n", type=int, default=20000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--timeout-s", type=float, default=420.0)
+    ap.add_argument("--no-web", action="store_true")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker_main(args.out)
+    report = run_dryrun(args.procs, args.n, args.seed,
+                        timeout_s=args.timeout_s, web=not args.no_web)
+    print(json.dumps({k: report[k] for k in
+                      ("ok", "checks", "wall_s", "work_dir")}, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

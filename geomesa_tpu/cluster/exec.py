@@ -1,0 +1,160 @@
+"""Cluster query execution: psum-reduced global kernels + ordered merge.
+
+The single-process DistributedScan already shards rows across local
+devices; across PROCESSES only two things change, and both live here:
+
+  - count/density jit with ``out_shardings=NamedSharding(mesh, P())``:
+    XLA inserts the cross-process psum, and EVERY process returns the
+    exact global answer (the paper's "psum-reduced hit counts" across a
+    pod). Each dispatch bumps the ``cluster.psum_rounds`` counter the
+    /cluster surface and ``debug cluster`` report.
+  - select/export cannot psum (ragged payloads): each process compacts
+    its LOCAL matches — readable host-side because its block of the
+    global array is addressable — and results stream through a
+    host-side ordered merge. Rank order == Morton key order (the table
+    is partitioned by contiguous key range), so concatenation in rank
+    order IS the global sort order: no re-sort, no k-way heap.
+
+knn is explicitly rejected on an active cluster for now: the f64 host
+re-rank needs candidate coordinates that live on other hosts, and a
+silent f32-only answer would violate the documented contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.cluster.table import ClusterShardedTable
+from geomesa_tpu.parallel.dist import DistributedScan, _build_mask
+
+
+class ClusterScan(DistributedScan):
+    """DistributedScan over a process-partitioned ClusterShardedTable."""
+
+    def __init__(self, sharded: ClusterShardedTable):
+        super().__init__(sharded)
+        self.runtime = sharded.runtime
+        self.layout = sharded.layout
+
+    def _active(self) -> bool:
+        return self.runtime is not None and self.runtime.active()
+
+    # -- psum-reduced global kernels ------------------------------------------
+
+    def _jit(self, fn, replicated_out: bool = False):
+        """The cluster side of DistributedScan's hook: replicated-out
+        reductions compile with ``out_shardings=P()`` so XLA inserts the
+        cross-process psum and every process holds the global answer."""
+        import jax
+        if not self._active() or not replicated_out:
+            return jax.jit(fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.jit(fn,
+                       out_shardings=NamedSharding(self.sharded.mesh, P()))
+
+    def count(self, plan) -> int:
+        if self._active():
+            self.runtime.note_psum_round()
+        return super().count(plan)
+
+    def density(self, plan, bbox, width: int, height: int,
+                weight_attr: Optional[str] = None) -> np.ndarray:
+        if self._active():
+            self.runtime.note_psum_round()
+        return super().density(plan, bbox, width, height, weight_attr)
+
+    def knn(self, plan, x: float, y: float, k: int):
+        if not self._active():
+            return super().knn(plan, x, y, k)
+        raise NotImplementedError(
+            "cluster knn: the exact f64 re-rank needs remote candidate "
+            "coordinates; run knn against a replicated table")
+
+    # -- local compaction + ordered merge -------------------------------------
+
+    def local_mask(self, plan) -> np.ndarray:
+        """This process's boolean match mask over its TRUE local rows
+        (host-readable: the local block of the global mask is
+        addressable). Single-process falls back to the full mask."""
+        if not self._active():
+            return super().mask(plan)
+        import jax
+        import jax.numpy as jnp
+
+        rkey, rfn, boxes, windows, rparams = self._stage(plan)
+        key = ("cluster_mask", plan.primary_kind,
+               plan.windows is not None, rkey)
+
+        def build():
+            def step(cols, boxes, windows, rparams):
+                return _build_mask(cols, plan.primary_kind, boxes,
+                                   windows, rfn, rparams)
+            return jax.jit(step)
+
+        fn = self._fn(key, build)
+        out = fn(self.sharded.columns, boxes, windows, rparams)
+        shards = sorted(out.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards])
+        return local[: self.sharded.local_rows()]
+
+    def mask(self, plan) -> np.ndarray:
+        """Full global mask (hydration). On an active cluster this is an
+        exchange of every process's local mask in rank order — used by
+        oracle comparisons, not hot paths."""
+        if not self._active():
+            return super().mask(plan)
+        local = self.local_mask(plan)
+        parts = ordered_merge(self.runtime,
+                              [int(i) for i in np.flatnonzero(local)])
+        # rebuild global-row mask from per-process match offsets
+        full = np.zeros(self.layout.n_global, dtype=bool)
+        row0 = np.cumsum([0] + [int(r) for r in self.layout.proc_rows])
+        for p, idxs in enumerate(parts):
+            full[row0[p] + np.asarray(idxs, dtype=np.int64)] = True
+        return full
+
+    def select_local(self, plan,
+                     values: Dict[str, np.ndarray]) -> Dict[str, list]:
+        """Compact ``values`` (per-local-row payload columns, e.g. fids)
+        down to this process's matches, in local key order."""
+        m = self.local_mask(plan)
+        idx = np.flatnonzero(m)
+        return {k: [_json_safe(np.asarray(v)[i]) for i in idx]
+                for k, v in values.items()}
+
+    def select_merged(self, plan,
+                      values: Dict[str, np.ndarray]) -> Dict[str, list]:
+        """Global select: local compaction + host-side ordered merge.
+        Every process returns the identical, globally key-ordered
+        result (the client-side FeatureReducer step, collectivized)."""
+        local = self.select_local(plan, values)
+        if not self._active():
+            return local
+        parts = ordered_merge(self.runtime, local)
+        merged: Dict[str, list] = {k: [] for k in values}
+        for part in parts:
+            for k in merged:
+                merged[k].extend(part.get(k, []))
+        return merged
+
+
+def ordered_merge(rt, local_payload) -> List:
+    """All-gather one JSON-safe payload per process, returned in RANK
+    order — which is global key order for key-range-partitioned data.
+    The host-side merge step of every cluster select/export."""
+    return [p["v"] for p in rt.exchange({"v": local_payload})]
+
+
+def _json_safe(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, np.str_):
+        return str(v)
+    return v

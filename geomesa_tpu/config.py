@@ -725,6 +725,60 @@ SOAK_STRETCH = _register(
     "regressed catch-up/burn metrics — proving the fleet gate trips.")
 
 
+# -- multi-process cluster runtime (ISSUE 15) ---------------------------------
+
+CLUSTER = _register(
+    "GEOMESA_TPU_CLUSTER", False, _parse_bool,
+    "Master switch for the multi-process cluster runtime: when true (or "
+    "when GEOMESA_TPU_CLUSTER_COORDINATOR is set) the process joins a "
+    "jax.distributed cluster and the feature table is PARTITIONED by "
+    "Morton key range across processes instead of replicated — counts/"
+    "density psum to the exact global answer on every process, selects "
+    "stream per-process matches through a host-side ordered merge.")
+
+CLUSTER_COORDINATOR = _register(
+    "GEOMESA_TPU_CLUSTER_COORDINATOR", "", str,
+    "Coordinator address host:port for jax.distributed.initialize. "
+    "Every process in the cluster passes the SAME address; the process "
+    "with id 0 binds it. Setting this implies GEOMESA_TPU_CLUSTER=1.")
+
+CLUSTER_NUM_PROCESSES = _register(
+    "GEOMESA_TPU_CLUSTER_NUM_PROCESSES", 1, int,
+    "Total process count in the cluster (jax.distributed num_processes). "
+    "Must match across every process.")
+
+CLUSTER_PROCESS_ID = _register(
+    "GEOMESA_TPU_CLUSTER_PROCESS_ID", 0, int,
+    "This process's rank in [0, num_processes) — also its Morton "
+    "key-range shard ownership slot (rank order == key order).")
+
+CLUSTER_LOCAL_DEVICES = _register(
+    "GEOMESA_TPU_CLUSTER_LOCAL_DEVICES", 0, int,
+    "Local device count hint passed to jax.distributed.initialize on "
+    "backends that need it (CPU dryruns). 0 lets jax/XLA decide "
+    "(XLA_FLAGS --xla_force_host_platform_device_count still applies).")
+
+CLUSTER_TOPOLOGY = _register(
+    "GEOMESA_TPU_CLUSTER_TOPOLOGY", "auto", str,
+    "Mesh topology policy: 'auto' builds a hybrid ICI x DCN mesh "
+    "(create_hybrid_device_mesh) when >1 slice is detected and a flat "
+    "process-contiguous 'rows' mesh otherwise; 'flat' forces the flat "
+    "mesh (CPU dryruns); 'hybrid' requires multi-slice and raises "
+    "without it (fail loudly instead of silently degrading).")
+
+CLUSTER_INIT_TIMEOUT_S = _register(
+    "GEOMESA_TPU_CLUSTER_INIT_TIMEOUT_S", 120.0, float,
+    "Bound on jax.distributed.initialize rendezvous (a missing peer "
+    "fails the bring-up instead of hanging the fleet).")
+
+CLUSTER_WEB_REGISTER = _register(
+    "GEOMESA_TPU_CLUSTER_WEB_REGISTER", True, _parse_bool,
+    "When a cluster process starts its web surface, exchange the bound "
+    "address across processes and install a Federator over ALL of them "
+    "on every rank — cluster nodes appear in /fleet with no manual "
+    "--addr lists.")
+
+
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property
     (the CLI `config` listing / docs surface)."""

@@ -879,6 +879,24 @@ class TpuDataStore:
                 raise ValueError(f"No data written to {type_name}")
         return self.planners[type_name]
 
+    def cluster_scan(self, type_name: str):
+        """ClusterScan over the type's primary index: on an active
+        multi-process cluster the (locally-held, key-range-partitioned)
+        index columns assemble into process-spanning global arrays —
+        counts/density psum to the exact global answer, selects merge in
+        rank order. Single-process it is an ordinary DistributedScan
+        over the local mesh. The shard layout registers on /cluster."""
+        from geomesa_tpu.cluster.exec import ClusterScan
+        from geomesa_tpu.cluster.runtime import runtime
+        from geomesa_tpu.cluster.table import ClusterShardedTable
+        rt = runtime()
+        idx = self.planner(type_name).indexes[0]
+        host_cols = {k: np.asarray(v)
+                     for k, v in idx.device.columns.items()}
+        st = ClusterShardedTable.from_local_columns(rt, host_cols)
+        rt.register_table(type_name, st.layout.summary())
+        return ClusterScan(st)
+
     def query(self, type_name: str, f: Union[str, ir.Filter] = "INCLUDE",
               hints: Optional[dict] = None, auths: Optional[list] = None,
               deadline_ms: Optional[float] = None):

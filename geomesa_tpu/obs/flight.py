@@ -284,7 +284,9 @@ def event_from_request(req, fut) -> dict:
         return None if seconds is None else round(seconds * 1000.0, 3)
 
     from geomesa_tpu import trace as _trace
+    from geomesa_tpu.cluster.runtime import event_dims as _cluster_dims
     return {
+        **_cluster_dims(),
         "kind": "count.scheduled",
         "type": req.type_name,
         "trace_id": req.trace_id,
@@ -346,6 +348,7 @@ def event_from_trace(t, retained: bool = False,
     optional precomputed per-kind self-time breakdown (the close hook
     shares one span walk between sampling and this)."""
     from geomesa_tpu import trace as _trace
+    from geomesa_tpu.cluster.runtime import event_dims as _cluster_dims
     if stages is None:
         stages = t.self_times_ms()
     device_ms = stages.get("device_scan", 0.0) + stages.get("device_wait", 0.0)
@@ -353,6 +356,7 @@ def event_from_trace(t, retained: bool = False,
     f = attrs.get("filter")
     parent = getattr(t, "parent", None)
     ev = {
+        **_cluster_dims(),
         "ts_ms": t.ts_ms,
         "kind": t.name,
         "type": attrs.get("type"),

@@ -71,6 +71,15 @@ _OVERRIDES = {
     "cfg11_acked_write_loss": "exact",
     "cfg11_clean_incidents": "exact",
     "cfg11_worst_phase_burn_rate": "lower",
+    # cluster dryrun (cfg12): exactness vs the single-process oracle is
+    # a correctness axis — a psum count / merged select / density grid
+    # that drifts from byte-equality is a distribution bug, never noise,
+    # and a shard that stops being a strict subset means partitioning
+    # silently degenerated to replication
+    "cfg12_count_mismatch": "exact",
+    "cfg12_select_mismatch": "exact",
+    "cfg12_density_mismatch": "exact",
+    "cfg12_shard_strict_subset": "exact",
 }
 
 
@@ -234,6 +243,26 @@ def compare(summary: dict, baselines: dict,
     ratio = _speed_ratio(run_metrics, baselines)
     same_scale = (summary.get("meta") or {}).get("n_points") \
         == (baselines.get("meta") or {}).get("n_points")
+    run_procs = int((summary.get("meta") or {}).get("num_processes") or 1)
+    base_procs = int((baselines.get("meta") or {}).get("num_processes") or 1)
+    if run_procs != base_procs:
+        # a single-process baseline says nothing about a multi-process
+        # run (collectives, host exchange, per-shard cardinality all
+        # differ) — a mismatch is a new baseline population, never a
+        # regression or an improvement
+        return {
+            "schema": SCHEMA, "ok": True,
+            "k": k, "min_rel": min_rel, "speed_ratio": 1.0,
+            "same_scale": False,
+            "process_mismatch": {"run": run_procs, "baseline": base_procs},
+            "checked": 0,
+            "regressions": [], "improvements": [], "missing_metrics": [],
+            "new_metrics": sorted(
+                n for n in run_metrics
+                if metric_direction(n) != "skip"
+                and isinstance(run_metrics[n], (int, float))),
+            "kernels": attribute_kernels({}, {}),
+        }
 
     regressions, improvements, missing, new = [], [], [], []
     checked = 0
@@ -361,6 +390,12 @@ def render(report: dict) -> str:
         f"{report['checked']} metric(s) checked "
         f"(k={report['k']}, floor={report['min_rel']:.0%}, "
         f"speed_ratio={report['speed_ratio']})")
+    pm = report.get("process_mismatch")
+    if pm:
+        lines.append(
+            f"  process-count mismatch: run has {pm['run']} process(es), "
+            f"baseline has {pm['baseline']} — treating every metric as "
+            f"new-baseline (nothing compared, nothing gated)")
     for r in report["regressions"]:
         if r.get("kind") == "value_changed":
             lines.append(f"  REGRESSION {r['metric']}: {r['value']} != "

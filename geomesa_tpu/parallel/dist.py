@@ -60,6 +60,15 @@ class DistributedScan:
             self._jitted[key] = builder()
         return self._jitted[key]
 
+    def _jit(self, fn, replicated_out: bool = False):
+        """Compile one scan step. ``replicated_out`` marks the reductions
+        whose result must be identical everywhere (count/density/knn) —
+        the single-process hook point cluster.exec.ClusterScan overrides
+        with ``out_shardings=NamedSharding(mesh, P())`` so XLA inserts
+        the cross-process psum and EVERY process returns the exact
+        global answer."""
+        return jax.jit(fn)
+
     def _stage(self, plan):
         """(rkey, rfn, boxes, windows, rparams) — shared plan staging:
         residual unpack + replicated query constants (one home for the four
@@ -82,7 +91,7 @@ class DistributedScan:
             def step(cols, boxes, windows, rparams):
                 return jnp.sum(_build_mask(cols, plan.primary_kind, boxes,
                                            windows, rfn, rparams))
-            return jax.jit(step)
+            return self._jit(step, replicated_out=True)
 
         fn = self._fn(key, build)
         return int(fn(self.sharded.columns, boxes, windows, rparams))
@@ -98,7 +107,7 @@ class DistributedScan:
                 m = _build_mask(cols, plan.primary_kind, boxes, windows, rfn, rparams)
                 w = cols[weight_attr] if weight_attr else None
                 return density_kernel(m, cols["xf"], cols["yf"], grid, width, height, w)
-            return jax.jit(step)
+            return self._jit(step, replicated_out=True)
 
         fn = self._fn(key, build)
         grid = self.sharded.replicated(np.asarray(bbox, dtype=np.float32))
@@ -136,7 +145,7 @@ class DistributedScan:
                 d = jnp.where(m, d, jnp.inf)
                 vals, idxs = jax.lax.top_k(-d, m_cap)
                 return -vals, idxs
-            return jax.jit(step)
+            return self._jit(step, replicated_out=True)
 
         fn = self._fn(key, build)
         q = self.sharded.replicated(np.array([x, y], dtype=np.float32))
@@ -163,7 +172,7 @@ class DistributedScan:
         def build():
             def step(cols, boxes, windows, rparams):
                 return _build_mask(cols, plan.primary_kind, boxes, windows, rfn, rparams)
-            return jax.jit(step)
+            return self._jit(step)
 
         fn = self._fn(key, build)
         return np.asarray(fn(self.sharded.columns, boxes, windows, rparams))[: self.sharded.n]
@@ -248,6 +257,12 @@ def mesh_sort_perm(planes=None, shards=None, n: Optional[int] = None,
     e.g. from the round-robin streaming upload) supplies the keys. Returns
     the int32 permutation on the default device — bitwise identical to
     ``np.lexsort(tuple(reversed(planes)))``.
+
+    Scope: LOCAL devices. Across process boundaries the same splitter
+    discipline continues host-side in cluster/build.py:cluster_partition
+    (sample exchange -> global splitters -> row exchange), so a
+    multi-process index build lands each process a contiguous sorted key
+    range with no post-hoc global sort.
     """
     import time as _time
 

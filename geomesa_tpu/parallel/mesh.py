@@ -27,8 +27,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def create_mesh(n_devices: Optional[int] = None, axis: str = "rows") -> Mesh:
+    """The row mesh. Topology routes through the cluster runtime when a
+    multi-process cluster is active (process-contiguous device order,
+    hybrid ICI x DCN across slices — cluster/runtime.py); otherwise a
+    flat mesh over the local devices.
+
+    An impossible ``n_devices`` raises instead of silently truncating:
+    computing on a partial device set while the caller believes it has
+    the mesh it asked for is exactly the quiet-wrong-answer failure the
+    cluster config is meant to rule out."""
+    if n_devices is not None and n_devices < 1:
+        raise ValueError(f"create_mesh: n_devices={n_devices} (want >= 1)")
+    from geomesa_tpu.cluster.runtime import cluster_active, runtime
+    if cluster_active():
+        mesh = runtime().mesh(axis)
+        if n_devices is not None and n_devices != mesh.devices.size:
+            raise ValueError(
+                f"create_mesh: n_devices={n_devices} conflicts with the "
+                f"active cluster mesh ({mesh.devices.size} devices over "
+                f"{runtime().num_processes} processes); topology is owned "
+                "by GEOMESA_TPU_CLUSTER_* config")
+        return mesh
     devs = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"create_mesh: {n_devices} devices requested but only "
+                f"{len(devs)} present — refusing to silently truncate")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
 
@@ -66,6 +91,18 @@ class ShardedTable:
         valid[:n] = True
         cols["__valid__"] = jax.device_put(valid, sharding)
         return cls(mesh, n, n_padded, cols, host_xy)
+
+    @classmethod
+    def from_process_local(cls, rt, local_cols: Dict[str, np.ndarray],
+                           key_bounds=None, axis: str = "rows"):
+        """The multi-process construction path: THIS process's contiguous
+        key-range shard assembles into one global array with
+        ``jax.make_array_from_process_local_data`` (cluster/table.py).
+        Collective across the cluster; single-process it degrades to
+        ``from_host_columns``."""
+        from geomesa_tpu.cluster.table import ClusterShardedTable
+        return ClusterShardedTable.from_local_columns(
+            rt, local_cols, key_bounds=key_bounds, axis=axis)
 
     def replicated(self, arr: np.ndarray) -> jnp.ndarray:
         """Place query constants replicated on every device."""

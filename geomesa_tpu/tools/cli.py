@@ -12,10 +12,11 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|cache|admission|wal|replication|workload
+    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|cache|admission|wal|replication|workload|cluster
                               [--format prometheus] [--slow MS] [--errors]
                               [--kind K] [--addr HOST:PORT ...] [-s STORE -f NAME -q ECQL]
                               [--id TRACE_ID --fleet]   (debug trace: stitched tree)
+    geomesa-tpu cluster-dryrun [--procs N] [--n ROWS] [--out DIR] [--no-web]
     geomesa-tpu serve         -s STORE [--durable] [--ship-port P] [--port W]
     geomesa-tpu replica       --dir DIR --follow HOST:PORT [--port W] [--id ID]
     geomesa-tpu router        --endpoint NAME=HOST:PORT ... [--port P]
@@ -361,6 +362,35 @@ def cmd_debug(args):
                       ("replication.lag_seqs", "replication.lag_ms",
                        "replication.followers") if k in gauges}
         print(json.dumps(out, indent=2, default=str))
+    elif args.what == "cluster":
+        # the partition plane runbook surface: process count, per-process
+        # rows, Morton key-range ownership, mesh topology (axes, ICI/DCN
+        # shape), psum round counters — this process's runtime, or a
+        # RUNNING cluster node's GET /cluster via --addr (fleet parity
+        # with `debug replication`)
+        out = {}
+        if args.addr:
+            import urllib.request
+            for addr in args.addr:
+                base = addr if addr.startswith("http") else f"http://{addr}"
+                try:
+                    with urllib.request.urlopen(base + "/cluster",
+                                                timeout=5) as r:
+                        node = json.loads(r.read().decode())
+                except OSError as e:
+                    node = {"error": str(e)}
+                if len(args.addr) == 1:
+                    out.update(node)
+                else:
+                    out.setdefault("nodes", {})[addr] = node
+        else:
+            from geomesa_tpu.cluster.runtime import runtime as _cluster_rt
+            out = _cluster_rt(init=False).state()
+        snap = REGISTRY.snapshot_prefixed("cluster.")
+        metrics = {k: v for k, v in snap.items() if v}
+        if metrics:
+            out["metrics"] = metrics
+        print(json.dumps(out, indent=2, default=str))
     elif args.what == "trace":
         # the stitched cross-process tree for one global trace id:
         # collect this process's halves plus every --addr node's
@@ -641,6 +671,22 @@ def cmd_soak(args):
         raise SystemExit(2)
 
 
+def cmd_cluster_dryrun(args):
+    """The partition-plane soak: spawn --procs CPU worker processes, build
+    ONE table sharded across them by contiguous Morton key-range, and check
+    that psum-reduced counts/density and host-merged selects are byte-equal
+    to the single-process oracle (same code path, inactive runtime). Exits
+    nonzero when any exactness check fails."""
+    from geomesa_tpu.cluster.dryrun import run_dryrun
+    report = run_dryrun(args.procs, args.n, args.seed,
+                        timeout_s=args.timeout_s, out_dir=args.out,
+                        web=not args.no_web)
+    print(json.dumps({k: report[k] for k in
+                      ("ok", "checks", "wall_s", "work_dir")}, indent=2))
+    if not report["ok"]:
+        raise SystemExit(2)
+
+
 def cmd_doctor(args):
     """The fleet doctor's verdicts: evaluate the anomaly detectors and
     print ONE line per incident — what fired, since when, suspected
@@ -796,7 +842,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=("metrics", "traces", "trace", "events",
                                      "slo", "kernels", "scheduler", "cache",
                                      "admission", "wal", "replication",
-                                     "workload", "incidents"))
+                                     "workload", "incidents", "cluster"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "
@@ -916,6 +962,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="scratch directory for the fleet's durable "
                          "stores (default: a temp dir)")
     sp.set_defaults(fn=cmd_soak)
+
+    sp = sub.add_parser(
+        "cluster-dryrun",
+        help="2-process CPU cluster dryrun: spawn worker subprocesses, "
+             "shard one table across them by Morton key-range, check "
+             "psum counts / density / merged selects byte-equal against "
+             "the single-process oracle")
+    sp.add_argument("--procs", type=int, default=2,
+                    help="number of worker processes (default 2)")
+    sp.add_argument("--n", type=int, default=20000,
+                    help="corpus rows (default 20000)")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.add_argument("--timeout-s", type=float, default=420.0,
+                    help="hard deadline for the worker fleet")
+    sp.add_argument("--out", default=None, metavar="DIR",
+                    help="directory for rank reports / logs / "
+                         "dryrun_report.json (default: a temp dir)")
+    sp.add_argument("--no-web", action="store_true",
+                    help="skip the per-rank REST server + federation "
+                         "registration checks")
+    sp.set_defaults(fn=cmd_cluster_dryrun)
 
     sp = sub.add_parser(
         "replica",

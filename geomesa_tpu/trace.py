@@ -371,6 +371,11 @@ class QueryTrace:
                "stages_ms": {k: round(v, 3)
                              for k, v in self.self_times_ms().items()},
                "root": self.root.to_dict()}
+        try:
+            from geomesa_tpu.cluster.runtime import event_dims
+            out.update(event_dims())   # process/shard on an active cluster
+        except Exception:
+            pass
         if self.parent is not None:
             out["parent"] = self.parent.to_dict()
         if self.error is not None:

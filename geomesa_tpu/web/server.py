@@ -63,7 +63,12 @@ Routes:
   POST /replication/promote?port=      → promote this replica to primary
                                          under a fresh fencing epoch
   GET  /healthz                        → liveness + device count + durability,
-                                         recovery/replay and replication state
+                                         recovery/replay, replication and
+                                         cluster-shard state
+  GET  /cluster                        → partition plane: process count,
+                                         per-process rows, Morton key-range
+                                         ownership, mesh topology, psum
+                                         round counters
   GET  /config                         → system-property listing
 
 Mutating routes on a read-only replica (or a fenced ex-primary) return 403
@@ -336,6 +341,13 @@ class GeoJsonApi:
                 # every node's doctor verdicts with node attribution
                 return 200, fed.fleet_incidents()
             return 404, {"error": f"no route {method} {path}"}
+        if parts == ["cluster"]:
+            # the partition plane: process count, per-process rows, Morton
+            # key-range ownership, mesh topology, psum round counters.
+            # (/fleet is the REPLICATION plane: full-copy nodes behind the
+            # router. A cluster shard can still have read replicas.)
+            from geomesa_tpu.cluster.runtime import runtime as _cluster_rt
+            return 200, _cluster_rt(init=False).state()
         if parts == ["healthz"]:
             import jax
             report = getattr(self.store, "recovery_report", None)
@@ -357,8 +369,20 @@ class GeoJsonApi:
             except Exception:
                 slo = {"status": "unknown"}
             repl = getattr(self.store, "replication", None)
+            from geomesa_tpu.cluster.runtime import runtime as _cluster_rt
+            c = _cluster_rt(init=False)
+            cluster = {"active": c.active()}
+            if c.active():
+                cluster.update({
+                    "process_id": c.process_id,
+                    "num_processes": c.num_processes,
+                    "psum_rounds": c.psum_rounds,
+                    "shard_rows": {
+                        t: s.get("proc_rows", [None] * (c.process_id + 1))
+                        [c.process_id] for t, s in c.tables.items()}})
             return 200, {"status": "ok",
                          "node": self._node_meta(),
+                         "cluster": cluster,
                          "devices": len(jax.local_devices()),
                          "types": len(self.store.get_type_names()),
                          "overload": overload,

@@ -137,6 +137,29 @@ def test_exact_metric_drift_flags_at_equal_scale():
     assert ok["ok"]
 
 
+def test_process_count_mismatch_is_new_baseline_never_a_gate():
+    """ISSUE 15 bench honesty: single-process baselines say nothing about
+    a multi-process run (collectives, host exchange, shard cardinality
+    all differ) — a num_processes mismatch compares NOTHING, flags
+    nothing, and names itself in the report/render."""
+    base = _baselines({"cfg1_blocking_p50_ms": [100.0, 101.0, 99.0],
+                       "cfg1_matched": [880809.0]})
+    run = _summary({"cfg1_blocking_p50_ms": 9999.0,   # would regress hard
+                    "cfg1_matched": 1.0})             # would flag exact
+    run["meta"]["num_processes"] = 2                  # baseline has 1
+    rep = pw.compare(run, base)
+    assert rep["ok"] and not rep["regressions"] and not rep["improvements"]
+    assert rep["checked"] == 0
+    assert rep["process_mismatch"] == {"run": 2, "baseline": 1}
+    assert "cfg1_blocking_p50_ms" in rep["new_metrics"]
+    assert "process-count mismatch" in pw.render(rep)
+    # equal process counts (even > 1) compare normally
+    base["meta"]["num_processes"] = 2
+    rep2 = pw.compare(run, base)
+    assert "process_mismatch" not in rep2
+    assert not rep2["ok"]
+
+
 def test_machine_normalization_scales_thresholds():
     """A 2x-slower host (CPU proxy doubled) must not flag durations that
     merely scaled with the machine."""
