@@ -1189,14 +1189,17 @@ def main(args=None) -> int:
             _cfg.ADMIT_INTERACTIVE.set(8)
             sched9.count("hotq", hot_q, tenant="victim")  # re-warm
 
-            def probe9(k) -> float:
+            def probe9_lat(k) -> np.ndarray:
                 lat = []
                 for _ in range(k):
                     t0 = time.perf_counter()
                     sched9.count("hotq", hot_q, tenant="victim",
                                  timeout=30)
                     lat.append(time.perf_counter() - t0)
-                return float(np.percentile(np.asarray(lat) * 1000.0, 99))
+                return np.asarray(lat) * 1000.0
+
+            def probe9(k) -> float:
+                return float(np.percentile(probe9_lat(k), 99))
 
             k9 = 100 if args.mini else 300
             p99_unloaded = probe9(k9)
@@ -1222,11 +1225,18 @@ def main(args=None) -> int:
             [t.start() for t in threads9]
             try:
                 time.sleep(0.1)
-                # best-of-3 while the storm is live: a single probe's p99
-                # is one GIL hiccup away from tripping the 2x bound on a
-                # loaded host, but QoS starvation (the property pinned
-                # here) degrades EVERY probe, never just one
-                p99_storm = min(probe9(k9) for _ in range(3))
+                # element-wise minimum over three interleaved passes
+                # while the storm is live: scheduler hiccups land on
+                # independent indices each pass, so min() needs all
+                # three to stall at the SAME probe before the p99 moves
+                # (~p^3), while QoS starvation — the property pinned
+                # here — inflates every index of every pass and survives
+                # the minimum untouched. min-of-whole-p99 retries still
+                # flaked on loaded hosts: one pass fully inside a noisy
+                # window poisons its own p99 and two clean passes can't
+                # repair a third's tail
+                passes9 = np.stack([probe9_lat(k9) for _ in range(3)])
+                p99_storm = float(np.percentile(passes9.min(axis=0), 99))
             finally:
                 stop9.set()
                 [t.join(timeout=30) for t in threads9]
@@ -1561,6 +1571,97 @@ def main(args=None) -> int:
                                            key=lambda r: r["process_id"])]},
                       fh, indent=1)
         assert rep12["ok"], ch12
+
+    if "13" in configs:
+        # cfg13 — shard balance observatory drill (obs/shardwatch.py +
+        # cluster/dryrun.py --drill): the SAME 2-process gloo fleet as
+        # cfg12, judged two-sided like cfg11. Skew half: rank 0 fires a
+        # Zipf storm at cells owned by the OTHER rank's key range — the
+        # ledger must put the load ratio over the pinned bar, open
+        # exactly one shard_imbalance incident, attribute it to the
+        # victim shard, and project split keys inside the victim's key
+        # range. Uniform control half: the same event count spread
+        # evenly must read near-1.0 balance with ZERO incidents (an
+        # observatory that cries wolf fails the gate as hard as one
+        # that misses the storm). All six verdict axes are pinned exact
+        # in perfwatch._OVERRIDES; the balance scores and wall times
+        # ride the statistical gate. Not in the default config lists —
+        # it spawns worker processes, so it rides the balance CI job.
+        from geomesa_tpu.cluster import dryrun as _cdry
+        n13 = int(os.environ.get("GEOMESA_TPU_BENCH_CLUSTER_N",
+                                 "8000" if args.mini else "20000"))
+        halves13 = {}
+        for mode13 in ("skew", "uniform"):
+            halves13[mode13] = _cdry.run_dryrun(
+                num_processes=2, n=n13, drill=mode13,
+                out_dir=os.path.join(REPO, f"BENCH_balance_{mode13}"))
+        skew13 = halves13["skew"]
+        ctrl13 = halves13["uniform"]
+
+        def _drill13(rep, pid=0):
+            r = next((x for x in rep["ranks"]
+                      if x and x["process_id"] == pid), None)
+            return (r or {}).get("drill") or {}
+
+        dsk = _drill13(skew13)
+        dct = _drill13(ctrl13)
+        sc_sk = ((dsk.get("balance") or {}).get("types") or {}) \
+            .get("pts", {}).get("score", {})
+        sc_ct = ((dct.get("balance") or {}).get("types") or {}) \
+            .get("pts", {}).get("score", {})
+        inc_sk = dsk.get("imbalance_incidents") or []
+        victim13 = dsk.get("victim")
+        vrange13 = ((dsk.get("balance") or {}).get("types") or {}) \
+            .get("pts", {}).get("shards", {}).get(victim13, {}) \
+            .get("key_range") or [None, None]
+        splits13 = (((dsk.get("balance") or {}).get("types") or {})
+                    .get("pts", {}).get("splits") or {}) \
+            .get("boundaries") or []
+        # the six pinned verdict axes (exact in perfwatch._OVERRIDES)
+        detail["cfg13_skew_flagged"] = 1 if sc_sk.get("over_bar") else 0
+        detail["cfg13_skew_incidents"] = len(inc_sk)
+        detail["cfg13_skew_attributed"] = (
+            1 if (len(inc_sk) == 1 and
+                  (inc_sk[0].get("suspect") or {}).get("shard")
+                  == victim13) else 0)
+        detail["cfg13_skew_splits_in_range"] = (
+            1 if (splits13 and vrange13[0] is not None and all(
+                vrange13[0] < b["key"] <= vrange13[1] + 1
+                for b in splits13)) else 0)
+        detail["cfg13_control_incidents"] = len(
+            dct.get("imbalance_incidents") or [])
+        detail["cfg13_control_balanced"] = (
+            1 if (sc_ct.get("max_over_mean") or 99.0) <= 1.35 else 0)
+        # federation + battery sanity ride along as exact too: the drill
+        # corpus must still pass the oracle equality checks, and the
+        # fleet-merged verdict must come from BOTH nodes
+        fb13 = (dsk.get("fleet_balance") or {})
+        detail["cfg13_fleet_federated"] = (
+            1 if (len(fb13.get("nodes") or {}) == 2
+                  and not fb13.get("partial")) else 0)
+        detail["cfg13_dryrun_ok"] = (
+            1 if (skew13["ok"] and ctrl13["ok"]) else 0)
+        # statistical axes
+        detail["cfg13_skew_max_over_mean"] = round(
+            float(sc_sk.get("max_over_mean") or 0.0), 4)
+        detail["cfg13_control_max_over_mean"] = round(
+            float(sc_ct.get("max_over_mean") or 0.0), 4)
+        live13 = [r for r in skew13["ranks"] if r]
+        if live13:
+            detail["cfg13_shard_map_s"] = round(max(
+                r["stages"].get("shard_map_s", 0.0) for r in live13), 3)
+        detail["cfg13_wall_s"] = round(
+            skew13["wall_s"] + ctrl13["wall_s"], 3)
+        # balance artifact (CI uploads it): both halves' verdicts with
+        # the projected split points for the hot shard
+        with open(os.path.join(REPO, "BENCH_balance.json"), "w") as fh:
+            json.dump({
+                "n": n13,
+                "skew": {"checks": skew13["checks"], "drill": dsk},
+                "control": {"checks": ctrl13["checks"], "drill": dct},
+            }, fh, indent=1)
+        assert skew13["ok"], skew13["checks"]
+        assert ctrl13["ok"], ctrl13["checks"]
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

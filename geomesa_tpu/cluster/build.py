@@ -33,17 +33,22 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from geomesa_tpu import config
-from geomesa_tpu.cluster.runtime import ClusterRuntime
+from geomesa_tpu.cluster.runtime import ClusterRuntime, note_collective
 
 
 def _allgather_u8(rt: ClusterRuntime, arr: np.ndarray,
                   rows: List[int]) -> List[np.ndarray]:
     """All-gather a per-process (n_p, w) uint8 matrix; ``rows`` is every
     process's row count (already exchanged). Returns one matrix per
-    process, unpadded."""
+    process, unpadded. This is the bulk row-payload mover of the
+    partition build — timed as ``cluster.collective.row_exchange`` with
+    the padded wire size as its payload-bytes gauge."""
+    import time as _time
+
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
+    t0 = _time.perf_counter()
     cap = max(1, max(rows))
     w = arr.shape[1] if arr.ndim == 2 else 1
     buf = np.zeros((cap, w), dtype=np.uint8)
@@ -51,6 +56,8 @@ def _allgather_u8(rt: ClusterRuntime, arr: np.ndarray,
         buf[:len(arr)] = arr.reshape(len(arr), w)
     out = np.asarray(multihost_utils.process_allgather(
         jnp.asarray(buf))).reshape(rt.num_processes, cap, w)
+    note_collective("row_exchange", _time.perf_counter() - t0,
+                    payload_bytes=cap * w * rt.num_processes)
     return [out[p, :rows[p]] for p in range(rt.num_processes)]
 
 

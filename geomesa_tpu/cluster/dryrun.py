@@ -62,14 +62,28 @@ DENSITY_BBOX = (-90.0, -45.0, 90.0, 45.0)
 DENSITY_WH = (64, 32)
 
 
-def make_corpus(n: int, seed: int) -> Dict[str, np.ndarray]:
+# balance-drill corpus window: a 2-hour dtg span starting on an
+# epoch-week boundary keeps every row in ONE z3 time bin, so the
+# (bin << 48 | z) partition keys become spatial-major and coarse Morton
+# cells map cleanly onto contiguous shard key ranges (the default
+# 30-day corpus interleaves time bins and spatial cells straddle shards)
+DRILL_START = "2020-01-06T00:00:00"
+DRILL_SPAN_MS = 2 * 3600 * 1000
+
+
+def make_corpus(n: int, seed: int, span_ms: Optional[int] = None,
+                start: Optional[str] = None) -> Dict[str, np.ndarray]:
     """Deterministic shared corpus; the tail duplicates head rows
-    (same point, same timestamp) to force key ties across processes."""
+    (same point, same timestamp) to force key ties across processes.
+    ``span_ms``/``start`` narrow the dtg window (the balance drill needs
+    a single z3 time bin); defaults reproduce the historical corpus."""
     rng = np.random.default_rng(seed)
     x = rng.uniform(-180, 180, n)
     y = rng.uniform(-90, 90, n)
-    base = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
-    dtg = base + rng.integers(0, 30 * 86400000, n)
+    base = np.datetime64(start or "2020-01-01T00:00:00",
+                         "ms").astype(np.int64)
+    span = int(span_ms) if span_ms else 30 * 86400000
+    dtg = base + rng.integers(0, span, n)
     name = rng.choice(["a", "b", "c"], n)
     val = rng.integers(0, 100, n).astype(np.int32)
     dup = max(1, n // 64)
@@ -105,11 +119,13 @@ def inactive_runtime() -> ClusterRuntime:
 
 
 def build_local(rt: ClusterRuntime, n: int, seed: int,
-                stages: Optional[dict] = None):
+                stages: Optional[dict] = None,
+                span_ms: Optional[int] = None,
+                start: Optional[str] = None):
     """Slice → partition → store/index → global table. Collective when
     the runtime is active; the complete single-process pipeline when
     not (the oracle)."""
-    from geomesa_tpu import DataStoreFinder
+    from geomesa_tpu import DataStoreFinder, config
     from geomesa_tpu.cluster.build import cluster_partition
     from geomesa_tpu.cluster.exec import ClusterScan
     from geomesa_tpu.cluster.table import ClusterShardedTable
@@ -119,7 +135,7 @@ def build_local(rt: ClusterRuntime, n: int, seed: int,
     if stages is None:
         stages = {}
     t0 = time.perf_counter()
-    corpus = make_corpus(n, seed)
+    corpus = make_corpus(n, seed, span_ms=span_ms, start=start)
     if rt.active():
         ids = np.arange(rt.process_id, n, rt.num_processes, dtype=np.int64)
     else:
@@ -156,6 +172,18 @@ def build_local(rt: ClusterRuntime, n: int, seed: int,
                                                 key_bounds=bounds)
     stages["global_table_s"] = round(time.perf_counter() - t0, 3)
     rt.register_table(TYPE, st.layout.summary())
+    if config.SHARDWATCH_ENABLED.get():
+        # shard balance observatory: exchange the empirical cell -> shard
+        # occupancy map (collective — symmetric because the knob is env-
+        # driven and identical across ranks) and install it in the ledger
+        from geomesa_tpu.cluster.table import shard_cell_map
+        from geomesa_tpu.obs import shardwatch as _shardwatch
+        t0 = time.perf_counter()
+        cells, key_ranges, shard_rows = shard_cell_map(
+            rt, part["x"], part["y"], keys_l)
+        _shardwatch.WATCH.set_shard_map(TYPE, cells, key_ranges,
+                                        shard_rows)
+        stages["shard_map_s"] = round(time.perf_counter() - t0, 3)
     fids_sorted = np.asarray(planner.table.fids)[np.asarray(idx.perm)]
     return ds, planner, ClusterScan(st), fids_sorted, stages
 
@@ -191,6 +219,90 @@ def run_battery(planner, scan, fids_sorted) -> dict:
     return out
 
 
+# -- the balance drill --------------------------------------------------------
+
+
+def _drill_cells(cells: Dict[str, dict], shard: str, k: int = 16,
+                 min_rows: int = 8, min_share: float = 0.9) -> List[str]:
+    """Cells owned (>= ``min_share`` of their rows) by ``shard`` with
+    enough rows to be meaningful, densest first — the drill's target
+    set (clean ownership keeps the expected attribution unambiguous)."""
+    owned = []
+    for cell, owners in cells.items():
+        rows = {s: int(o["rows"]) for s, o in owners.items()}
+        tot = sum(rows.values())
+        if tot >= min_rows and rows.get(shard, 0) / tot >= min_share:
+            owned.append((cell, tot))
+    owned.sort(key=lambda t: (-t[1], t[0]))
+    return [c for c, _ in owned[:k]]
+
+
+def run_drill(rt: ClusterRuntime, mode: str, seed: int,
+              n_events: Optional[int] = None) -> dict:
+    """The balance drill: rank 0 synthesizes a query-event storm through
+    the observability plane's own input surface (flight record → workload
+    tee → shardwatch ledger), then every rank reports its ledger verdict.
+
+    ``skew`` is a Zipf storm (s=1.3) over cells owned by the LAST shard
+    — rank 0 emits the events, so the ledger must attribute load across
+    a rank boundary to name the victim. ``uniform`` spreads the same
+    event count evenly over every shard's cells (the two-sided control:
+    balance ≈ 1.0, zero incidents)."""
+    from geomesa_tpu.obs import flight as _flight
+    from geomesa_tpu.obs import shardwatch as _shardwatch
+    from geomesa_tpu.obs.doctor import DOCTOR
+
+    n_events = int(n_events if n_events is not None else os.environ.get(
+        "GEOMESA_TPU_DRYRUN_DRILL_EVENTS", "600"))
+    smap = (_shardwatch.WATCH.export_state()["maps"] or {}).get(TYPE) \
+        or {}
+    cells = smap.get("cells") or {}
+    shards = sorted(smap.get("key_ranges") or {})
+    victim = shards[-1] if shards else "0"
+    out: dict = {"mode": mode, "victim": victim, "events": 0}
+    if rt.process_id == 0 and cells:
+        rng = np.random.default_rng(seed + 1000)
+        now_ms = int(time.time() * 1000)
+        if mode == "skew":
+            pool = _drill_cells(cells, victim)
+            w = 1.0 / np.arange(1, len(pool) + 1, dtype=np.float64) ** 1.3
+        else:
+            # equal weight PER SHARD (not per cell) so the control stays
+            # balanced even when shards differ in qualifying-cell count
+            pool, wl = [], []
+            for s in shards:
+                owned = _drill_cells(cells, s)
+                pool.extend(owned)
+                wl.extend([1.0 / max(1, len(owned))] * len(owned))
+            w = np.asarray(wl, dtype=np.float64)
+        if len(pool):
+            w = w / w.sum()
+            picks = rng.choice(len(pool), size=n_events, p=w)
+            for j, i in enumerate(picks):
+                cell = pool[int(i)]
+                rows = sum(int(o["rows"]) for o in cells[cell].values())
+                _flight.RECORDER.record({
+                    "ts_ms": now_ms, "kind": "query", "type": TYPE,
+                    "plan_hash": f"drill:{cell}", "cell": cell,
+                    "priority": "interactive",
+                    "tenant": f"drill{j % 3}",
+                    "duration_ms": 2.0, "rows_scanned": rows,
+                    "rows_matched": rows, "device_ms": 0.4})
+            out["events"] = int(n_events)
+            out["pool_cells"] = len(pool)
+    out["balance"] = _shardwatch.WATCH.balance()
+    res = DOCTOR.evaluate()
+    out["alerts"] = [a for a in res.get("alerts", [])
+                     if a["rule"] in ("shard_imbalance",
+                                      "collective_straggler")]
+    out["imbalance_incidents"] = [
+        {"rule": i.get("rule"), "cause": i.get("cause"),
+         "suspect": i.get("suspect"), "status": i.get("status")}
+        for i in res.get("incidents", [])
+        if i.get("rule") == "shard_imbalance"]
+    return out
+
+
 # -- worker entry (one process of the cluster) --------------------------------
 
 
@@ -198,14 +310,20 @@ def worker_main(out_path: str) -> int:
     n = int(os.environ.get("GEOMESA_TPU_DRYRUN_N", "20000"))
     seed = int(os.environ.get("GEOMESA_TPU_DRYRUN_SEED", "7"))
     with_web = os.environ.get("GEOMESA_TPU_DRYRUN_WEB", "1") != "0"
+    drill = os.environ.get("GEOMESA_TPU_DRYRUN_DRILL", "").strip().lower()
+    span_ms = os.environ.get("GEOMESA_TPU_DRYRUN_SPAN_MS")
+    start = os.environ.get("GEOMESA_TPU_DRYRUN_START") or None
     t_start = time.perf_counter()
     rt = runtime()
     stages: dict = {}
-    ds, planner, scan, fids_sorted, stages = build_local(rt, n, seed,
-                                                         stages)
+    ds, planner, scan, fids_sorted, stages = build_local(
+        rt, n, seed, stages,
+        span_ms=int(span_ms) if span_ms else None, start=start)
     battery = run_battery(planner, scan, fids_sorted)
+    drill_report = run_drill(rt, drill, seed) if drill else None
 
     fleet = None
+    balance_http = None
     if with_web:
         from geomesa_tpu.web import serve
         httpd = serve(ds, port=0, background=True)
@@ -216,6 +334,17 @@ def worker_main(out_path: str) -> int:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/fleet", timeout=30) as r:
                 fleet = json.loads(r.read().decode())
+            if drill:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/cluster/balance",
+                        timeout=30) as r:
+                    balance_http = json.loads(r.read().decode())
+                if rt.process_id == 0 and drill_report is not None:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/fleet/balance",
+                            timeout=30) as r:
+                        drill_report["fleet_balance"] = json.loads(
+                            r.read().decode())
 
     report = {
         "process_id": rt.process_id,
@@ -229,6 +358,8 @@ def worker_main(out_path: str) -> int:
         "battery": battery,
         "stages": stages,
         "fleet": fleet,
+        "drill": drill_report,
+        "balance_http": balance_http,
         "wall_s": round(time.perf_counter() - t_start, 3),
     }
     with open(out_path, "w") as f:
@@ -249,12 +380,19 @@ def _free_port() -> int:
 
 def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
                timeout_s: float = 420.0, local_devices: int = 2,
-               out_dir: Optional[str] = None, web: bool = True) -> dict:
+               out_dir: Optional[str] = None, web: bool = True,
+               drill: Optional[str] = None) -> dict:
     """Spawn the N-process dryrun, compute the oracle in-process, and
-    return the merged report with exactness checks + timings."""
+    return the merged report with exactness checks + timings. ``drill``
+    ("skew" | "uniform") additionally runs the shard-balance drill on the
+    single-z3-bin corpus window (see ``DRILL_START``)."""
+    if drill and drill not in ("skew", "uniform"):
+        raise ValueError(f"unknown drill mode: {drill!r}")
     t_start = time.perf_counter()
     work = out_dir or tempfile.mkdtemp(prefix="geomesa_cluster_dryrun_")
     os.makedirs(work, exist_ok=True)
+    span_ms = DRILL_SPAN_MS if drill else None
+    start = DRILL_START if drill else None
 
     coord = f"127.0.0.1:{_free_port()}"
     procs: List[subprocess.Popen] = []
@@ -276,6 +414,12 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
             "GEOMESA_TPU_DRYRUN_SEED": str(seed),
             "GEOMESA_TPU_DRYRUN_WEB": "1" if web else "0",
         })
+        if drill:
+            env.update({
+                "GEOMESA_TPU_DRYRUN_DRILL": drill,
+                "GEOMESA_TPU_DRYRUN_START": DRILL_START,
+                "GEOMESA_TPU_DRYRUN_SPAN_MS": str(DRILL_SPAN_MS),
+            })
         with open(os.path.join(work, f"rank{p}.log"), "w") as log:
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "geomesa_tpu.cluster.dryrun",
@@ -283,8 +427,10 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
                 stdout=log, stderr=subprocess.STDOUT, env=env))
 
     # oracle while the workers run: same battery, inactive runtime
+    # (same corpus window as the workers so equality still holds)
     rt0 = inactive_runtime()
-    _, planner, scan, fids_sorted, ostages = build_local(rt0, n, seed)
+    _, planner, scan, fids_sorted, ostages = build_local(
+        rt0, n, seed, span_ms=span_ms, start=start)
     oracle = run_battery(planner, scan, fids_sorted)
 
     deadline = time.monotonic() + timeout_s
@@ -307,11 +453,12 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
         except Exception:
             ranks.append(None)
 
-    checks = _check(oracle, ranks, n, num_processes, web)
+    checks = _check(oracle, ranks, n, num_processes, web, drill)
     report = {
         "ok": all(checks.values()) and all(rc == 0 for rc in rcs),
         "num_processes": num_processes,
         "n": n,
+        "drill": drill,
         "exit_codes": rcs,
         "checks": checks,
         "oracle": {k: oracle[k] for k in
@@ -327,7 +474,8 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
 
 
 def _check(oracle: dict, ranks: List[Optional[dict]], n: int,
-           num_processes: int, web: bool) -> Dict[str, bool]:
+           num_processes: int, web: bool,
+           drill: Optional[str] = None) -> Dict[str, bool]:
     live = [r for r in ranks if r is not None]
     checks = {"all_ranks_reported": len(live) == num_processes}
     if not checks["all_ranks_reported"]:
@@ -354,6 +502,15 @@ def _check(oracle: dict, ranks: List[Optional[dict]], n: int,
             return (len(nodes) == num_processes
                     and all(v.get("ok") for v in nodes.values()))
         checks["fleet_registered"] = all(_fleet_ok(r) for r in live)
+    if drill:
+        # every rank ran the drill and rank 0's ledger was active
+        # (scoring against the pinned bars lives in bench cfg13)
+        checks["drill_reported"] = all(
+            (r.get("drill") or {}).get("mode") == drill for r in live)
+        r0 = next((r for r in live if r["process_id"] == 0), None)
+        checks["drill_ledger_active"] = bool(
+            r0 and ((r0.get("drill") or {}).get("balance")
+                    or {}).get("active"))
     return checks
 
 
@@ -369,11 +526,15 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--timeout-s", type=float, default=420.0)
     ap.add_argument("--no-web", action="store_true")
+    ap.add_argument("--drill", choices=["skew", "uniform"], default=None,
+                    help="run the shard-balance drill (Zipf storm on one "
+                         "shard's key range, or the uniform control)")
     args = ap.parse_args(argv)
     if args.worker:
         return worker_main(args.out)
     report = run_dryrun(args.procs, args.n, args.seed,
-                        timeout_s=args.timeout_s, web=not args.no_web)
+                        timeout_s=args.timeout_s, web=not args.no_web,
+                        drill=args.drill)
     print(json.dumps({k: report[k] for k in
                       ("ok", "checks", "wall_s", "work_dir")}, indent=2))
     if args.out:

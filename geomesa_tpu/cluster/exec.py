@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from geomesa_tpu.cluster.runtime import note_collective
 from geomesa_tpu.cluster.table import ClusterShardedTable
 from geomesa_tpu.parallel.dist import DistributedScan, _build_mask
 
@@ -55,15 +56,26 @@ class ClusterScan(DistributedScan):
                        out_shardings=NamedSharding(self.sharded.mesh, P()))
 
     def count(self, plan) -> int:
-        if self._active():
-            self.runtime.note_psum_round()
-        return super().count(plan)
+        if not self._active():
+            return super().count(plan)
+        import time as _time
+        self.runtime.note_psum_round()
+        t0 = _time.perf_counter()
+        out = super().count(plan)
+        note_collective("psum", _time.perf_counter() - t0)
+        return out
 
     def density(self, plan, bbox, width: int, height: int,
                 weight_attr: Optional[str] = None) -> np.ndarray:
-        if self._active():
-            self.runtime.note_psum_round()
-        return super().density(plan, bbox, width, height, weight_attr)
+        if not self._active():
+            return super().density(plan, bbox, width, height, weight_attr)
+        import time as _time
+        self.runtime.note_psum_round()
+        t0 = _time.perf_counter()
+        out = super().density(plan, bbox, width, height, weight_attr)
+        note_collective("psum", _time.perf_counter() - t0,
+                        payload_bytes=out.nbytes)
+        return out
 
     def knn(self, plan, x: float, y: float, k: int):
         if not self._active():
@@ -144,8 +156,10 @@ class ClusterScan(DistributedScan):
 def ordered_merge(rt, local_payload) -> List:
     """All-gather one JSON-safe payload per process, returned in RANK
     order — which is global key order for key-range-partitioned data.
-    The host-side merge step of every cluster select/export."""
-    return [p["v"] for p in rt.exchange({"v": local_payload})]
+    The host-side merge step of every cluster select/export (timed as
+    the ``cluster.collective.row_exchange`` op)."""
+    return [p["v"] for p in rt.exchange({"v": local_payload},
+                                        op="row_exchange")]
 
 
 def _json_safe(v):

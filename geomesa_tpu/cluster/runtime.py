@@ -28,12 +28,29 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from geomesa_tpu import config
+
+
+def note_collective(op: str, seconds: float,
+                    payload_bytes: int = 0) -> None:
+    """Record one collective round: a ``cluster.collective.<op>`` timer
+    (a leaf span under an active trace, a registry histogram otherwise)
+    plus a payload-bytes counter. Never raises into the collective."""
+    try:
+        from geomesa_tpu import trace as _trace
+        _trace.record(f"cluster.collective.{op}", "collective", seconds)
+        if payload_bytes:
+            from geomesa_tpu.metrics import REGISTRY
+            REGISTRY.inc(f"cluster.collective.{op}.bytes",
+                         int(payload_bytes))
+    except Exception:
+        pass
 
 
 class ClusterConfigError(ValueError):
@@ -174,13 +191,17 @@ class ClusterRuntime:
 
     # -- host-side exchange ---------------------------------------------------
 
-    def exchange(self, payload: dict) -> List[dict]:
+    def exchange(self, payload: dict, op: str = "allgather") -> List[dict]:
         """All-gather one small JSON payload per process (rank order).
-        Inactive clusters return ``[payload]`` — callers never branch."""
+        Inactive clusters return ``[payload]`` — callers never branch.
+        Each active round records a ``cluster.collective.<op>`` timer
+        with total payload bytes, and (shardwatch on) one extra tiny
+        gather of per-rank round timings for straggler attribution."""
         if not self.active():
             return [payload]
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
+        t0 = time.perf_counter()
         raw = json.dumps(payload, sort_keys=True).encode("utf-8")
         n = np.asarray([len(raw)], dtype=np.int32)
         lens = np.asarray(multihost_utils.process_allgather(
@@ -190,14 +211,53 @@ class ClusterRuntime:
         buf[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
         blobs = np.asarray(multihost_utils.process_allgather(
             jnp.asarray(buf))).reshape(self.num_processes, cap)
+        dt = time.perf_counter() - t0
+        note_collective(op, dt, payload_bytes=int(lens.sum()))
+        if config.SHARDWATCH_ENABLED.get():
+            # symmetric on every rank (same env across the cluster):
+            # gather each rank's round wall time; the LAST arriver made
+            # everyone else wait, so it measured the SHORTEST round —
+            # the slowest rank is the argmin
+            durs = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(np.asarray([dt * 1000.0],
+                                       dtype=np.float32)))
+            ).reshape(self.num_processes)
+            self._note_straggler(op, [float(d) for d in durs])
         return [json.loads(bytes(blobs[p, :int(lens[p])]).decode("utf-8"))
                 for p in range(self.num_processes)]
+
+    def _note_straggler(self, op: str, durs_ms: List[float]) -> None:
+        """Per-round straggler attribution: name the slowest rank, count
+        over-bar rounds against it (the doctor's collective_straggler
+        feed), and flight-record the round with cluster dims."""
+        try:
+            from geomesa_tpu.metrics import REGISTRY
+            spread = max(durs_ms) - min(durs_ms)
+            slowest = int(min(range(len(durs_ms)),
+                              key=lambda p: (durs_ms[p], p)))
+            REGISTRY.inc("cluster.collective.rounds")
+            if spread < float(config.DOCTOR_STRAGGLER_MS.get()):
+                return
+            REGISTRY.inc(f"cluster.collective.straggler.rank{slowest}")
+            REGISTRY.observe("cluster.collective.straggler_spread",
+                             spread / 1000.0)
+            from geomesa_tpu.obs import flight as _flight
+            _flight.RECORDER.record({
+                "ts_ms": int(time.time() * 1000), "kind": "collective",
+                "type": op, "duration_ms": round(spread, 3),
+                "slowest_rank": slowest,
+                "round_ms": [round(d, 3) for d in durs_ms],
+                **event_dims()})
+        except Exception:
+            pass
 
     def barrier(self, name: str = "cluster") -> None:
         if not self.active():
             return
         from jax.experimental import multihost_utils
+        t0 = time.perf_counter()
         multihost_utils.sync_global_devices(name)
+        note_collective("barrier", time.perf_counter() - t0)
 
     # -- integration hooks ----------------------------------------------------
 

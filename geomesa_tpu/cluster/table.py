@@ -31,8 +31,64 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from geomesa_tpu import config
 from geomesa_tpu.cluster.runtime import ClusterRuntime
 from geomesa_tpu.parallel.mesh import ShardedTable, _pad_value
+
+
+def shard_cell_map(rt: ClusterRuntime, xs, ys, keys, bits=None):
+    """Empirical cell -> shard occupancy: which shard holds how many
+    rows of each coarse Morton cell, plus the per-shard key span of
+    those rows (obs/sketches.cell_key geometry, so the workload plane's
+    hot cells join directly against it).
+
+    Collective when the cluster is active (one small allgather of the
+    per-shard cell tallies); solo it degrades to a one-shard map. Feeds
+    ``obs.shardwatch.WATCH.set_shard_map`` — the ledger's ownership
+    side. Returns ``(cells, key_ranges, shard_rows)`` keyed by shard id
+    strings."""
+    from geomesa_tpu.obs.sketches import z_interleave
+
+    if bits is None:
+        bits = int(config.WORKLOAD_CELL_BITS.get())
+    bits = max(1, min(16, int(bits)))
+    xs = np.asarray(xs, dtype=np.float64)
+    ys = np.asarray(ys, dtype=np.float64)
+    keys = np.asarray(keys, dtype=np.int64)
+    n = 1 << bits
+    # same center-quantization as sketches.cell_key (point rows ARE
+    # their own bbox center), truncation and clamping included
+    gx = np.clip(((xs + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
+    gy = np.clip(((ys + 90.0) / 180.0 * n).astype(np.int64), 0, n - 1)
+    local = {}
+    if len(keys):
+        gid = gx * n + gy
+        uniq, inv = np.unique(gid, return_inverse=True)
+        counts = np.bincount(inv, minlength=len(uniq))
+        klo = np.full(len(uniq), np.iinfo(np.int64).max, dtype=np.int64)
+        khi = np.full(len(uniq), np.iinfo(np.int64).min, dtype=np.int64)
+        np.minimum.at(klo, inv, keys)
+        np.maximum.at(khi, inv, keys)
+        width = max(1, (2 * bits + 3) // 4)
+        for u, c, lo, hi in zip(uniq.tolist(), counts.tolist(),
+                                klo.tolist(), khi.tolist()):
+            z = z_interleave(int(u) // n, int(u) % n)
+            local[f"b{bits}:{z:0{width}x}"] = {
+                "rows": int(c), "key_lo": int(lo), "key_hi": int(hi)}
+    me = {"proc": rt.process_id if rt.active() else 0,
+          "rows": int(len(keys)),
+          "key_range": [int(keys.min()), int(keys.max())]
+          if len(keys) else [0, -1],
+          "cells": local}
+    peers = rt.exchange(me, op="shard_map")
+    cells, key_ranges, shard_rows = {}, {}, {}
+    for p in peers:
+        s = str(p["proc"])
+        key_ranges[s] = [int(p["key_range"][0]), int(p["key_range"][1])]
+        shard_rows[s] = int(p["rows"])
+        for cell, o in (p["cells"] or {}).items():
+            cells.setdefault(cell, {})[s] = o
+    return cells, key_ranges, shard_rows
 
 
 @dataclass

@@ -779,6 +779,59 @@ CLUSTER_WEB_REGISTER = _register(
     "--addr lists.")
 
 
+# -- shard balance observatory (ISSUE 16) -------------------------------------
+
+SHARDWATCH_ENABLED = _register(
+    "GEOMESA_TPU_SHARDWATCH", True, _parse_bool,
+    "Master switch for the per-shard load ledger (obs/shardwatch.py): "
+    "joins the workload plane's hot Morton cells against cluster "
+    "key-range ownership into per-shard load shares, an imbalance "
+    "score, and projected split points. Off: balance surfaces report "
+    "inactive and the workload fold hook is skipped.")
+
+SHARDWATCH_TOP_CELLS = _register(
+    "GEOMESA_TPU_SHARDWATCH_TOP_CELLS", 32, int,
+    "How many hot cells the ledger joins per balance report (the k "
+    "passed to workload hot_set). Must stay at or below "
+    "GEOMESA_TPU_WORKLOAD_SKETCH_K for the at_least guarantees to "
+    "cover every joined cell.")
+
+SHARDWATCH_SPLIT_PARTS = _register(
+    "GEOMESA_TPU_SHARDWATCH_SPLIT_PARTS", 2, int,
+    "How many pieces a projected split divides the hottest shard into "
+    "(parts - 1 boundaries). The boundaries are the candidate split "
+    "points ROADMAP item 2's split/migrate plane will consume.")
+
+SHARDWATCH_CELL_STATS = _register(
+    "GEOMESA_TPU_SHARDWATCH_CELL_STATS", 256, int,
+    "Capacity of the per-cell rows-scanned/device-ms accumulator table "
+    "fed by the workload drain hook. Cells past the capacity count "
+    "toward the ledger's drop counter instead of growing the table.")
+
+DOCTOR_IMBALANCE_RATIO = _register(
+    "GEOMESA_TPU_DOCTOR_IMBALANCE_RATIO", 1.5, float,
+    "shard_imbalance bar: the doctor opens an incident when the "
+    "GUARANTEED (at_least-based) max-over-mean per-shard load ratio "
+    "reaches this value — undercount-proof, so sketch error can never "
+    "fake an imbalance.")
+
+DOCTOR_IMBALANCE_MIN = _register(
+    "GEOMESA_TPU_DOCTOR_IMBALANCE_MIN", 200, int,
+    "Total guaranteed hot-cell load floor below which shard_imbalance "
+    "never fires (a handful of queries is not a skew signal).")
+
+DOCTOR_STRAGGLER_MS = _register(
+    "GEOMESA_TPU_DOCTOR_STRAGGLER_MS", 50.0, float,
+    "Per-round straggler bar: a collective round whose slowest-rank "
+    "spread exceeds this many milliseconds charges one straggler count "
+    "against that rank (cluster.collective.straggler.rank<p>).")
+
+DOCTOR_STRAGGLER_ROUNDS = _register(
+    "GEOMESA_TPU_DOCTOR_STRAGGLER_ROUNDS", 5, int,
+    "collective_straggler bar: incidents open when one rank accumulates "
+    "this many over-bar straggler rounds inside the doctor window.")
+
+
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property
     (the CLI `config` listing / docs surface)."""

@@ -26,6 +26,13 @@ configured, the fleet-merged state) on the same injectable clock as
                     page immediately by default
   hot_skew          one plan/cell/tenant whose GUARANTEED (at_least)
                     share of the workload window exceeds the bar
+  shard_imbalance   the shardwatch ledger's GUARANTEED max-over-mean
+                    per-shard load ratio over the bar — names the hot
+                    shard and carries its projected split keys
+  collective_straggler
+                    one rank repeatedly the slowest arriver in cluster
+                    collective rounds (over-bar spread counts charged
+                    by cluster/runtime.py straggler attribution)
 
 Every firing opens (or dedups into) an incident via ``obs/incidents.py``
 with a correlated timeline snapshot; detectors that stay clear close
@@ -60,6 +67,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "hot_skew": ("ticket", "single plan/cell/tenant dominates window"),
     "reindex_churn": ("ticket", "build aborts/failed installs or "
                                 "merge-fraction breaches over bar"),
+    "shard_imbalance": ("ticket", "guaranteed per-shard load "
+                                  "max-over-mean ratio over bar"),
+    "collective_straggler": ("ticket", "one rank repeatedly slowest in "
+                                       "collective rounds"),
 }
 
 
@@ -72,12 +83,13 @@ class DoctorEngine:
     def __init__(self, registry=None, clock=time.monotonic,
                  slo_engine=None, store: Optional[IncidentStore] = None,
                  journal_path: Optional[str] = None,
-                 federator=None, workload=None):
+                 federator=None, workload=None, shardwatch=None):
         self._reg = registry if registry is not None else _metrics
         self._clock = clock
         self._slo = slo_engine          # None -> late-bind slo.ENGINE
         self._federator = federator     # None -> late-bind federation
         self._workload = workload       # None -> late-bind WORKLOAD
+        self._shardwatch = shardwatch   # None -> late-bind WATCH
         self.store = store if store is not None else IncidentStore(
             journal_path=journal_path, registry=self._reg,
             node=_trace.node_id())
@@ -106,6 +118,12 @@ class DoctorEngine:
             return self._workload
         from geomesa_tpu.obs import workload as _wl
         return _wl.WORKLOAD
+
+    def _sw(self):
+        if self._shardwatch is not None:
+            return self._shardwatch
+        from geomesa_tpu.obs import shardwatch as _shardwatch
+        return _shardwatch.WATCH
 
     # -- windowed counter deltas ----------------------------------------------
 
@@ -404,6 +422,84 @@ class DoctorEngine:
             })
         return alerts
 
+    def _check_shard_imbalance(self, now: float) -> List[dict]:
+        """shard_imbalance: the shardwatch ledger's GUARANTEED
+        (at_least-based) max-over-mean per-shard load ratio over the bar
+        with enough guaranteed load to mean anything — the suspect names
+        the hot shard and carries its projected split keys (the exact
+        boundaries the split/migrate plane will consume)."""
+        try:
+            rep = self._sw().balance()
+        except Exception:
+            return []
+        if not rep.get("active"):
+            return []
+        alerts: List[dict] = []
+        for tname, tr in sorted((rep.get("types") or {}).items()):
+            sc = tr.get("score") or {}
+            if not sc.get("over_bar"):
+                continue
+            hot = sc.get("hot_shard")
+            boundaries = (tr.get("splits") or {}).get("boundaries") or []
+            hot_row = (tr.get("shards") or {}).get(hot) or {}
+            alerts.append({
+                "rule": "shard_imbalance", "severity": "ticket",
+                "cause": f"shard:{tname}:{hot}",
+                "detail": {
+                    "type": tname,
+                    "max_over_mean": sc.get("max_over_mean"),
+                    "max_over_mean_est": sc.get("max_over_mean_est"),
+                    "top_cell_fraction": sc.get("top_cell_fraction"),
+                    "imbalance": sc.get("imbalance"),
+                    "bar": sc.get("bar"),
+                    "guaranteed_total": sc.get("guaranteed_total"),
+                    "split_keys": [b["key"] for b in boundaries]},
+                "suspect": {"type": tname, "shard": hot,
+                            "load_share": hot_row.get("load_share"),
+                            "key_range": hot_row.get("key_range")},
+                "match": {},
+            })
+        return alerts
+
+    def _check_straggler(self, now: float, counters: dict) -> List[dict]:
+        """collective_straggler: cluster/runtime.py charges one count
+        against the slowest rank of every collective round whose spread
+        crosses GEOMESA_TPU_DOCTOR_STRAGGLER_MS; a rank accumulating
+        DOCTOR_STRAGGLER_ROUNDS of them inside the window is named."""
+        window = float(config.DOCTOR_WINDOW_S.get())
+        bar = int(config.DOCTOR_STRAGGLER_ROUNDS.get())
+        prefix = "cluster.collective.straggler.rank"
+        per_rank: Dict[str, float] = {}
+        for k, v in counters.items():
+            if k.startswith(prefix):
+                _r, d = self._delta(k, v, now, window)
+                if d > 0:
+                    per_rank[k[len(prefix):]] = d
+        if bar <= 0 or not per_rank:
+            return []
+        alerts: List[dict] = []
+        for rank, d in sorted(per_rank.items()):
+            if d < bar:
+                continue
+            try:
+                rank_id = int(rank)
+            except ValueError:
+                rank_id = rank
+            alerts.append({
+                "rule": "collective_straggler", "severity": "ticket",
+                "cause": f"collective:rank{rank}",
+                "detail": {
+                    "over_bar_rounds_in_window": int(d), "bar": bar,
+                    "window_s": window,
+                    "spread_bar_ms":
+                        float(config.DOCTOR_STRAGGLER_MS.get()),
+                    "rounds_total": int(
+                        counters.get("cluster.collective.rounds", 0))},
+                "suspect": {"rank": rank_id},
+                "match": {"kind": "collective"},
+            })
+        return alerts
+
     # -- the correlated timeline ----------------------------------------------
 
     def _timeline(self, alert: dict, counters: dict) -> dict:
@@ -453,7 +549,9 @@ class DoctorEngine:
                           lambda: self._check_breakers(now, counters),
                           lambda: self._check_wal(now, counters),
                           lambda: self._check_reindex(now, counters),
-                          lambda: self._check_skew(now)):
+                          lambda: self._check_skew(now),
+                          lambda: self._check_shard_imbalance(now),
+                          lambda: self._check_straggler(now, counters)):
                 try:
                     alerts.extend(check())
                 except Exception:

@@ -95,11 +95,13 @@ class NodeScrape:
 def _local_fetch() -> Tuple[dict, dict]:
     """The in-process node's (healthz-lite, state) — the router federates
     its own router.* counters without scraping itself over HTTP."""
+    from geomesa_tpu.obs import shardwatch as _shardwatch
     from geomesa_tpu.obs import workload as _workload
     hz = {"status": "ok",
           "node": {"id": _trace.node_id(), "role": _trace.node_role()}}
     state = _metrics.export_state()
     state["workload"] = _workload.WORKLOAD.export_state()
+    state["shardwatch"] = _shardwatch.WATCH.export_state()
     return hz, state
 
 
@@ -347,6 +349,34 @@ class Federator:
                 "hot_set": merged.hot_set(),
                 "tenants": merged.top_tenants(),
                 "rollups": merged.rollups()}
+
+    def fleet_balance(self) -> dict:
+        """Fleet-wide shard balance: every node's shardwatch + workload
+        state (riding the same /metrics?format=state scrape) merged —
+        per-cell cost stats sum, hot-cell sketches merge with propagated
+        error bounds, the rank-identical shard maps union — then joined
+        through the SAME ledger a single node runs, so /cluster/balance
+        and /fleet/balance speak one schema."""
+        from geomesa_tpu.obs import shardwatch as _shardwatch
+        from geomesa_tpu.obs import workload as _workload
+        wl_states, sw_states, nodes = [], [], {}
+        for name, s in sorted(self.refresh().items()):
+            if not (s.ok and s.state):
+                nodes[name] = {"ok": False, "error": s.error}
+                continue
+            swst = s.state.get("shardwatch") or {}
+            wl_states.append(s.state.get("workload") or {})
+            sw_states.append(swst)
+            nodes[name] = {"ok": True, "node_id": s.node_id,
+                           "types": sorted((swst.get("maps")
+                                            or {}).keys()),
+                           "cells_tracked": len(swst.get("cells") or ())}
+        report = _shardwatch.fleet_balance_report(
+            _workload.merge_states(wl_states), sw_states)
+        missing = self.missing_nodes()
+        return {"nodes": nodes,
+                "partial": bool(missing), "missing": missing,
+                "balance": report}
 
     def fleet_incidents(self) -> dict:
         """Every node's doctor incidents under one pane with node

@@ -80,6 +80,22 @@ _OVERRIDES = {
     "cfg12_select_mismatch": "exact",
     "cfg12_density_mismatch": "exact",
     "cfg12_shard_strict_subset": "exact",
+    # shard balance observatory (cfg13, two-sided): a Zipf storm the
+    # ledger fails to flag / mis-attributes, a projected split key
+    # outside the victim's key range, or a false alarm on the uniform
+    # control half is a correctness bug, never noise. The raw balance
+    # scores ride the statistical gate with pinned directions: skew
+    # detection eroding DOWN or the control drifting UP both flag.
+    "cfg13_skew_flagged": "exact",
+    "cfg13_skew_incidents": "exact",
+    "cfg13_skew_attributed": "exact",
+    "cfg13_skew_splits_in_range": "exact",
+    "cfg13_control_incidents": "exact",
+    "cfg13_control_balanced": "exact",
+    "cfg13_fleet_federated": "exact",
+    "cfg13_dryrun_ok": "exact",
+    "cfg13_skew_max_over_mean": "higher",
+    "cfg13_control_max_over_mean": "lower",
 }
 
 
@@ -206,6 +222,22 @@ def update_baselines(baselines: dict, summary: dict,
 # -- comparison ---------------------------------------------------------------
 
 
+def _meta_procs(meta) -> Optional[int]:
+    """Process count recorded in a summary/baseline ``meta`` block.
+    Absent (a baseline written before the field existed) means the
+    historical single-process population → 1. Present but unparseable
+    (a corrupted or future-schema store) → None, which ``compare``
+    treats as a process mismatch — new-baseline semantics, never a
+    crash: an aged baseline file must not brick the gate."""
+    v = (meta or {}).get("num_processes")
+    if v is None or v == "":
+        return 1
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
 def _speed_ratio(run_metrics: dict, baselines: dict) -> float:
     """run-host / baseline-host speed ratio from the CPU proxy metric.
     A DEADBAND treats ratios within [0.67, 1.5] as 1.0 — the proxy itself
@@ -243,9 +275,9 @@ def compare(summary: dict, baselines: dict,
     ratio = _speed_ratio(run_metrics, baselines)
     same_scale = (summary.get("meta") or {}).get("n_points") \
         == (baselines.get("meta") or {}).get("n_points")
-    run_procs = int((summary.get("meta") or {}).get("num_processes") or 1)
-    base_procs = int((baselines.get("meta") or {}).get("num_processes") or 1)
-    if run_procs != base_procs:
+    run_procs = _meta_procs(summary.get("meta"))
+    base_procs = _meta_procs(baselines.get("meta"))
+    if run_procs is None or base_procs is None or run_procs != base_procs:
         # a single-process baseline says nothing about a multi-process
         # run (collectives, host exchange, per-shard cardinality all
         # differ) — a mismatch is a new baseline population, never a

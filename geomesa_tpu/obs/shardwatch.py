@@ -1,0 +1,379 @@
+"""Shard balance observatory: the per-shard load ledger (ISSUE 16).
+
+PR 15 partitioned the feature table by contiguous Morton key range but
+left the cluster plane blind to WHERE the load lands. This module closes
+that loop observationally — the prerequisite signal for ROADMAP item
+2's split/merge/migrate plane — by joining two surfaces that already
+speak the same Z2 key space:
+
+  workload plane   ``hot_set()`` top Morton cells with SpaceSaving
+                   confidence bounds (``count`` never undercounts,
+                   ``at_least = count - error`` never overcounts);
+
+  cluster plane    per-process ``key_ranges`` ownership plus an
+                   EMPIRICAL cell -> shard occupancy map (which shard
+                   holds how many rows of each coarse cell, measured at
+                   table-build time by cluster/table.py shard_cell_map).
+
+The join attributes each hot cell's load to the shards that own its
+rows, FRACTIONALLY by row share — cells that straddle an ownership
+boundary split their load honestly instead of being forced to one side.
+Per shard the ledger reports qps / rows-scanned / device-ms / hot-cell
+load shares; the imbalance score is the max-over-mean per-shard load
+ratio plus the top-cell concentration. Doctor bars use the GUARANTEED
+(at_least-based) loads, so sketch error can never fake an imbalance.
+
+``project_splits`` turns the hottest shard's owned cells into candidate
+boundary keys that partition its observed load into near-equal parts —
+exactly the split points the elasticity PR will consume. Boundaries
+always fall inside the victim's key range; the property test pins the
+partition tolerance to the largest single-cell share (a cell is the
+atomic unit — no boundary can do better than the cell granularity).
+
+Rows-scanned / device-ms per cell come from a workload drain hook
+(``workload.add_fold_hook``): the hot path still pays one deque append,
+and the per-cell accumulator folds at read time under the workload
+drain, same deferred discipline as every obs surface.
+
+Federation: ``export_state()`` rides the /metrics?format=state scrape
+next to the workload state; ``merge_states`` sums per-cell stats and
+unions the (rank-identical) shard maps, backing GET /fleet/balance.
+
+Import discipline (obs/__init__ rule): config/metrics + obs.sketches/
+obs.workload only — never cluster/planner/datastore layers. The shard
+map is PUSHED in by the cluster plane (set_shard_map), not pulled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu.obs import sketches as _sk
+from geomesa_tpu.obs import workload as _workload
+
+
+def project_splits(cells: List[dict], key_range: Tuple[int, int],
+                   parts: int = 2) -> List[dict]:
+    """Candidate split boundaries for ONE shard from its owned hot-cell
+    slices.
+
+    ``cells`` entries carry ``load`` (this shard's share of the cell)
+    and the shard-local key span ``key_lo``/``key_hi`` of the cell's
+    rows. Boundaries are key values B such that rows with key < B land
+    left; each targets cumulative load ``j/parts`` and lands within the
+    largest single-cell share of it (cells are atomic — a split cannot
+    cut finer than the cell granularity). Every boundary falls inside
+    ``(key_lo, key_hi]`` of the victim's range."""
+    lo, hi = int(key_range[0]), int(key_range[1])
+    parts = max(2, int(parts))
+    usable = [c for c in cells if float(c.get("load") or 0.0) > 0.0]
+    total = sum(float(c["load"]) for c in usable)
+    if not usable or total <= 0.0 or hi <= lo:
+        return []
+    order = sorted(usable, key=lambda c: ((int(c["key_lo"])
+                                           + int(c["key_hi"])) / 2.0,
+                                          str(c.get("cell"))))
+    out: List[dict] = []
+    cum = 0.0
+    targets = [(j, total * j / parts) for j in range(1, parts)]
+    ti = 0
+    for i, c in enumerate(order):
+        cum += float(c["load"])
+        while ti < len(targets) and cum >= targets[ti][1] - 1e-12:
+            j, _ = targets[ti]
+            key = max(lo + 1, min(hi, int(c["key_hi"]) + 1))
+            out.append({"key": key,
+                        "left_fraction": round(cum / total, 6),
+                        "target": round(j / parts, 6),
+                        "cells_left": i + 1,
+                        "cell": c.get("cell")})
+            ti += 1
+        if ti >= len(targets):
+            break
+    return out
+
+
+class ShardWatch:
+    """Per-shard load ledger (one per process, like the Federator).
+
+    The cluster plane pushes the cell -> shard occupancy map in at
+    table-build time (``set_shard_map``); a workload drain hook feeds
+    per-cell rows-scanned / device-ms; ``balance()`` performs the join
+    on demand."""
+
+    def __init__(self, workload=None):
+        self._lock = threading.Lock()
+        self._workload = workload       # None -> process-global WORKLOAD
+        # type -> {"cells": {cell: {shard: {"rows","key_lo","key_hi"}}},
+        #          "key_ranges": {shard: [lo, hi]},
+        #          "shard_rows": {shard: rows}}
+        self._maps: Dict[str, dict] = {}
+        # cell -> [events, rows_scanned, device_ms] (drain-hook fed)
+        self._cells: Dict[str, list] = {}
+        self._cell_drops = 0
+        self._t0: Optional[float] = None
+
+    def _wl(self):
+        return self._workload if self._workload is not None \
+            else _workload.WORKLOAD
+
+    # -- cluster-plane input ----------------------------------------------------
+
+    def set_shard_map(self, type_name: str, cells: Dict[str, dict],
+                      key_ranges, shard_rows=None) -> None:
+        """Install the empirical ownership map for one table type.
+
+        ``cells[cell][shard]`` -> {"rows", "key_lo", "key_hi"} (that
+        shard's row count and key span inside the cell); ``key_ranges``
+        is per-shard [lo, hi] (dict keyed by shard, or a rank-ordered
+        list). Shard ids normalize to strings for JSON stability."""
+        if isinstance(key_ranges, (list, tuple)):
+            key_ranges = {str(i): list(r)
+                          for i, r in enumerate(key_ranges)}
+        norm_cells = {}
+        for cell, owners in (cells or {}).items():
+            norm_cells[str(cell)] = {
+                str(s): {"rows": int(o["rows"]),
+                         "key_lo": int(o["key_lo"]),
+                         "key_hi": int(o["key_hi"])}
+                for s, o in owners.items()}
+        smap = {"cells": norm_cells,
+                "key_ranges": {str(s): [int(r[0]), int(r[1])]
+                               for s, r in (key_ranges or {}).items()},
+                "shard_rows": {str(s): int(n)
+                               for s, n in (shard_rows or {}).items()}}
+        with self._lock:
+            self._maps[str(type_name)] = smap
+
+    # -- workload drain hook ----------------------------------------------------
+
+    def fold_event(self, ev: dict) -> None:
+        """Per-event accumulator (runs under the workload drain, NOT on
+        the query hot path). Cheap and bounded: one dict update per
+        event carrying a cell."""
+        if not config.SHARDWATCH_ENABLED.get():
+            return
+        cell = ev.get("cell")
+        if not cell:
+            return
+        cell = str(cell)
+        with self._lock:
+            rec = self._cells.get(cell)
+            if rec is None:
+                if len(self._cells) >= int(
+                        config.SHARDWATCH_CELL_STATS.get()):
+                    self._cell_drops += 1
+                    return
+                rec = self._cells[cell] = [0, 0, 0.0]
+            if self._t0 is None:
+                self._t0 = time.monotonic()
+            rec[0] += 1
+            rec[1] += int(ev.get("rows_scanned") or 0)
+            rec[2] += float(ev.get("device_ms") or 0.0)
+
+    # -- the join ---------------------------------------------------------------
+
+    def _type_report(self, hot: dict, smap: dict, stats: Dict[str, list],
+                     elapsed_s: float, parts: int) -> dict:
+        key_ranges = smap["key_ranges"]
+        shards = {s: {"load": 0.0, "at_least": 0.0, "events": 0.0,
+                      "qps": 0.0, "rows_scanned": 0.0, "device_ms": 0.0,
+                      "key_range": list(r), "cells": []}
+                  for s, r in key_ranges.items()}
+        unmapped_cells = 0
+        unmapped_load = 0
+        for e in hot.get("cells") or ():
+            owners = smap["cells"].get(e["key"])
+            if not owners:
+                unmapped_cells += 1
+                unmapped_load += int(e["at_least"])
+                continue
+            rows_total = sum(o["rows"] for o in owners.values()) or 1
+            st = stats.get(e["key"]) or (0, 0, 0.0)
+            for s, o in owners.items():
+                sh = shards.get(s)
+                if sh is None:
+                    continue
+                frac = o["rows"] / rows_total
+                sh["load"] += e["count"] * frac
+                sh["at_least"] += e["at_least"] * frac
+                sh["events"] += st[0] * frac
+                sh["rows_scanned"] += st[1] * frac
+                sh["device_ms"] += st[2] * frac
+                sh["cells"].append({"cell": e["key"],
+                                    "load": e["count"] * frac,
+                                    "at_least": e["at_least"] * frac,
+                                    "share_of_cell": round(frac, 4),
+                                    "key_lo": o["key_lo"],
+                                    "key_hi": o["key_hi"]})
+        total_load = sum(sh["load"] for sh in shards.values())
+        total_g = sum(sh["at_least"] for sh in shards.values())
+        n_shards = max(1, len(shards))
+        mean_g = total_g / n_shards
+        mean_e = total_load / n_shards
+        max_over_mean = max(
+            (sh["at_least"] for sh in shards.values()), default=0.0) \
+            / mean_g if mean_g > 0 else 1.0
+        max_over_mean_est = max(
+            (sh["load"] for sh in shards.values()), default=0.0) \
+            / mean_e if mean_e > 0 else 1.0
+        hot_cells = hot.get("cells") or []
+        top_frac = float(hot_cells[0]["fraction"]) if hot_cells else 0.0
+        hot_shard = max(shards,
+                        key=lambda s: (shards[s]["at_least"],
+                                       shards[s]["load"], s)) \
+            if shards else None
+        for s, sh in shards.items():
+            sh["load_share"] = round(sh["load"] / total_load, 4) \
+                if total_load > 0 else 0.0
+            sh["qps"] = round(sh["events"] / elapsed_s, 3) \
+                if elapsed_s > 0 else 0.0
+            sh["load"] = round(sh["load"], 2)
+            sh["at_least"] = round(sh["at_least"], 2)
+            sh["events"] = round(sh["events"], 2)
+            sh["rows_scanned"] = round(sh["rows_scanned"], 1)
+            sh["device_ms"] = round(sh["device_ms"], 3)
+            sh["cells"] = sorted(sh["cells"],
+                                 key=lambda c: (-c["load"], c["cell"]))
+            for c in sh["cells"]:
+                c["load"] = round(c["load"], 2)
+                c["at_least"] = round(c["at_least"], 2)
+        splits = []
+        if hot_shard is not None and hot_shard in key_ranges:
+            splits = project_splits(shards[hot_shard]["cells"],
+                                    key_ranges[hot_shard], parts)
+        score = {
+            "max_over_mean": round(max_over_mean, 4),
+            "max_over_mean_est": round(max_over_mean_est, 4),
+            "top_cell_fraction": round(top_frac, 4),
+            "imbalance": round(max_over_mean + top_frac, 4),
+            "hot_shard": hot_shard,
+            "guaranteed_total": round(total_g, 2),
+            "bar": float(config.DOCTOR_IMBALANCE_RATIO.get()),
+            "min_load": int(config.DOCTOR_IMBALANCE_MIN.get()),
+        }
+        score["over_bar"] = bool(
+            total_g >= score["min_load"]
+            and max_over_mean >= score["bar"])
+        return {"shards": shards, "score": score,
+                "splits": {"shard": hot_shard,
+                           "parts": max(2, int(parts)),
+                           "boundaries": splits},
+                "unmapped": {"cells": unmapped_cells,
+                             "load": unmapped_load}}
+
+    def balance(self, k: Optional[int] = None,
+                parts: Optional[int] = None) -> dict:
+        """The ledger join: per-type per-shard loads, imbalance score,
+        and projected split points for the hottest shard. ``active`` is
+        False until a shard map exists (solo processes stay quiet)."""
+        if not config.SHARDWATCH_ENABLED.get():
+            return {"active": False, "reason": "shardwatch disabled"}
+        k = int(k if k is not None
+                else config.SHARDWATCH_TOP_CELLS.get())
+        parts = int(parts if parts is not None
+                    else config.SHARDWATCH_SPLIT_PARTS.get())
+        hot = self._wl().hot_set(k)
+        with self._lock:
+            maps = {t: m for t, m in self._maps.items()}
+            stats = {c: list(v) for c, v in self._cells.items()}
+            drops = self._cell_drops
+            elapsed = (time.monotonic() - self._t0) \
+                if self._t0 is not None else 0.0
+        if not maps:
+            return {"active": False, "reason": "no shard map registered",
+                    "hot_cells": len(hot.get("cells") or ())}
+        types = {t: self._type_report(hot, m, stats, elapsed, parts)
+                 for t, m in sorted(maps.items())}
+        worst = max(types, key=lambda t: types[t]["score"]["imbalance"])
+        return {"active": True,
+                "types": types,
+                "worst": {"type": worst, **types[worst]["score"]},
+                "hot_cells": len(hot.get("cells") or ()),
+                "total": hot.get("total", 0),
+                "cell_stats": {"tracked": len(stats), "dropped": drops,
+                               "elapsed_s": round(elapsed, 3)}}
+
+    # -- federation -------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        """Mergeable wire form riding the /metrics?format=state scrape
+        next to the workload state."""
+        with self._lock:
+            return {
+                "maps": {t: m for t, m in sorted(self._maps.items())},
+                "cells": {c: [v[0], v[1], round(v[2], 3)]
+                          for c, v in sorted(self._cells.items())},
+                "cell_drops": self._cell_drops,
+                "elapsed_s": round((time.monotonic() - self._t0), 3)
+                if self._t0 is not None else 0.0,
+            }
+
+    def load_state(self, state: dict) -> "ShardWatch":
+        with self._lock:
+            self._maps = dict(state.get("maps") or {})
+            self._cells = {str(c): [int(v[0]), int(v[1]), float(v[2])]
+                           for c, v in (state.get("cells") or {}).items()}
+            self._cell_drops = int(state.get("cell_drops", 0))
+            el = float(state.get("elapsed_s", 0.0))
+            self._t0 = (time.monotonic() - el) if el > 0 else None
+        return self
+
+    def clear(self) -> None:
+        with self._lock:
+            self._maps.clear()
+            self._cells.clear()
+            self._cell_drops = 0
+            self._t0 = None
+
+
+def merge_states(states: List[dict]) -> dict:
+    """Merge per-node shardwatch states: per-cell stats sum, shard maps
+    union (every rank derives the identical map from the same exchange,
+    so union == any one of them), elapsed takes the max."""
+    maps: Dict[str, dict] = {}
+    cells: Dict[str, list] = {}
+    drops = 0
+    elapsed = 0.0
+    for st in states:
+        if not st:
+            continue
+        drops += int(st.get("cell_drops", 0))
+        elapsed = max(elapsed, float(st.get("elapsed_s", 0.0)))
+        for t, m in (st.get("maps") or {}).items():
+            maps.setdefault(t, m)
+        for c, v in (st.get("cells") or {}).items():
+            have = cells.setdefault(str(c), [0, 0, 0.0])
+            have[0] += int(v[0])
+            have[1] += int(v[1])
+            have[2] += float(v[2])
+    return {"maps": maps,
+            "cells": {c: [v[0], v[1], round(v[2], 3)]
+                      for c, v in sorted(cells.items())},
+            "cell_drops": drops, "elapsed_s": round(elapsed, 3)}
+
+
+def fleet_balance_report(workload_state: dict,
+                         shardwatch_states: List[dict],
+                         k: Optional[int] = None,
+                         parts: Optional[int] = None) -> dict:
+    """Build the fleet-wide balance report from merged scrape states —
+    the Federator's GET /fleet/balance computation."""
+    wl = _workload.WorkloadAnalytics.from_state(workload_state or {})
+    sw = ShardWatch(workload=wl)
+    sw.load_state(merge_states(shardwatch_states))
+    return sw.balance(k=k, parts=parts)
+
+
+# process-global ledger (the serving shape: one per process), fed by the
+# workload plane's drain hook — producers never call into shardwatch
+WATCH = ShardWatch()
+_workload.add_fold_hook(WATCH.fold_event)
+
+
+def _cell_span(cell: str) -> Optional[Tuple[float, float, float, float]]:
+    """Re-export of the cell bbox inverse for balance consumers."""
+    return _sk.cell_bbox(cell)

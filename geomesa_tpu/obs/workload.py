@@ -77,6 +77,20 @@ def enabled() -> bool:
     return c[0]
 
 
+# drain-time fold hooks: other obs planes (shardwatch's per-cell cost
+# accumulator) observe every folded event WITHOUT touching the producer
+# hot path — hooks run under the analytics lock at drain time and must
+# never raise into the fold
+_FOLD_HOOKS: List = []
+
+
+def add_fold_hook(fn) -> None:
+    """Register ``fn(event_dict)`` to run for every event folded at
+    drain time (idempotent per function)."""
+    if fn not in _FOLD_HOOKS:
+        _FOLD_HOOKS.append(fn)
+
+
 def tenant_metric_label(tenant) -> str:
     """A metrics-safe tenant label (the ``tenant.*`` counter namespace
     must stay bounded and exposition-clean)."""
@@ -356,6 +370,12 @@ class WorkloadAnalytics:
 
     def _fold_event(self, ev: dict) -> None:
         self.consumed += 1
+        if self._meter:  # read-only from_state views skip the hooks too
+            for hook in _FOLD_HOOKS:
+                try:
+                    hook(ev)
+                except Exception:
+                    pass
         ts_s = float(ev.get("ts_ms") or self._clock() * 1000.0) / 1000.0
         key = _group_key(ev)
         for ring in self.rings.values():

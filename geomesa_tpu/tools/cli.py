@@ -12,7 +12,7 @@ mutating commands load → act → save.
     geomesa-tpu explain       -s STORE -f NAME -q ECQL
     geomesa-tpu stats         -s STORE -f NAME [--attr A] [--kind histogram|topk|bounds|count|minmax]
     geomesa-tpu delete        -s STORE -f NAME -q ECQL
-    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|cache|admission|wal|replication|workload|cluster
+    geomesa-tpu debug         metrics|traces|trace|events|slo|kernels|scheduler|cache|admission|wal|replication|workload|cluster|balance
                               [--format prometheus] [--slow MS] [--errors]
                               [--kind K] [--addr HOST:PORT ...] [-s STORE -f NAME -q ECQL]
                               [--id TRACE_ID --fleet]   (debug trace: stitched tree)
@@ -390,6 +390,35 @@ def cmd_debug(args):
         metrics = {k: v for k, v in snap.items() if v}
         if metrics:
             out["metrics"] = metrics
+        print(json.dumps(out, indent=2, default=str))
+    elif args.what == "balance":
+        # the shard balance observatory runbook surface: per-shard load
+        # shares joined from hot cells x key-range ownership, imbalance
+        # score, projected split points — this process's ledger, or a
+        # RUNNING cluster node's GET /cluster/balance via --addr (one
+        # addr flattens; several nest per node)
+        out = {}
+        if args.addr:
+            import urllib.request
+            for addr in args.addr:
+                base = addr if addr.startswith("http") else f"http://{addr}"
+                try:
+                    with urllib.request.urlopen(base + "/cluster/balance",
+                                                timeout=5) as r:
+                        node = json.loads(r.read().decode())
+                except OSError as e:
+                    node = {"error": str(e)}
+                if len(args.addr) == 1:
+                    out.update(node)
+                else:
+                    out.setdefault("nodes", {})[addr] = node
+        else:
+            from geomesa_tpu.obs.shardwatch import WATCH
+            out = WATCH.balance()
+        snap = REGISTRY.snapshot_prefixed("cluster.collective.")
+        metrics = {k: v for k, v in snap.items() if v}
+        if metrics:
+            out["collective"] = metrics
         print(json.dumps(out, indent=2, default=str))
     elif args.what == "trace":
         # the stitched cross-process tree for one global trace id:
@@ -842,7 +871,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=("metrics", "traces", "trace", "events",
                                      "slo", "kernels", "scheduler", "cache",
                                      "admission", "wal", "replication",
-                                     "workload", "incidents", "cluster"))
+                                     "workload", "incidents", "cluster",
+                                     "balance"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "

@@ -78,7 +78,11 @@ SPAN_KINDS = ("plan", "range_decompose", "queue_wait", "scan", "device_scan",
               # long-running build phase (encode/upload/sort — obs/profiling
               # PROGRESS): a traced ingest that triggers a rebuild
               # attributes the build stages instead of one opaque span
-              "build_phase")
+              "build_phase",
+              # cross-process collective op (cluster/: psum dispatch,
+              # host allgather, barrier, row exchange) — stitched traces
+              # show where a distributed query's wall time went
+              "collective")
 
 _pc = time.perf_counter  # cached: spans sit on µs-scale hot paths
 

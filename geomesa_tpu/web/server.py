@@ -69,6 +69,12 @@ Routes:
                                          per-process rows, Morton key-range
                                          ownership, mesh topology, psum
                                          round counters
+  GET  /cluster/balance                → shard balance observatory: per-shard
+                                         load shares (hot cells x key-range
+                                         ownership), imbalance score,
+                                         projected split points
+  GET  /fleet/balance                  → the same ledger over fleet-merged
+                                         shardwatch + workload states
   GET  /config                         → system-property listing
 
 Mutating routes on a read-only replica (or a fenced ex-primary) return 403
@@ -214,9 +220,11 @@ class GeoJsonApi:
                 # (lossless cross-node histogram merge), tagged with this
                 # node's fleet identity; workload rollup/sketch state rides
                 # the same payload so one scrape carries both
+                from geomesa_tpu.obs.shardwatch import WATCH
                 from geomesa_tpu.obs.workload import WORKLOAD
                 state = REGISTRY.export_state()
                 state["workload"] = WORKLOAD.export_state()
+                state["shardwatch"] = WATCH.export_state()
                 return 200, {"node": self._node_meta(), "state": state}
             return 200, REGISTRY.snapshot()
         if parts == ["traces"]:
@@ -340,7 +348,17 @@ class GeoJsonApi:
             if parts == ["fleet", "incidents"]:
                 # every node's doctor verdicts with node attribution
                 return 200, fed.fleet_incidents()
+            if parts == ["fleet", "balance"]:
+                # fleet-wide shard balance: merged shardwatch + workload
+                # states joined through the same ledger a node runs
+                return 200, fed.fleet_balance()
             return 404, {"error": f"no route {method} {path}"}
+        if parts == ["cluster", "balance"]:
+            # the shard balance observatory: per-shard load shares joined
+            # from hot cells x key-range ownership, imbalance score, and
+            # projected split points for the hottest shard
+            from geomesa_tpu.obs.shardwatch import WATCH
+            return 200, WATCH.balance()
         if parts == ["cluster"]:
             # the partition plane: process count, per-process rows, Morton
             # key-range ownership, mesh topology, psum round counters.

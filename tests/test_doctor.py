@@ -337,7 +337,8 @@ def test_verdict_is_one_line_with_suspect_and_trace():
     assert "trace=n1-abc123" in line
     assert set(RULES) == {"slo_burn", "replication_lag", "recompile_churn",
                           "shed_storm", "breaker_flapping",
-                          "wal_fsync_stall", "hot_skew", "reindex_churn"}
+                          "wal_fsync_stall", "hot_skew", "reindex_churn",
+                          "shard_imbalance", "collective_straggler"}
 
 
 # -- journal: rotation + replay (satellite) -----------------------------------

@@ -160,6 +160,41 @@ def test_process_count_mismatch_is_new_baseline_never_a_gate():
     assert not rep2["ok"]
 
 
+def test_hand_aged_baseline_without_process_meta_never_raises(tmp_path):
+    """ISSUE 16 satellite: a baseline file written before num_processes
+    existed — meta present but lacking the key, or the whole meta block
+    absent, or the value unparseable garbage — must load and gate as
+    new-baseline/single-process, never raise. Regression: the mismatch
+    guard used int(...) straight off the meta dict and a garbage value
+    bricked --check until someone hand-edited the committed file."""
+    # age the file on disk the way a real pre-PR-15 baseline looks
+    aged = pw.empty_baselines()
+    aged["metrics"]["cfg4_knn10_ms"] = {
+        "samples": [470.0], "median": 470.0, "mad": 0.0,
+        "direction": "lower"}
+    del aged["meta"]                       # the whole block predates meta
+    path = str(tmp_path / "baselines.json")
+    with open(path, "w") as fh:
+        json.dump(aged, fh)
+    run = _summary({"cfg4_knn10_ms": 471.0})
+    rep = pw.check_summary(run, path)      # must not raise
+    assert rep["ok"] and rep["checked"] == 1   # absent meta -> 1 process
+
+    # meta present, key absent: same single-process semantics
+    assert pw._meta_procs({}) == 1
+    assert pw._meta_procs(None) == 1
+    assert pw._meta_procs({"num_processes": ""}) == 1
+    # parseable strings parse; garbage means mismatch, not a crash
+    assert pw._meta_procs({"num_processes": "2"}) == 2
+    assert pw._meta_procs({"num_processes": "gloo"}) is None
+    base = _baselines({"cfg4_knn10_ms": [470.0]})
+    base["meta"]["num_processes"] = "gloo"
+    rep = pw.compare(_summary({"cfg4_knn10_ms": 9999.0}), base)
+    assert rep["ok"] and rep["checked"] == 0
+    assert rep["process_mismatch"] == {"run": 1, "baseline": None}
+    assert "process-count mismatch" in pw.render(rep)
+
+
 def test_machine_normalization_scales_thresholds():
     """A 2x-slower host (CPU proxy doubled) must not flag durations that
     merely scaled with the machine."""
