@@ -333,6 +333,13 @@ def main(args=None) -> int:
         detail["cfg1_host_keys_s"] = round(time.perf_counter() - t0, 2)
         planner = QueryPlanner(sft, table, [idx])
 
+        # pre-warm the fused single-dispatch programs (cold-shape XLA
+        # compiles otherwise land in the first prepared query below)
+        from geomesa_tpu.index import compiled as _fused_mod
+        t0 = time.perf_counter()
+        _fused_mod.warm_programs(idx)
+        detail["cfg1_fused_warm_s"] = round(time.perf_counter() - t0, 2)
+
         ecql = (f"BBOX(geom, {qx0}, {qy0}, {qx1}, {qy1}) AND "
                 "dtg DURING 2020-01-05T00:00:00Z/2020-01-12T00:00:00Z")
         t0 = time.perf_counter()
@@ -1662,6 +1669,132 @@ def main(args=None) -> int:
             }, fh, indent=1)
         assert skew13["ok"], skew13["checks"]
         assert ctrl13["ok"], ctrl13["checks"]
+
+    if "14" in configs:
+        # -- 14: single-dispatch cold-query latency (staged vs fused) -------
+        # Uncached single queries: each iteration is a bbox the planner has
+        # never seen (same *shape*, distinct values), so the staged path
+        # pays cover decomposition + candidate uploads + residual compile
+        # per query while the fused path binds values into a cached device
+        # program and pays exactly ONE host<->device round.
+        from geomesa_tpu import config as _cfg
+        from geomesa_tpu.index import compiled as _fq
+        from geomesa_tpu.index.scan import ROUNDS as _rounds
+        t14_start = time.perf_counter()
+        # 100k rows at 512-row blocks prunes like 100M at 4096 (same
+        # block-count regime the fused qualifier keys on)
+        _cfg.PRUNE_BLOCK.set(512)
+        _cfg.FUSED_QUERY.set(True)
+        try:
+            n14 = 100_000
+            rng14 = np.random.default_rng(1234)
+            cent14 = rng14.uniform([-120, -40], [140, 60], size=(64, 2))
+            which14 = rng14.integers(0, 64, n14)
+            x14 = np.clip(cent14[which14, 0] + rng14.normal(0, 8, n14),
+                          -180, 180)
+            y14 = np.clip(cent14[which14, 1] + rng14.normal(0, 6, n14),
+                          -90, 90)
+            base14 = np.datetime64("2020-01-01T00:00:00",
+                                   "ms").astype(np.int64)
+            dtg14 = base14 + rng14.integers(0, 120 * 86400000, n14)
+            risk14 = rng14.integers(0, 100, n14).astype(np.int32)
+            sft14 = SimpleFeatureType.from_spec(
+                "gdelt14", "risk:Int,dtg:Date,*geom:Point;"
+                "geomesa.z3.interval=week")
+            table14 = FeatureTable.build(
+                sft14, {"risk": risk14, "dtg": dtg14, "geom": (x14, y14)})
+            idx14 = Z3Index(sft14, table14)
+            pl14 = QueryPlanner(sft14, table14, [idx14])
+
+            def _q14(i):
+                dx, dy = (0.83 * i) % 40.0, (0.41 * i) % 20.0
+                x0, y0 = -90 + dx, -12 - dy
+                return (f"BBOX(geom, {x0}, {y0}, {x0 + 12}, {y0 + 8})"
+                        " AND dtg DURING 2020-01-02T00:00:00Z/"
+                        "2020-03-12T00:00:00Z AND risk > 40")
+
+            # warm both tiers so the cold loops measure per-query work,
+            # not one-time XLA compiles
+            _fq.warm_programs(idx14)
+            _cfg.FUSED_QUERY.set(False)
+            for i in (90, 91):
+                pl14.prepare(_q14(i)).count()
+            _cfg.FUSED_QUERY.set(True)
+            for i in (92, 93):          # registers the shape recipe
+                pl14.prepare(_q14(i)).count()
+
+            # exactness: fused vs the staged oracle on 16 distinct boxes
+            mism14 = 0
+            for i in range(30, 46):
+                fc = pl14.prepare(_q14(i)).count()
+                _cfg.FUSED_QUERY.set(False)
+                sc = pl14.prepare(_q14(i)).count()
+                _cfg.FUSED_QUERY.set(True)
+                mism14 += int(fc != sc)
+
+            # staged cold loop: 24 never-before-seen boxes
+            _cfg.FUSED_QUERY.set(False)
+            snap14 = _rounds.snapshot()
+            stag14 = []
+            for i in range(24):
+                t0 = time.perf_counter()
+                pl14.prepare(_q14(i)).count()
+                stag14.append(time.perf_counter() - t0)
+            stag_disp14 = _rounds.rounds_since(snap14) / 24.0
+
+            # fused cold loop: 48 never-before-seen boxes
+            _cfg.FUSED_QUERY.set(True)
+            built14 = _fq.STATS["programs_built"]
+            snap14 = _rounds.snapshot()
+            fuse14 = []
+            for i in range(130, 178):
+                t0 = time.perf_counter()
+                pl14.prepare(_q14(i)).count()
+                fuse14.append(time.perf_counter() - t0)
+            fuse_disp14 = _rounds.rounds_since(snap14) / 48.0
+            recompiles14 = _fq.STATS["programs_built"] - built14
+
+            sp50 = _p50(stag14) * _stretch("cfg14_staged")
+            fp50 = _p50(fuse14)
+            detail["cfg14_staged_cold_p50_ms"] = round(sp50, 3)
+            detail["cfg14_staged_cold_p99_ms"] = round(float(
+                np.percentile(np.asarray(stag14) * 1000, 99)), 3)
+            detail["cfg14_fused_cold_p50_ms"] = round(fp50, 3)
+            detail["cfg14_fused_cold_p99_ms"] = round(float(
+                np.percentile(np.asarray(fuse14) * 1000, 99)), 3)
+            # _speedup suffix -> higher-is-better for the regression gate
+            detail["cfg14_cold_speedup"] = round(sp50 / fp50, 2)
+            detail["cfg14_fused_dispatches_per_cold_query"] = fuse_disp14
+            detail["cfg14_staged_dispatches_per_cold_query"] = round(
+                stag_disp14, 2)
+            detail["cfg14_fused_recompiles"] = recompiles14
+            detail["cfg14_fused_parity_mismatches"] = mism14
+            floor14 = detail.get("dispatch_floor_ms_per_query")
+            if floor14:
+                detail["cfg14_staged_floor_multiple"] = round(
+                    sp50 / floor14, 1)
+                detail["cfg14_fused_floor_multiple"] = round(
+                    fp50 / floor14, 1)
+            detail["cfg14_wall_s"] = round(
+                time.perf_counter() - t14_start, 3)
+            # cold-query artifact (CI uploads it)
+            with open(os.path.join(REPO, "BENCH_fused_cold.json"),
+                      "w") as fh:
+                json.dump({
+                    "n": n14,
+                    "staged_cold_ms": [round(t * 1000, 4) for t in stag14],
+                    "fused_cold_ms": [round(t * 1000, 4) for t in fuse14],
+                    "summary": {k: detail[k] for k in sorted(detail)
+                                if k.startswith("cfg14_")},
+                }, fh, indent=1)
+            assert mism14 == 0, f"fused/staged parity broke: {mism14}"
+            assert recompiles14 == 0, \
+                f"fused path recompiled {recompiles14}x across one shape"
+            assert fuse_disp14 == 1.0, \
+                f"fused cold query took {fuse_disp14} rounds, expected 1"
+        finally:
+            _cfg.FUSED_QUERY.unset()
+            _cfg.PRUNE_BLOCK.unset()
 
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",

@@ -832,6 +832,38 @@ DOCTOR_STRAGGLER_ROUNDS = _register(
     "this many over-bar straggler rounds inside the doctor window.")
 
 
+# -- single-dispatch query compilation (ISSUE 17) -----------------------------
+
+FUSED_QUERY = _register(
+    "GEOMESA_TPU_FUSED_QUERY", True, _parse_bool,
+    "Master switch for single-dispatch query compilation "
+    "(index/compiled.py): qualifying plan shapes lower the filter IR "
+    "into ONE jitted program (cover + scan + residual + aggregate, one "
+    "host->device round trip) and repeat shapes bind through the recipe "
+    "fast path without replanning. Off: every query runs the staged "
+    "planner/scan path.")
+
+PALLAS_REFINE = _register(
+    "GEOMESA_TPU_PALLAS_REFINE", False, _parse_bool,
+    "Use the Pallas tiling of the point-in-polygon certainty-band "
+    "classifier inside fused refine programs (interpret mode off-TPU). "
+    "A one-time probe falls back to the jnp band kernel on any backend "
+    "where Pallas lowering fails, so this can never break correctness.")
+
+FUSED_SHAPE_CACHE = _register(
+    "GEOMESA_TPU_FUSED_SHAPE_CACHE", 256, int,
+    "LRU capacity of the per-planner (filter shape, auths) -> recipe "
+    "cache that lets repeat shapes skip planning entirely. Compiled "
+    "program bodies are bounded separately by GEOMESA_TPU_KERNEL_CACHE.")
+
+ROUTER_CELL_MEMO = _register(
+    "GEOMESA_TPU_ROUTER_CELL_MEMO", 4096, int,
+    "LRU capacity of the router's cql -> Morton-cell affinity memo. "
+    "Bounds memory under high-cardinality filter streams; size is "
+    "exported as the router.cell_memo.size gauge. <= 0 disables "
+    "memoization.")
+
+
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property
     (the CLI `config` listing / docs surface)."""

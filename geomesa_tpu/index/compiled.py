@@ -1,0 +1,1298 @@
+"""Single-dispatch query compilation: one plan *shape* → ONE device program.
+
+≙ the reference's server-side push-down taken to its limit: instead of the
+host orchestrating plan → range-decompose → scan → refine as separate device
+rounds (each paying the dispatch floor ``bench.py`` tracks as
+``dispatch_floor_ms_per_query``), a qualifying plan shape compiles into a
+single jitted program that does cover/block selection, the primary scan, the
+lowered residual predicate, and the aggregate in ONE dispatch with ONE
+host→device round trip.
+
+Three layers:
+
+1. **IR lowering** (``_lower_residual``): walks the filter-IR tree and emits
+   the residual mask directly into the program, mirroring
+   ``scan.compile_residual``'s structure-key grammar EXACTLY (the lowered key
+   must reproduce the interpreted key, or we fall back) — but constants land
+   in ONE packed int32 vector instead of a params list, so a whole query
+   ships as a single warm-shaped transfer inside the dispatch.
+
+2. **In-kernel cover selection**: per-block f32 coordinate (and time-bin)
+   summaries live on device; the program gates blocks against the query's
+   f32 envelope (slack-expanded superset — the exact fp62 mask re-applies to
+   every gathered row), gathers up to CAP candidate blocks, and falls back to
+   the full-table mask *inside the same program* (``lax.cond``) when the
+   candidate set overflows. The program is total: no host-visible overflow
+   round trip for counts.
+
+3. **Shape-keyed caching + recipe fast path**: programs key by the same
+   normalized structure signature discipline as the plan cache (geometry is
+   data, shape is structure — N distinct bboxes of one shape compile ONCE),
+   bounded in a ``ModuleKernelCache`` LRU and counted in ``kernels.compiled``.
+   A per-planner recipe cache additionally maps (filter shape, auths) →
+   bind instructions, so a repeat *shape* skips ``planner.plan()`` and range
+   decomposition entirely: extract boxes/windows, pack, dispatch.
+
+Fallback rules (always exact — the staged path is the oracle): attribute
+-index plans, FID filters, union/OR plans, vocab-less string predicates,
+host residuals other than single-polygon INTERSECTS over point layers,
+tables under 4 gather blocks, and any structure-key drift between the
+lowered and interpreted residuals.
+
+Knobs: ``GEOMESA_TPU_FUSED_QUERY`` (master switch),
+``GEOMESA_TPU_PALLAS_REFINE`` (Pallas point-in-polygon inner loop),
+``GEOMESA_TPU_FUSED_SHAPE_CACHE`` (recipe LRU bound),
+``GEOMESA_TPU_KERNEL_CACHE`` (compiled program LRU bound).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.extract import extract_bboxes, extract_intervals
+from geomesa_tpu.index import prune as _prune
+from geomesa_tpu.index.scan import (EMPTY_BOX, EMPTY_WINDOW, PRIMARY_FNS,
+                                    ModuleKernelCache, ScanKernels,
+                                    Unsupported, _LazyBlockGather, _fetch,
+                                    _grid_scatter, _pip_band, _time_mask,
+                                    pad_boxes, pad_windows, split_residual)
+from geomesa_tpu.index.spatial import _boxes_fp62, _strip_handled
+from geomesa_tpu.curves.binnedtime import time_to_binned_time
+from geomesa_tpu.metrics import REGISTRY
+from geomesa_tpu.obs import attrib as _attrib
+from geomesa_tpu.serve.resilience import deadline as _rdl
+
+# module-level program cache: LRU-bounded by GEOMESA_TPU_KERNEL_CACHE,
+# registered in _KERNEL_INSTANCES so fused programs count in the
+# kernels.compiled gauge and the PR-6 recompile detector exactly like the
+# staged scan kernels they replace
+_PROGRAMS = ModuleKernelCache("fused_query")
+
+# observable ledger for tests and the debug/healthz surfaces
+STATS: Dict[str, int] = {
+    "queries": 0,          # dispatches served by a fused program
+    "fallbacks": 0,        # qualification declines (staged path served)
+    "programs_built": 0,   # distinct program compiles
+    "shape_hits": 0,       # recipe fast-path binds (no planner.plan at all)
+    "shape_misses": 0,     # shapes seen before a recipe existed
+    "bind_failures": 0,    # recipe present but the new values didn't bind
+    "overflow_retries": 0, # select capacity regrows
+}
+
+REGISTRY.set_gauge("fused.programs", lambda: len(_PROGRAMS._jitted))
+
+# block-gate slack in degrees: the per-block summaries are f32 reductions of
+# the f32 coordinate planes and the gate envelopes are f32 roundings of f64
+# query bounds — both within _IN_DELTA (2.5e-5) of exact. 1e-3 deg is >>
+# both, so a gated-out block provably contains no match (the exact fp62 mask
+# re-applies inside the gathered blocks either way).
+_GATE_SLACK = np.float32(1e-3)
+
+# select-capacity tiers shared with planner._SELECT_TIERS (each distinct
+# capacity is its own compile; hints quantize UP)
+_SELECT_TIERS = (1 << 10, 1 << 13, 1 << 16, 1 << 19, 1 << 22)
+
+_UNC_CAP = 4096  # refine-mode uncertain-row capacity (host fallback past it)
+
+
+def _pow2(x: int) -> int:
+    return max(1, 1 << max(0, int(x) - 1).bit_length())
+
+
+def _tier(capacity: Optional[int]) -> int:
+    if capacity is None:
+        return 1 << 16
+    for t in _SELECT_TIERS:
+        if capacity <= t:
+            return t
+    return _pow2(capacity)
+
+
+# -- packed constant layout ---------------------------------------------------
+
+
+class _Layout:
+    """Every per-query constant (boxes, gate, windows, residual values, vis
+    codes, edges, grid) packs into ONE pow2-padded int32 vector — one warm
+    transfer shape per program, shipped with the dispatch. f32 slots ride as
+    bit patterns (``view``/``bitcast_convert_type``)."""
+
+    def __init__(self):
+        self.slots: List[tuple] = []   # (offset, size, shape, is_f32)
+        self._n = 0
+
+    def add(self, shape: tuple, f32: bool = False) -> int:
+        size = 1
+        for d in shape:
+            size *= int(d)
+        self.slots.append((self._n, size, tuple(shape), bool(f32)))
+        self._n += size
+        return len(self.slots) - 1
+
+    @property
+    def padded(self) -> int:
+        return _pow2(max(8, self._n))
+
+    def signature(self) -> tuple:
+        """Value-free structural signature (part of the program key)."""
+        return tuple((size, shape, f32) for _, size, shape, f32 in self.slots)
+
+    def pack(self, values: list) -> np.ndarray:
+        out = np.zeros(self.padded, dtype=np.int32)
+        for (off, size, shape, f32), v in zip(self.slots, values):
+            if f32:
+                a = np.ascontiguousarray(v, dtype=np.float32)
+                out[off:off + size] = a.reshape(-1).view(np.int32)
+            else:
+                out[off:off + size] = np.asarray(
+                    v, dtype=np.int32).reshape(-1)
+        return out
+
+
+def _make_get(slots: tuple) -> Callable:
+    """In-kernel unpack: static slices + bitcast, so unpacking fuses away."""
+    import jax
+    import jax.numpy as jnp
+
+    def get(packed, i: int):
+        off, size, shape, f32 = slots[i]
+        v = packed[off:off + size]
+        if f32:
+            v = jax.lax.bitcast_convert_type(v, jnp.float32)
+        return v.reshape(shape) if shape else v[0]
+
+    return get
+
+
+# -- residual IR lowering -----------------------------------------------------
+
+# attr type names whose device columns are exact (mirrors scan.py)
+_EXACT_DEVICE_TYPES = {"Int", "Integer", "Boolean", "String", "Float"}
+
+
+def _lower_residual(f: Optional[ir.Filter], sft, string_vocabs,
+                    available: Optional[set], layout: _Layout, values: list):
+    """``compile_residual``'s twin: same structure-key grammar and the same
+    ``Unsupported`` conditions, but constants allocate packed layout slots
+    and the emitted fn reads them back through ``get``. Returns
+    (structure_key, emit | None) where emit(cols, packed, get) → bool mask.
+    """
+    import functools
+
+    import jax.numpy as jnp
+
+    if f is None:
+        return "none", None
+
+    def check_available(attr: str) -> None:
+        if available is not None and attr not in available:
+            raise Unsupported(f"{attr} not in the device column group")
+
+    def const(v, f32: bool = False, shape: tuple = ()) -> int:
+        values.append(v)
+        return layout.add(shape, f32)
+
+    def walk(node: ir.Filter):
+        if isinstance(node, ir.Include):
+            return "inc", lambda cols, p, get: jnp.ones(
+                next(iter(cols.values())).shape[0], dtype=bool)
+        if isinstance(node, ir.Exclude):
+            return "exc", lambda cols, p, get: jnp.zeros(
+                next(iter(cols.values())).shape[0], dtype=bool)
+        if isinstance(node, ir.And):
+            keys, fns = zip(*(walk(c) for c in node.children))
+            return "and(" + ",".join(keys) + ")", \
+                lambda cols, p, get, fns=fns: functools.reduce(
+                    jnp.logical_and, [g(cols, p, get) for g in fns])
+        if isinstance(node, ir.Or):
+            keys, fns = zip(*(walk(c) for c in node.children))
+            return "or(" + ",".join(keys) + ")", \
+                lambda cols, p, get, fns=fns: functools.reduce(
+                    jnp.logical_or, [g(cols, p, get) for g in fns])
+        if isinstance(node, ir.Not):
+            k, g = walk(node.child)
+            return f"not({k})", lambda cols, p, get, g=g: ~g(cols, p, get)
+        if isinstance(node, ir.Cmp):
+            check_available(node.attr)
+            attr = sft.attribute(node.attr)
+            if attr.type_name == "String":
+                if node.op not in ("=", "<>"):
+                    raise Unsupported("ordered string cmp on device")
+                vocab = string_vocabs.get(node.attr)
+                if vocab is None:
+                    raise Unsupported("no vocab")
+                try:
+                    code = vocab.index(node.value)
+                except ValueError:
+                    code = -1  # matches nothing
+                i = const(code)
+                if node.op == "=":
+                    return f"seq:{node.attr}", \
+                        lambda cols, p, get, i=i, a=node.attr: \
+                        cols[a] == get(p, i)
+                return f"sne:{node.attr}", \
+                    lambda cols, p, get, i=i, a=node.attr: \
+                    cols[a] != get(p, i)
+            if attr.type_name not in _EXACT_DEVICE_TYPES:
+                raise Unsupported(f"{attr.type_name} cmp is inexact on device")
+            i = const(node.value, f32=(attr.type_name == "Float"))
+            op = node.op
+            key = f"cmp{op}:{node.attr}"
+
+            def g(cols, p, get, i=i, a=node.attr, op=op):
+                c = cols[a]
+                v = get(p, i)
+                return {"=": c == v, "<>": c != v, "<": c < v,
+                        "<=": c <= v, ">": c > v, ">=": c >= v}[op]
+            return key, g
+        if isinstance(node, ir.In):
+            check_available(node.attr)
+            attr = sft.attribute(node.attr)
+            if attr.type_name == "String":
+                vocab = string_vocabs.get(node.attr)
+                if vocab is None:
+                    raise Unsupported("no vocab")
+                codes = [vocab.index(v) for v in node.values if v in vocab] \
+                    or [-1]
+            elif attr.type_name in ("Int", "Integer"):
+                codes = [int(v) for v in node.values]
+            else:
+                raise Unsupported("IN on non-int/string")
+            size = max(1, 1 << (len(codes) - 1).bit_length())
+            padded = codes + [codes[-1]] * (size - len(codes))
+            i = const(padded, shape=(size,))
+            return f"in{size}:{node.attr}", \
+                lambda cols, p, get, i=i, a=node.attr: jnp.any(
+                    cols[a][:, None] == get(p, i)[None, :], axis=1)
+        if isinstance(node, ir.During):
+            raise Unsupported("During handled by primary time windows")
+        raise Unsupported(type(node).__name__)
+
+    return walk(f)
+
+
+# -- per-block device summaries (the in-kernel cover) -------------------------
+
+
+def _block_summaries(index, bsz: int):
+    """Per-gather-block coordinate (and time-bin) envelopes, resident on
+    device and cached on the index. The program's block gate tests query
+    envelopes against these — a slack-expanded superset of the block's rows
+    (invalid/padded rows fold to ∓inf so they never keep a block alive)."""
+    cached = getattr(index, "_fused_summ", None)
+    if cached is not None and cached[0] == bsz:
+        return cached[1]
+    import jax
+    import jax.numpy as jnp
+
+    cols = index.device.columns
+    n = int(cols["xf"].shape[0])
+    nb = -(-n // bsz)
+    pad = nb * bsz - n
+    valid = cols.get("__valid__")
+
+    def blocked(c, fill):
+        if valid is not None:
+            c = jnp.where(valid, c, fill)
+        if pad:
+            c = jnp.concatenate([c, jnp.full((pad,), fill, c.dtype)])
+        return c.reshape(nb, bsz)
+
+    inf = jnp.float32(np.inf)
+    summ = {
+        "bxmin": jnp.min(blocked(cols["xf"], inf), axis=1) - _GATE_SLACK,
+        "bxmax": jnp.max(blocked(cols["xf"], -inf), axis=1) + _GATE_SLACK,
+        "bymin": jnp.min(blocked(cols["yf"], inf), axis=1) - _GATE_SLACK,
+        "bymax": jnp.max(blocked(cols["yf"], -inf), axis=1) + _GATE_SLACK,
+    }
+    if "bin" in cols:
+        lo = jnp.int32(-(1 << 31) + 1)
+        hi = jnp.int32((1 << 31) - 1)
+        summ["binmin"] = jnp.min(blocked(cols["bin"], hi), axis=1)
+        summ["binmax"] = jnp.max(blocked(cols["bin"], lo), axis=1)
+    jax.block_until_ready(summ)
+    index._fused_summ = (bsz, summ)
+    return summ
+
+
+# -- Pallas point-in-polygon refine prototype --------------------------------
+
+
+_PALLAS_OK: Optional[bool] = None
+
+
+def _pallas_pip(px, py, edges):
+    """Pallas tiling of the certainty-band point-in-polygon classifier:
+    point tiles stream through VMEM against the full resident edge table.
+    CPU-safe via interpret mode (non-TPU backends)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n = int(px.shape[0])
+    ne = int(edges.shape[0])
+    tile = 512 if n >= 512 else _pow2(n)
+    npad = -(-n // tile) * tile
+    if npad != n:
+        far = jnp.full((npad - n,), 1e9, jnp.float32)
+        px = jnp.concatenate([px, far])   # pad rows classify certain-out
+        py = jnp.concatenate([py, far])
+
+    def kernel(px_ref, py_ref, e_ref, cin_ref, cout_ref):
+        e = e_ref[...]
+        cin, cout = _pip_band(
+            px_ref[...][:, None], py_ref[...][:, None],
+            e[None, :, 0], e[None, :, 1], e[None, :, 2], e[None, :, 3])
+        cin_ref[...] = cin
+        cout_ref[...] = cout
+
+    cin, cout = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((npad,), jnp.bool_)] * 2,
+        grid=(npad // tile,),
+        in_specs=[pl.BlockSpec((tile,), lambda i: (i,)),
+                  pl.BlockSpec((tile,), lambda i: (i,)),
+                  pl.BlockSpec((ne, 4), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((tile,), lambda i: (i,))] * 2,
+        interpret=jax.default_backend() != "tpu",
+    )(px, py, edges)
+    return cin[:n], cout[:n]
+
+
+def _pallas_available() -> bool:
+    """GEOMESA_TPU_PALLAS_REFINE gate + a one-time eager probe: any failure
+    (backend without pallas lowering) permanently falls back to the jnp
+    band kernel, so the knob can never break correctness."""
+    if not config.PALLAS_REFINE.get():
+        return False
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            import jax.numpy as jnp
+            ep = jnp.asarray(np.tile(ScanKernels._EDGE_PAD, (4, 1)))
+            z = jnp.zeros(4, jnp.float32)
+            _PALLAS_OK = bool(np.asarray(_pallas_pip(z, z, ep)[1]).all())
+        except Exception:
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
+def _pip_flags(px, py, edges, use_pallas: bool):
+    if use_pallas:
+        return _pallas_pip(px, py, edges)
+    return _pip_band(px[:, None], py[:, None],
+                     edges[None, :, 0], edges[None, :, 1],
+                     edges[None, :, 2], edges[None, :, 3])
+
+
+# -- the fused program --------------------------------------------------------
+
+
+class _Program:
+    """A compiled fused program bound to one query's packed constants."""
+
+    __slots__ = ("fn", "cols", "summ", "packed", "mode", "sel_cap",
+                 "unc_cap", "n", "res_key", "key", "layout")
+
+    def __init__(self, fn, cols, summ, packed, mode, sel_cap, unc_cap, n,
+                 res_key, key, layout=None):
+        self.fn = fn
+        self.cols = cols
+        self.summ = summ
+        self.packed = packed   # host np; ships WITH the dispatch (one round)
+        self.mode = mode
+        self.sel_cap = sel_cap
+        self.unc_cap = unc_cap
+        self.n = n
+        self.res_key = res_key
+        self.key = key
+        self.layout = layout   # set by _build; the template-rebind fast path
+
+    def dispatch(self):
+        """The single dispatch: packed constants ride into the jit call, the
+        returned device value syncs only when the caller reads it."""
+        return self.fn(self.cols, self.summ, self.packed)
+
+
+def _jit_program(mode: str, slots: tuple, six: Dict[str, int], emit,
+                 T: int, n: int, bsz: int, cap: int, sel_cap: int,
+                 unc_cap: int, use_pallas: bool, has_bin: bool,
+                 width: int, height: int):
+    """Build + jit one fused program. Everything here is structure; values
+    arrive through the packed vector at dispatch time."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    get = _make_get(slots)
+    total = cap * bsz
+
+    def run(cols, summ, packed):
+        boxes = get(packed, six["boxes"])
+        gate = get(packed, six["gate"])
+        windows = get(packed, six["windows"]) if T else None
+
+        # -- in-kernel cover: which blocks can possibly match -------------
+        alive = jnp.any(
+            (summ["bxmax"][:, None] >= gate[None, :, 0])
+            & (summ["bxmin"][:, None] <= gate[None, :, 2])
+            & (summ["bymax"][:, None] >= gate[None, :, 1])
+            & (summ["bymin"][:, None] <= gate[None, :, 3]), axis=1)
+        if T and has_bin:
+            blo, bhi = windows[:, 0], windows[:, 2]
+            alive = alive & jnp.any(
+                (blo <= bhi)[None, :]
+                & (summ["binmin"][:, None] <= bhi[None, :])
+                & (summ["binmax"][:, None] >= blo[None, :]), axis=1)
+        n_alive = jnp.sum(alive)
+
+        def mask_of(c, membership=None):
+            m = PRIMARY_FNS["point_boxes"](c, boxes)
+            if T:
+                m = m & _time_mask(c, windows)
+            if emit is not None:
+                m = m & emit(c, packed, get)
+            if "vis" in six:
+                codes = get(packed, six["vis"])
+                m = m & jnp.any(
+                    c["__vis__"][:, None] == codes[None, :], axis=1)
+            if "__valid__" in c:
+                m = m & c["__valid__"]
+            if membership is not None:
+                m = m & membership
+            return m
+
+        def gathered():
+            # scan.py expand_blocks discipline: clamped starts re-read a
+            # suffix of the previous block; the membership test masks the
+            # re-reads and -1 pads without double counts
+            bids = jnp.nonzero(
+                alive, size=cap, fill_value=-1)[0].astype(jnp.int32)
+            bids = jnp.where(bids < nb_blocks, bids, -1)
+            starts = bids * bsz
+            astart = jnp.clip(starts, 0, max(0, n - bsz))
+            rows = astart[:, None] + jnp.arange(bsz, dtype=jnp.int32)[None, :]
+            membership = ((bids >= 0)[:, None]
+                          & (rows >= starts[:, None])
+                          & (rows < starts[:, None] + bsz)).reshape(-1)
+            g = _LazyBlockGather(cols, astart, bsz, total)
+            return mask_of(g, membership), rows.reshape(-1), g
+
+        def refine_of(c, m):
+            edges = get(packed, six["edges"])
+            cin, cout = _pip_flags(c["xf"], c["yf"], edges, use_pallas)
+            return m & cin, m & ~cin & ~cout
+
+        if mode == "count":
+            def pruned(_):
+                m, _, _ = gathered()
+                return jnp.sum(m).astype(jnp.int32)
+
+            def full(_):
+                return jnp.sum(mask_of(cols)).astype(jnp.int32)
+
+            return lax.cond(n_alive <= cap, pruned, full, 0)
+
+        if mode == "select":
+            def pruned(_):
+                m, rowids, _ = gathered()
+                sel = jnp.nonzero(m, size=sel_cap, fill_value=total)[0]
+                rows = jnp.where(
+                    sel < total, rowids[jnp.clip(sel, 0, total - 1)], n)
+                return jnp.concatenate([
+                    jnp.sum(m)[None].astype(jnp.int32),
+                    rows.astype(jnp.int32)])
+
+            def full(_):
+                m = mask_of(cols)
+                sel = jnp.nonzero(m, size=sel_cap, fill_value=n)[0]
+                return jnp.concatenate([
+                    jnp.sum(m)[None].astype(jnp.int32),
+                    sel.astype(jnp.int32)])
+
+            return lax.cond(n_alive <= cap, pruned, full, 0)
+
+        if mode in ("count_refine", "select_refine"):
+            def pruned(_):
+                m, rowids, g = gathered()
+                hit, unc = refine_of(g, m)
+                parts = [jnp.sum(hit)[None].astype(jnp.int32),
+                         jnp.sum(unc)[None].astype(jnp.int32)]
+                if mode == "select_refine":
+                    s = jnp.nonzero(hit, size=sel_cap, fill_value=total)[0]
+                    parts.append(jnp.where(
+                        s < total, rowids[jnp.clip(s, 0, total - 1)],
+                        n).astype(jnp.int32))
+                u = jnp.nonzero(unc, size=unc_cap, fill_value=total)[0]
+                parts.append(jnp.where(
+                    u < total, rowids[jnp.clip(u, 0, total - 1)],
+                    n).astype(jnp.int32))
+                return jnp.concatenate(parts)
+
+            def full(_):
+                m = mask_of(cols)
+                hit, unc = refine_of(cols, m)
+                parts = [jnp.sum(hit)[None].astype(jnp.int32),
+                         jnp.sum(unc)[None].astype(jnp.int32)]
+                if mode == "select_refine":
+                    parts.append(jnp.nonzero(
+                        hit, size=sel_cap,
+                        fill_value=n)[0].astype(jnp.int32))
+                parts.append(jnp.nonzero(
+                    unc, size=unc_cap, fill_value=n)[0].astype(jnp.int32))
+                return jnp.concatenate(parts)
+
+            return lax.cond(n_alive <= cap, pruned, full, 0)
+
+        if mode == "density":
+            grid = get(packed, six["grid"])
+
+            def pruned(_):
+                m, _, g = gathered()
+                return (_grid_scatter(g["xf"], g["yf"], m, None, grid,
+                                      width, height),
+                        jnp.sum(m).astype(jnp.int32))
+
+            def full(_):
+                m = mask_of(cols)
+                return (_grid_scatter(cols["xf"], cols["yf"], m, None, grid,
+                                      width, height),
+                        jnp.sum(m).astype(jnp.int32))
+
+            return lax.cond(n_alive <= cap, pruned, full, 0)
+
+        raise ValueError(mode)
+
+    nb_blocks = -(-n // bsz)
+    STATS["programs_built"] += 1
+    jitted = jax.jit(run)
+    if _attrib.enabled():
+        jitted = _attrib.compile_probe(jitted, f"fused_{mode}.point_boxes",
+                                       cap)
+    return jitted
+
+
+def _gate_of(boxes_geo, B: int) -> np.ndarray:
+    """(B, 4) f32 [xmin, ymin, xmax, ymax] block-gate envelopes; padded rows
+    are inverted (nothing alive)."""
+    gate = np.empty((B, 4), dtype=np.float32)
+    gate[:, 0] = 3e38
+    gate[:, 1] = 3e38
+    gate[:, 2] = -3e38
+    gate[:, 3] = -3e38
+    for i, (xmin, ymin, xmax, ymax) in enumerate(boxes_geo):
+        gate[i] = (xmin, ymin, xmax, ymax)
+    return gate
+
+
+def _build(index, sft, vocabs, mode: str, boxes: np.ndarray,
+           gate: np.ndarray, windows: Optional[np.ndarray], dev_ir,
+           vis: Optional[np.ndarray], edges: Optional[np.ndarray],
+           grid, width: int, height: int, capacity: Optional[int],
+           expected_key: Optional[str] = None) -> Optional[_Program]:
+    """Assemble layout + values for one query and fetch (or compile) its
+    program. ``boxes``/``windows`` arrive pow2-padded. Returns None when the
+    shape doesn't qualify — the staged path is always the fallback."""
+    cols = index.device.columns
+    if "xf" not in cols or "yf" not in cols:
+        return None
+    n = int(cols["xf"].shape[0])
+    bsz = int(_prune.BLOCK_SIZE)
+    if n < 4 * bsz:
+        return None  # tiny tables: the staged full mask is already one pass
+    T = 0 if windows is None else len(windows)
+    if T and ("bin" not in cols or "off" not in cols):
+        return None
+
+    layout = _Layout()
+    values: list = []
+    six: Dict[str, int] = {}
+    six["boxes"] = layout.add(boxes.shape)
+    values.append(boxes)
+    six["gate"] = layout.add(gate.shape, f32=True)
+    values.append(gate)
+    if T:
+        six["windows"] = layout.add(windows.shape)
+        values.append(windows)
+    try:
+        res_key, emit = _lower_residual(dev_ir, sft, vocabs, set(cols),
+                                        layout, values)
+    except Unsupported:
+        return None
+    if vis is not None:
+        if "__vis__" not in cols:
+            return None
+        six["vis"] = layout.add((len(vis),))
+        values.append(vis)
+        res_key = f"vis{len(vis)}&({res_key})"
+    if expected_key is not None and res_key != expected_key:
+        # structure drift between the lowered and interpreted residuals:
+        # stay staged rather than risk a divergent program
+        return None
+    ne = 0
+    if edges is not None:
+        ne = len(edges)
+        six["edges"] = layout.add((ne, 4), f32=True)
+        values.append(edges)
+    if grid is not None:
+        six["grid"] = layout.add((4,), f32=True)
+        values.append(np.asarray(grid, dtype=np.float32))
+
+    nb = -(-n // bsz)
+    cap = min(_pow2(max(4, int(np.ceil(
+        nb * float(config.PRUNE_MAX_FRACTION.get()))))), _pow2(nb))
+    sel_cap = min(_tier(capacity), _pow2(n)) \
+        if mode in ("select", "select_refine") else 0
+    unc_cap = _UNC_CAP if ne else 0
+    use_pallas = bool(ne) and _pallas_available()
+    has_bin = T > 0 and "bin" in cols
+
+    # value-free program key: geometry/time/residual VALUES ride in the
+    # packed vector; only structure lands here, so N distinct bboxes of one
+    # shape share one compile (the recompile-churn pin)
+    key = ("fq", mode, res_key, layout.signature(), n, bsz, cap, sel_cap,
+           unc_cap, use_pallas, has_bin, width, height)
+    slots = tuple(layout.slots)
+    fn = _PROGRAMS.get(key, lambda: _jit_program(
+        mode, slots, dict(six), emit, T, n, bsz, cap, sel_cap, unc_cap,
+        use_pallas, has_bin, width, height))
+    summ = _block_summaries(index, bsz)
+    return _Program(fn, cols, summ, layout.pack(values), mode, sel_cap,
+                    unc_cap, n, res_key, key, layout)
+
+
+# -- plan qualification -------------------------------------------------------
+
+
+def _refine_edges(plan) -> Optional[np.ndarray]:
+    """Padded f32 edge table when the host residual is exactly one
+    polygon-INTERSECTS on the plan's geometry (the point-layer refine shape
+    the fused program classifies with certainty bands)."""
+    res = plan.residual_host
+    if not isinstance(res, ir.Intersects):
+        return None
+    if getattr(plan.index, "geom", None) != res.attr:
+        return None
+    from geomesa_tpu.features import geometry as geo
+    if res.geometry[0] != geo.POLYGON:
+        return None
+    from geomesa_tpu.filter.geom_numpy import literal_segments
+    edges = literal_segments(res.geometry).astype(np.float32)
+    ne = max(4, _pow2(len(edges)))
+    ep = np.tile(ScanKernels._EDGE_PAD, (ne, 1))
+    ep[: len(edges)] = edges
+    return ep
+
+
+def _from_plan(planner, plan, mode: str, capacity: Optional[int] = None,
+               grid=None, width: int = 0, height: int = 0) \
+        -> Optional[_Program]:
+    """Qualify a staged plan for fused execution. Exactness contract: every
+    decline returns None and the caller runs the staged path; every accept
+    produces a program whose mask is the SAME primary/time/residual/vis
+    conjunction the staged kernels evaluate."""
+    if not config.FUSED_QUERY.get():
+        return None
+    if plan.empty or plan.index is None \
+            or plan.primary_kind != "point_boxes" \
+            or plan.candidate_slices is not None \
+            or plan.boxes_loose is None:
+        return None
+    cache = getattr(plan, "_fused_cache", None)
+    ck = (mode, _tier(capacity) if mode in ("select", "select_refine")
+          else 0, width, height)
+    if cache is not None and ck in cache:
+        return cache[ck]
+    boxes_geo = plan.explain.get("boxes")
+    if not boxes_geo or len(boxes_geo) > len(plan.boxes_loose):
+        return None
+    edges = None
+    if mode in ("count_refine", "select_refine"):
+        edges = _refine_edges(plan)
+        if edges is None:
+            return None
+    elif plan.residual_host is not None:
+        return None
+    dev_ir = plan.explain.get("residual_device")
+    vis = None
+    pkey = plan.residual_device[0] if plan.residual_device else "none"
+    if plan.explain.get("__vis_applied__") and pkey.startswith("vis"):
+        vis = np.asarray(plan.residual_device[1][-1], dtype=np.int32)
+    gate = _gate_of(boxes_geo, len(plan.boxes_loose))
+    prog = _build(plan.index, planner.sft, plan.index.vocabs, mode,
+                  plan.boxes_loose, gate, plan.windows, dev_ir, vis, edges,
+                  grid, width, height, capacity, expected_key=pkey)
+    try:
+        if cache is None:
+            cache = {}
+            plan._fused_cache = cache   # plans are immutable post-build
+        cache[ck] = prog
+    except (AttributeError, TypeError):
+        pass
+    return prog
+
+
+# -- execution entry points (planner integration) -----------------------------
+
+
+def prepare_count_program(planner, plan) -> Optional[_Program]:
+    """The PreparedQuery hook: a fused count dispatcher for a device-exact
+    plan, or None (staged staging takes over)."""
+    prog = _from_plan(planner, plan, "count")
+    if prog is not None:
+        STATS["queries"] += 1
+        REGISTRY.inc("fused.queries")
+    elif config.FUSED_QUERY.get():
+        STATS["fallbacks"] += 1
+    return prog
+
+
+def try_count(planner, plan) -> Optional[int]:
+    """One-dispatch count for a device-exact plan, or None."""
+    prog = _from_plan(planner, plan, "count")
+    if prog is None:
+        if config.FUSED_QUERY.get():
+            STATS["fallbacks"] += 1
+        return None
+    _rdl.check_current("fused_dispatch")
+    STATS["queries"] += 1
+    REGISTRY.inc("fused.queries")
+    with _attrib.kernel("fused_count.point_boxes"):
+        return int(_fetch(prog.dispatch))
+
+
+def try_select(planner, plan, capacity: Optional[int]) \
+        -> Optional[np.ndarray]:
+    """One-dispatch select → index POSITIONS (caller maps + sorts), or None.
+    Overflow regrows the capacity tier and re-dispatches (same discipline as
+    scan.select)."""
+    cap = capacity
+    while True:
+        prog = _from_plan(planner, plan, "select", capacity=cap)
+        if prog is None:
+            if config.FUSED_QUERY.get():
+                STATS["fallbacks"] += 1
+            return None
+        _rdl.check_current("fused_dispatch")
+        STATS["queries"] += 1
+        REGISTRY.inc("fused.queries")
+        with _attrib.kernel("fused_select.point_boxes", prog.sel_cap):
+            out = np.asarray(_fetch(prog.dispatch))
+        cnt = int(out[0])
+        if cnt <= prog.sel_cap:
+            return out[1: 1 + cnt].astype(np.int64)
+        STATS["overflow_retries"] += 1
+        cap = _pow2(cnt)
+
+
+def try_count_refine(planner, plan) -> Optional[int]:
+    """Fused scan + certainty-band polygon refine + count in one dispatch;
+    only the uncertain sliver re-evaluates on host in exact f64. None when
+    the shape doesn't qualify or uncertainty overflowed."""
+    prog = _from_plan(planner, plan, "count_refine")
+    if prog is None:
+        return None
+    _rdl.check_current("fused_dispatch")
+    STATS["queries"] += 1
+    REGISTRY.inc("fused.queries")
+    with _attrib.kernel("fused_count_refine.point_boxes"):
+        out = np.asarray(_fetch(prog.dispatch))
+    certain, n_unc = int(out[0]), int(out[1])
+    if n_unc > prog.unc_cap:
+        return None  # uncertainty overflow: staged/host refine instead
+    if n_unc == 0:
+        # the refine stage ran in-kernel (its time is in device_wait);
+        # keep the stage visible in the trace contract with 0 host rows
+        if _trace.enabled():
+            _trace.record("refine", "refine", 0.0)
+        return certain
+    pos = out[2: 2 + n_unc].astype(np.int64)
+    rows = plan.index.map_rows(pos)
+    from geomesa_tpu.filter.evaluate import evaluate_at
+    with _trace.span("refine", kind="refine", rows=len(rows)):
+        return certain + int(np.sum(
+            evaluate_at(plan.residual_host, planner.table, rows)))
+
+
+def try_select_refine(planner, plan, capacity: Optional[int]) \
+        -> Optional[np.ndarray]:
+    """Fused select with in-kernel polygon refine → FINAL sorted table rows
+    (certain hits + host-confirmed uncertain rows), or None."""
+    cap = capacity
+    while True:
+        prog = _from_plan(planner, plan, "select_refine", capacity=cap)
+        if prog is None:
+            return None
+        _rdl.check_current("fused_dispatch")
+        STATS["queries"] += 1
+        REGISTRY.inc("fused.queries")
+        with _attrib.kernel("fused_select_refine.point_boxes", prog.sel_cap):
+            out = np.asarray(_fetch(prog.dispatch))
+        n_in, n_unc = int(out[0]), int(out[1])
+        if n_unc > prog.unc_cap:
+            return None
+        if n_in > prog.sel_cap:
+            STATS["overflow_retries"] += 1
+            cap = _pow2(n_in)
+            continue
+        in_pos = out[2: 2 + n_in].astype(np.int64)
+        rows = plan.index.map_rows(in_pos)
+        if n_unc:
+            unc_pos = out[2 + prog.sel_cap:
+                          2 + prog.sel_cap + n_unc].astype(np.int64)
+            unc_rows = plan.index.map_rows(unc_pos)
+            from geomesa_tpu.filter.evaluate import evaluate_at
+            with _trace.span("refine", kind="refine", rows=len(unc_rows)):
+                keep = evaluate_at(plan.residual_host, planner.table,
+                                   unc_rows)
+            rows = np.concatenate([rows, unc_rows[keep]])
+        elif _trace.enabled():
+            # in-kernel refine resolved every candidate: the stage's time
+            # is inside device_wait, but it must stay a visible stage
+            _trace.record("refine", "refine", 0.0)
+        return np.sort(rows)
+
+
+def try_density(planner, plan, grid_bbox, width: int, height: int):
+    """One-dispatch heat-map: ((H, W) f32 grid, count) or None. Available to
+    aggregation callers; the staged density kernels remain the default."""
+    prog = _from_plan(planner, plan, "density", grid=grid_bbox, width=width,
+                      height=height)
+    if prog is None:
+        return None
+    _rdl.check_current("fused_dispatch")
+    STATS["queries"] += 1
+    REGISTRY.inc("fused.queries")
+    with _attrib.kernel("fused_density.point_boxes"):
+        grid, cnt = _fetch(prog.dispatch)
+    return np.asarray(grid), int(cnt)
+
+
+# -- shape-keyed recipe fast path (skip planning entirely) --------------------
+
+
+def _shape_key(f: ir.Filter) -> str:
+    """Value-free structural signature of a filter tree — the same
+    normalization discipline the scheduler's plan cache uses: two queries
+    with this key in common differ only in geometry/time/constant VALUES."""
+    if isinstance(f, ir.And):
+        return "and(" + ",".join(_shape_key(c) for c in f.children) + ")"
+    if isinstance(f, ir.Or):
+        return "or(" + ",".join(_shape_key(c) for c in f.children) + ")"
+    if isinstance(f, ir.Not):
+        return f"not({_shape_key(f.child)})"
+    if isinstance(f, ir.Include):
+        return "inc"
+    if isinstance(f, ir.Exclude):
+        return "exc"
+    if isinstance(f, ir.BBox):
+        return f"bbox:{f.attr}"
+    if isinstance(f, ir.Intersects):
+        return f"ints:{f.attr}:{f.geometry[0]}"
+    if isinstance(f, ir.During):
+        return f"during:{f.attr}:{int(f.lo_inclusive)}{int(f.hi_inclusive)}"
+    if isinstance(f, ir.Cmp):
+        return f"cmp{f.op}:{f.attr}"
+    if isinstance(f, ir.In):
+        return f"in{_pow2(len(f.values))}:{f.attr}"
+    raise Unsupported(type(f).__name__)
+
+
+def _auths_key(auths) -> Optional[tuple]:
+    return None if auths is None else tuple(sorted(auths))
+
+
+class _RecipeCache:
+    """Small thread-safe LRU for (shape, auths) → Recipe | None (negative).
+    Deliberately self-contained — the recipe lookup sits ahead of planning
+    on the hottest path and must stay a dict op under one lock."""
+
+    MISS = object()
+
+    def __init__(self):
+        self._d: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            v = self._d.get(key, self.MISS)
+            if v is not self.MISS:
+                self._d.move_to_end(key)
+            return v
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            cap = max(1, int(config.FUSED_SHAPE_CACHE.get()))
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > cap:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+def _recipes(planner) -> _RecipeCache:
+    cache = getattr(planner, "_fused_recipes", None)
+    if cache is None:
+        cache = _RecipeCache()
+        planner._fused_recipes = cache
+    return cache
+
+
+_EMPTY_BIND = object()   # bind result: provably-empty query (count 0)
+
+
+def _boxes_fp62_fast(boxes) -> Optional[np.ndarray]:
+    """Scalar twin of ``spatial._boxes_fp62`` for the handful-of-boxes case:
+    pure-python IEEE-754 math (bit-identical to the numpy path — python
+    floats ARE C doubles, and floor(ldexp(frac, 62)) of an integral float
+    converts to int exactly) without ~40µs of small-array numpy dispatch.
+    None on anything unusual (NaN coordinates) → caller uses the array path.
+    """
+    import math
+    out = np.empty((len(boxes), 8), dtype=np.int32)
+    m62 = (1 << 62) - 1
+    m31 = (1 << 31) - 1
+    try:
+        for i, (xmin, ymin, xmax, ymax) in enumerate(boxes):
+            row = out[i]
+            for j, (c, lo, hi) in enumerate(
+                    ((xmin, -180.0, 360.0), (xmax, -180.0, 360.0),
+                     (ymin, -90.0, 180.0), (ymax, -90.0, 180.0))):
+                frac = (float(c) - lo) / hi
+                frac = 0.0 if frac < 0.0 else (1.0 if frac > 1.0 else frac)
+                v = min(math.floor(math.ldexp(frac, 62)), m62)
+                row[2 * j] = v >> 31
+                row[2 * j + 1] = v & m31
+    except (ValueError, OverflowError):   # NaN / inf coordinate
+        return None
+    return out
+
+
+def _collect_values(f: Optional[ir.Filter], sft, string_vocabs,
+                    out: list) -> None:
+    """Value-collecting twin of ``_lower_residual``'s walk: appends this
+    query's residual constants to ``out`` in the SAME traversal order the
+    lowering allocated its layout slots, raising ``Unsupported`` under the
+    same conditions. Used by the template rebind (``_rebind``), which then
+    shape-checks every value against the template's slots — any drift falls
+    back to the full ``_build``."""
+    if f is None:
+        return
+    if isinstance(f, (ir.Include, ir.Exclude)):
+        return
+    if isinstance(f, (ir.And, ir.Or)):
+        for c in f.children:
+            _collect_values(c, sft, string_vocabs, out)
+        return
+    if isinstance(f, ir.Not):
+        _collect_values(f.child, sft, string_vocabs, out)
+        return
+    if isinstance(f, ir.Cmp):
+        attr = sft.attribute(f.attr)
+        if attr.type_name == "String":
+            vocab = string_vocabs.get(f.attr)
+            if vocab is None:
+                raise Unsupported("no vocab")
+            try:
+                out.append(vocab.index(f.value))
+            except ValueError:
+                out.append(-1)
+            return
+        if attr.type_name not in _EXACT_DEVICE_TYPES:
+            raise Unsupported("inexact cmp")
+        out.append(f.value)
+        return
+    if isinstance(f, ir.In):
+        attr = sft.attribute(f.attr)
+        if attr.type_name == "String":
+            vocab = string_vocabs.get(f.attr)
+            if vocab is None:
+                raise Unsupported("no vocab")
+            codes = [vocab.index(v) for v in f.values if v in vocab] or [-1]
+        elif attr.type_name in ("Int", "Integer"):
+            codes = [int(v) for v in f.values]
+        else:
+            raise Unsupported("IN on non-int/string")
+        size = max(1, 1 << (len(codes) - 1).bit_length())
+        out.append(codes + [codes[-1]] * (size - len(codes)))
+        return
+    raise Unsupported(type(f).__name__)
+
+
+def _rebind(recipe, boxes, gate, windows, dev_ir) -> Optional[_Program]:
+    """Hot rebind: pack this query's values straight into the recipe's
+    template program — no layout rebuild, no lowering, no cache lookups.
+    Every value is size-checked against its template slot; any mismatch
+    (vocab-miss IN shrank its pad, a column group reload, table growth)
+    returns None and the ordinary ``_build`` re-derives everything."""
+    tmpl = recipe.tmpl
+    prog, layout = tmpl
+    cols = recipe.index.device.columns
+    if cols is not prog.cols:
+        recipe.tmpl = None   # device table reloaded: template is stale
+        return None
+    values = [boxes, gate]
+    if windows is not None:
+        values.append(windows)
+    try:
+        _collect_values(dev_ir, recipe.sft, recipe.vocabs, values)
+    except Unsupported:
+        return None
+    if recipe.vis is not None:
+        values.append(recipe.vis)
+    slots = layout.slots
+    if len(values) != len(slots):
+        return None
+    packed = np.zeros(layout.padded, dtype=np.int32)
+    for (off, size, shape, f32), v in zip(slots, values):
+        if f32:
+            a = np.ascontiguousarray(v, dtype=np.float32).reshape(-1)
+            if a.size != size:
+                return None
+            packed[off:off + size] = a.view(np.int32)
+        else:
+            a = np.asarray(v, dtype=np.int32).reshape(-1)
+            if a.size != size:
+                return None
+            packed[off:off + size] = a
+    return _Program(prog.fn, cols, prog.summ, packed, prog.mode,
+                    prog.sel_cap, prog.unc_cap, prog.n, prog.res_key,
+                    prog.key)
+
+
+class Recipe:
+    """Bind instructions for one (filter shape, auths): everything needed to
+    turn a NEW same-shape filter into a packed fused count dispatch without
+    touching ``planner.plan()`` — extract boxes/intervals, window them,
+    re-lower the residual (values only; the structure key must reproduce),
+    pack, go. Any drift (box count, window count, residual key, host
+    residual appearing) returns None and the slow path serves the query
+    exactly."""
+
+    __slots__ = ("index", "sft", "geom", "dtg", "period", "vocabs",
+                 "n_boxes", "n_windows", "res_key", "vis", "template_plan",
+                 "tmpl")
+
+    def __init__(self, plan, planner, res_key, vis):
+        self.tmpl = None   # (program, layout) after the first full _build
+        self.index = plan.index
+        self.sft = planner.sft
+        self.geom = plan.index.geom
+        self.dtg = plan.index.dtg
+        self.period = plan.index.period
+        self.vocabs = plan.index.vocabs
+        self.n_boxes = len(plan.boxes_loose)
+        self.n_windows = 0 if plan.windows is None else len(plan.windows)
+        self.res_key = res_key
+        self.vis = vis
+        self.template_plan = plan
+
+    def bind(self, f: ir.Filter):
+        """→ (boxes, gate, windows, dev_ir) | _EMPTY_BIND | None."""
+        if self.geom is None:
+            return None
+        ext = extract_bboxes(f, self.geom)
+        if len(ext.boxes) == 0:
+            return _EMPTY_BIND
+        if ext.unconstrained:
+            return None
+        boxes = (_boxes_fp62_fast(ext.boxes) if len(ext.boxes) <= 4
+                 else None)
+        if boxes is None:
+            boxes = _boxes_fp62(ext.boxes)
+        if len(boxes) & (len(boxes) - 1):
+            boxes = pad_boxes(boxes)
+        if len(boxes) != self.n_boxes:
+            return None
+        windows = None
+        iv = extract_intervals(f, self.dtg) if self.dtg else None
+        if iv is not None and len(iv.intervals) == 0:
+            return _EMPTY_BIND
+        if iv is not None and not iv.unconstrained:
+            w = np.empty((len(iv.intervals), 4), dtype=np.int32)
+            i32 = (1 << 31) - 1   # open-ended intervals overflow the bin i32
+            for i, (lo, hi) in enumerate(iv.intervals):
+                blo, olo = time_to_binned_time(lo, self.period)
+                bhi, ohi = time_to_binned_time(hi, self.period)
+                w[i] = (max(-i32, int(blo)), int(olo),
+                        min(i32, int(bhi)), int(ohi))
+            windows = pad_windows(w)
+        if (0 if windows is None else len(windows)) != self.n_windows:
+            return None
+        residual = _strip_handled(f, self.geom, self.dtg, True)
+        dev_ir, host_ir = split_residual(
+            residual, self.sft, self.vocabs, set(self.index.device.columns))
+        if host_ir is not None:
+            return None   # refine shapes go through the planner
+        return boxes, _gate_of(ext.boxes, len(boxes)), windows, dev_ir
+
+
+class FusedPrepared:
+    """PreparedQuery-shaped handle from the recipe fast path: the query went
+    filter → packed constants → one dispatch, never through
+    ``planner.plan()``. ``plan`` exposes the recipe's template plan (its
+    box/window VALUES belong to the recipe's exemplar query — audit and
+    explain surfaces only)."""
+
+    def __init__(self, planner, recipe: Recipe, f: ir.Filter, auths,
+                 prog: Optional[_Program]):
+        self.planner = planner
+        self.plan = recipe.template_plan
+        self.filter = f
+        self.auths = auths
+        self._prog = prog        # None → provably empty
+
+    @property
+    def device_exact(self) -> bool:
+        return self._prog is not None
+
+    def count_async(self):
+        """Async dispatch → 0-d device array (None for empty binds) — the
+        same pipelining contract as PreparedQuery.count_async."""
+        if self._prog is None:
+            return None
+        with _trace.span("device_scan", kind="device_scan"):
+            return self._prog.dispatch()
+
+    def count(self) -> int:
+        from geomesa_tpu.index.guards import Deadline
+        attrs = {"type": self.planner.sft.name, "prepared": True}
+        if _trace.enabled():
+            attrs["filter"] = str(self.filter)  # ir repr is µs-scale; only
+        with _trace.trace("count", **attrs):    # pay it when traces record
+            dl = Deadline(self.planner.timeout_ms)
+            t0 = time.perf_counter()
+            n = 0 if self._prog is None else int(_fetch(self._prog.dispatch))
+            dl.check("scan")
+            self.planner._write_audit(self.plan, self.filter, 0.0,
+                                      (time.perf_counter() - t0) * 1000, n)
+            return n
+
+    def select_indices(self) -> np.ndarray:
+        # selects replan through the general path (capacity tiers vary);
+        # counts are the latency-critical shape the recipe accelerates
+        return self.planner.select_indices(self.filter, auths=self.auths)
+
+
+def fast_prepare(planner, f: ir.Filter, auths) -> Optional[FusedPrepared]:
+    """Recipe-keyed prepare: when this (filter shape, auths) has fused
+    before, bind the new VALUES straight into the compiled program — no
+    parse, no plan, no range decomposition, one dispatch. None sends the
+    caller down the ordinary prepare path (which registers the shape)."""
+    if not config.FUSED_QUERY.get() or getattr(planner, "interceptors", None):
+        return None
+    try:
+        skey = _shape_key(f)
+    except Unsupported:
+        return None
+    cache = _recipes(planner)
+    r = cache.get((skey, _auths_key(auths)))
+    if r is _RecipeCache.MISS:
+        STATS["shape_misses"] += 1
+        return None
+    if r is None:   # negative entry: shape known non-fusable
+        return None
+    bound = r.bind(f)
+    if bound is _EMPTY_BIND:
+        STATS["shape_hits"] += 1
+        return FusedPrepared(planner, r, f, auths, None)
+    if bound is None:
+        STATS["bind_failures"] += 1
+        return None
+    boxes, gate, windows, dev_ir = bound
+    prog = _rebind(r, boxes, gate, windows, dev_ir) \
+        if r.tmpl is not None else None
+    if prog is None:
+        prog = _build(r.index, r.sft, r.vocabs, "count", boxes, gate,
+                      windows, dev_ir, r.vis, None, None, 0, 0, None,
+                      expected_key=r.res_key)
+        if prog is None:
+            STATS["bind_failures"] += 1
+            return None
+        if prog.layout is not None:
+            r.tmpl = (prog, prog.layout)
+    STATS["shape_hits"] += 1
+    STATS["queries"] += 1
+    REGISTRY.inc("fused.shape_hits")
+    REGISTRY.inc("fused.queries")
+    return FusedPrepared(planner, r, f, auths, prog)
+
+
+def note_shape(planner, plan, f: ir.Filter, auths,
+               prog: Optional[_Program]) -> None:
+    """Slow-path epilogue: record how this shape resolved so the NEXT
+    same-shape query takes the recipe fast path (or skips the attempt —
+    negative entries stop re-qualifying known-staged shapes)."""
+    if not config.FUSED_QUERY.get() or getattr(planner, "interceptors", None):
+        return
+    if getattr(plan, "empty", False):
+        return   # emptiness is a property of the values, not the shape
+    try:
+        skey = _shape_key(f)
+    except Unsupported:
+        return
+    cache = _recipes(planner)
+    ck = (skey, _auths_key(auths))
+    if cache.get(ck) is not _RecipeCache.MISS:
+        return
+    if prog is None:
+        cache.put(ck, None)
+        return
+    vis = None
+    pkey = plan.residual_device[0] if plan.residual_device else "none"
+    if plan.explain.get("__vis_applied__") and pkey.startswith("vis"):
+        vis = np.asarray(plan.residual_device[1][-1], dtype=np.int32)
+    cache.put(ck, Recipe(plan, planner, prog.res_key, vis))
+
+
+# -- startup warming ----------------------------------------------------------
+
+
+def warm_programs(index) -> int:
+    """Compile the common fused single-dispatch count shapes for an index
+    ahead of traffic (1 box; 1 box + 1 window on temporal layers) and run
+    each once, paying the XLA compile + packed transfer-shape setup at
+    startup instead of on the first cold query. Returns programs warmed."""
+    if not config.FUSED_QUERY.get():
+        return 0
+    cols = getattr(getattr(index, "device", None), "columns", None)
+    if not cols or "xf" not in cols:
+        return 0
+    if not getattr(index, "points", False):
+        return 0
+    n = int(cols["xf"].shape[0])
+    if n < 4 * int(_prune.BLOCK_SIZE):
+        return 0
+    warmed = 0
+    shapes = [(1, 0)]
+    if "bin" in cols and "off" in cols:
+        shapes.append((1, 1))
+    for nb, nw in shapes:
+        boxes = pad_boxes(np.empty((0, 8), dtype=np.int32), min_size=nb)
+        windows = pad_windows(np.empty((0, 4), dtype=np.int32),
+                              min_size=nw) if nw else None
+        prog = _build(index, index.sft, index.vocabs, "count", boxes,
+                      _gate_of((), len(boxes)), windows, None, None, None,
+                      None, 0, 0, None)
+        if prog is None:
+            continue
+        _fetch(prog.dispatch)   # empty gate: executes, compiles both branches
+        warmed += 1
+    return warmed
+
+
+def stats_snapshot() -> Dict[str, int]:
+    """STATS + live program count (debug/healthz surfaces)."""
+    out = dict(STATS)
+    out["programs"] = len(_PROGRAMS._jitted)
+    return out

@@ -347,11 +347,22 @@ class QueryPlanner:
         """Plan once and stage all query constants on device; the returned
         handle re-executes without re-parsing, re-planning, or re-uploading
         (≙ a configured scan the reference would hand each tablet server;
-        also the natural unit for pipelined dispatch)."""
-        plan = self._apply_auths(self.plan(f), auths)
-        return PreparedQuery(self, plan,
-                             f if isinstance(f, ir.Filter) else parse_ecql(f),
-                             auths)
+        also the natural unit for pipelined dispatch).
+
+        When this (filter shape, auths) has fused before, the recipe fast
+        path (index/compiled.py) binds the new values straight into the
+        compiled single-dispatch program — no planning, no range decompose,
+        no per-constant uploads. The ordinary path registers each shape's
+        outcome so its NEXT occurrence takes the fast path."""
+        from geomesa_tpu.index import compiled as _fused
+        f_ir = f if isinstance(f, ir.Filter) else parse_ecql(f)
+        fp = _fused.fast_prepare(self, f_ir, auths)
+        if fp is not None:
+            return fp
+        plan = self._apply_auths(self.plan(f_ir), auths)
+        pq = PreparedQuery(self, plan, f_ir, auths)
+        _fused.note_shape(self, plan, f_ir, auths, pq._fused)
+        return pq
 
     def count(self, f: Union[str, ir.Filter], auths=None) -> int:
         from geomesa_tpu.index.guards import Deadline
@@ -390,12 +401,16 @@ class QueryPlanner:
         if plan.primary_kind == "fid":
             return len(self._fid_vis_filter(
                 self._fid_rows(plan.full_filter), auths))
+        from geomesa_tpu.index import compiled as _fused
         if plan.residual_host is None:
             # fully device-exact: one fused reduction, one roundtrip
             if plan.candidate_slices is not None:
                 return plan.index.kernels.count_at(
                     plan.primary_kind, plan.boxes_loose, plan.windows,
                     plan.residual_device, plan.candidate_positions())
+            fused = _fused.try_count(self, plan)
+            if fused is not None:
+                return fused
             blocks = self._pruned_blocks(plan)
             if blocks is not None:
                 if len(blocks) == 0:
@@ -406,6 +421,9 @@ class QueryPlanner:
             return plan.index.kernels.count(
                 plan.primary_kind, plan.boxes_loose, plan.windows,
                 plan.residual_device)
+        fused = _fused.try_count_refine(self, plan)
+        if fused is not None:
+            return fused
         fast = self._band_intersects_count(plan)
         if fast is not None:
             return fast
@@ -473,6 +491,15 @@ class QueryPlanner:
                     plan.primary_kind, plan.boxes_loose, plan.windows,
                     plan.residual_device, plan.candidate_positions())
             else:
+                from geomesa_tpu.index import compiled as _fused
+                if plan.residual_host is None:
+                    pos = _fused.try_select(self, plan, capacity)
+                    if pos is not None:
+                        return np.sort(plan.index.map_rows(pos))
+                else:
+                    rows = _fused.try_select_refine(self, plan, capacity)
+                    if rows is not None:
+                        return rows
                 blocks = self._pruned_blocks(plan)
                 if blocks is not None:
                     if len(blocks) == 0:
@@ -574,7 +601,16 @@ class PreparedQuery:
         self.filter = f
         self.auths = auths
         self._count_disp = None
+        self._fused = None
         if plan.device_exact:
+            from geomesa_tpu.index import compiled as _fused
+            prog = _fused.prepare_count_program(planner, plan)
+            if prog is not None:
+                # single-dispatch fused program: cover + scan + residual +
+                # count in one device round; constants ride with the call
+                self._fused = prog
+                self._count_disp = prog.dispatch
+                return
             blocks = planner._pruned_blocks(plan)
             if blocks is not None and len(blocks) > 0:
                 self._count_disp = plan.index.kernels.prepare_count_blocks(
@@ -606,8 +642,10 @@ class PreparedQuery:
         subject to the planner's cooperative deadline."""
         from geomesa_tpu.index.guards import Deadline
         from geomesa_tpu.index.scan import _fetch
-        with _trace.trace("count", type=self.planner.sft.name,
-                          filter=str(self.filter), prepared=True):
+        attrs = {"type": self.planner.sft.name, "prepared": True}
+        if _trace.enabled():
+            attrs["filter"] = str(self.filter)
+        with _trace.trace("count", **attrs):
             dl = Deadline(self.planner.timeout_ms)
             t0 = time.perf_counter()
             if self.plan.empty:

@@ -35,11 +35,35 @@ from geomesa_tpu.obs import attrib as _attrib
 from geomesa_tpu.obs import profiling as _prof
 
 
+class _RoundLedger:
+    """Process-wide host↔device round counter: every kernel dispatch and
+    every constant upload is one potential tunnel round trip (each pays the
+    ``dispatch_floor_ms_per_query`` the bench tracks). ``rounds_since`` a
+    snapshot is how the cfg14 bench and the fused-query tests pin
+    ``dispatches_per_cold_query`` — the fused path must read exactly 1."""
+
+    __slots__ = ("dispatches", "uploads")
+
+    def __init__(self):
+        self.dispatches = 0
+        self.uploads = 0
+
+    def snapshot(self):
+        return (self.dispatches, self.uploads)
+
+    def rounds_since(self, snap) -> int:
+        return (self.dispatches - snap[0]) + (self.uploads - snap[1])
+
+
+ROUNDS = _RoundLedger()
+
+
 def _fetch(dispatch, *args):
     """Run a kernel dispatch under a ``device_scan`` span (host-side enqueue)
     and block under a ``device_wait`` span — separating the dispatch floor
     from true device time in every trace. Returns the ready device value.
     Variadic so hot paths pass ``(fn, *args)`` without a closure alloc."""
+    ROUNDS.dispatches += 1
     return _trace.device_fetch(jax.block_until_ready, dispatch, *args)
 
 # -- primary spatial/temporal masks -----------------------------------------
@@ -417,7 +441,7 @@ _TRANSFER_SHAPES_WARMED = False
 _WARMED_BATCH_SIZES: set = set()
 
 
-def warm_transfer_shapes(batch_sizes=()) -> None:
+def warm_transfer_shapes(batch_sizes=(), fused_indexes=()) -> None:
     """Pre-touch the small host→device transfer shapes queries use.
 
     Through the axon RPC tunnel the FIRST device_put of each new array shape
@@ -430,7 +454,13 @@ def warm_transfer_shapes(batch_sizes=()) -> None:
     params at each size) — the micro-batching scheduler passes its flush
     tiers at construction so the FIRST fused dispatch doesn't eat the
     per-shape transfer cliff. Each size rounds up to the next power of two
-    (the pad the dispatch path actually ships) and warms at most once."""
+    (the pad the dispatch path actually ships) and warms at most once.
+
+    ``fused_indexes``: indexes whose single-dispatch fused program tiers
+    (index/compiled.py) should compile + run once now instead of on the
+    first cold query. The fused packed-constant vector is a pow2 1-D int32
+    — a shape this function already warms — so program warming here is
+    about the XLA compile, not a new transfer shape."""
     global _TRANSFER_SHAPES_WARMED
     import jax
     puts = []
@@ -461,6 +491,12 @@ def warm_transfer_shapes(batch_sizes=()) -> None:
         puts.append(jax.device_put(np.zeros((b,), np.int32)))        # params
     if puts:
         jax.block_until_ready(puts)
+    for idx in fused_indexes:
+        try:
+            from geomesa_tpu.index import compiled as _fused
+            _fused.warm_programs(idx)
+        except Exception:
+            pass   # warming is best-effort; the query path compiles lazily
 
 
 import weakref
@@ -924,6 +960,7 @@ class ScanKernels:
                        0 if windows is None else windows.shape[0])
         cols = self.cols
         b, w = _dev(boxes), _dev(windows)
+        ROUNDS.uploads += len(residual[1]) if residual else 0
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
         return lambda: fn(cols, b, w, rp)
 
@@ -965,6 +1002,7 @@ class ScanKernels:
                        (b.shape[0], block_size, 0))
         cols = self.cols
         bx, w = _dev(boxes), _dev(windows)
+        ROUNDS.uploads += 1 + (len(residual[1]) if residual else 0)
         rp = [jnp.asarray(p) for p in residual[1]] if residual else []
         db = jnp.asarray(b)
         return lambda: fn(cols, bx, w, rp, db)
@@ -1150,7 +1188,10 @@ class ScanKernels:
 
 
 def _dev(a):
-    return None if a is None else jnp.asarray(a)
+    if a is None:
+        return None
+    ROUNDS.uploads += 1
+    return jnp.asarray(a)
 
 
 def _pad_positions(positions: np.ndarray):

@@ -96,6 +96,21 @@ _OVERRIDES = {
     "cfg13_dryrun_ok": "exact",
     "cfg13_skew_max_over_mean": "higher",
     "cfg13_control_max_over_mean": "lower",
+    # single-dispatch cold queries (cfg14): one round per fused cold
+    # query, zero recompiles across distinct same-shape values, and
+    # fused==staged counts are the contract the fused path exists on —
+    # any drift is a correctness bug, never noise. Latencies and the
+    # speedup ride the statistical gate via their suffixes; the floor
+    # multiple pins how far the fused path sits above the raw dispatch
+    # RTT (erosion there is overhead creeping back into the hot path).
+    "cfg14_fused_dispatches_per_cold_query": "exact",
+    "cfg14_fused_recompiles": "exact",
+    "cfg14_fused_parity_mismatches": "exact",
+    "cfg14_fused_floor_multiple": "lower",
+    # how many rounds the staged path pays is workload description, not
+    # a perf axis of the code under gate
+    "cfg14_staged_dispatches_per_cold_query": "skip",
+    "cfg14_staged_floor_multiple": "skip",
 }
 
 

@@ -363,10 +363,17 @@ class ReplicaRouter:
         self._n_requests = 0
         self._n_failovers = 0
         self._n_promotions = 0
-        # cell affinity: bounded cql -> Morton cell memo + a short-TTL
-        # snapshot of the workload plane's hot cells (at_least floored)
+        # cell affinity: LRU-bounded cql -> Morton cell memo (a
+        # high-cardinality filter stream evicts instead of growing or
+        # clearing wholesale) + a short-TTL snapshot of the workload
+        # plane's hot cells (at_least floored)
         self._n_affinity = 0
-        self._cell_memo: Dict[str, Optional[str]] = {}
+        from geomesa_tpu.serve.scheduler import LruCache
+        self._cell_memo = LruCache(int(config.ROUTER_CELL_MEMO.get()),
+                                   "router.cell_memo")
+        from geomesa_tpu.metrics import REGISTRY
+        REGISTRY.set_gauge("router.cell_memo.size",
+                           lambda: len(self._cell_memo))
         self._hot_cells: Dict[str, int] = {}
         self._hot_at = 0.0
 
@@ -394,20 +401,20 @@ class ReplicaRouter:
         return None
 
     def _query_cell(self, cql: str) -> Optional[str]:
-        """The query's coarse Morton cell (memoized per cql string; the
-        memo is bounded and None results are cached too)."""
-        if cql in self._cell_memo:
-            return self._cell_memo[cql]
+        """The query's coarse Morton cell (LRU-memoized per cql string —
+        bounded by GEOMESA_TPU_ROUTER_CELL_MEMO, size exported as the
+        router.cell_memo.size gauge; None results are cached too)."""
+        from geomesa_tpu.serve.scheduler import _MISS
+        cached = self._cell_memo.get(cql)
+        if cached is not _MISS:
+            return cached
         from geomesa_tpu.filter.parser import parse_ecql
         from geomesa_tpu.serve.scheduler import _query_cell
         try:
             cell = _query_cell(parse_ecql(cql))
         except Exception:
             cell = None
-        with self._lock:
-            if len(self._cell_memo) > 1024:
-                self._cell_memo.clear()
-            self._cell_memo[cql] = cell
+        self._cell_memo.put(cql, cell)
         return cell
 
     def _cell_is_hot(self, cell: str) -> bool:

@@ -179,6 +179,9 @@ class LruCache:
         with self._lock:
             self._d.clear()
 
+    def __len__(self) -> int:
+        return len(self._d)
+
     def stats(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
@@ -364,7 +367,15 @@ class QueryScheduler:
         while b < self._flush_size:
             b <<= 1
             tiers.append(b)
-        warm_transfer_shapes(batch_sizes=tiers or [1])
+        # ... and the fused single-dispatch program tiers for every bound
+        # planner's indexes, so a cold single query through the scheduler
+        # doesn't pay the first-query XLA compile either (best-effort: the
+        # query path compiles lazily when warming can't reach the indexes)
+        fused_indexes = [
+            idx for p in getattr(binding, "_planners", {}).values()
+            for idx in getattr(p, "indexes", ())]
+        warm_transfer_shapes(batch_sizes=tiers or [1],
+                             fused_indexes=fused_indexes)
         self._collector = threading.Thread(
             target=self._worker_main, args=("collector", self._collect_loop),
             name="geomesa-sched-collect", daemon=True)

@@ -512,6 +512,7 @@ def cmd_debug(args):
         # transfer bytes, compiles, flops/bytes cost model per kernel id
         # + batch tier), headed by the process-wide recompile count and
         # live/peak device memory — the perf-regression postmortem dump
+        from geomesa_tpu.index import compiled as _fused
         from geomesa_tpu.index.device import memory_snapshot
         from geomesa_tpu.obs import attrib
         snap = REGISTRY.snapshot()
@@ -519,6 +520,7 @@ def cmd_debug(args):
             "recompiles": snap["counters"].get("kernels.recompiles", 0),
             "device_memory": memory_snapshot(),
             "kernels": attrib.snapshot(),
+            "fused_query": _fused.stats_snapshot(),
         }, indent=2, default=str))
     else:  # traces — filtered through the shared flight-recorder predicate
         from geomesa_tpu.obs.flight import matches

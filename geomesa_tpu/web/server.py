@@ -398,6 +398,7 @@ class GeoJsonApi:
                     "shard_rows": {
                         t: s.get("proc_rows", [None] * (c.process_id + 1))
                         [c.process_id] for t, s in c.tables.items()}})
+            from geomesa_tpu.index import compiled as _fused
             return 200, {"status": "ok",
                          "node": self._node_meta(),
                          "cluster": cluster,
@@ -405,6 +406,7 @@ class GeoJsonApi:
                          "types": len(self.store.get_type_names()),
                          "overload": overload,
                          "slo": slo,
+                         "fused_query": _fused.stats_snapshot(),
                          "replication": repl.stats() if repl is not None
                          else {"role": "standalone"},
                          "durability": {

@@ -558,6 +558,25 @@ def test_router_stamps_cells_from_cql():
         a.close()
 
 
+def test_router_cell_memo_lru_bounded_with_gauge():
+    config.ROUTER_CELL_MEMO.set(8)
+    a = _mk_store(n=2000, seed=1)
+    try:
+        router = ReplicaRouter([LocalEndpoint("a", a)])
+        for i in range(30):   # high-cardinality stream: evicts, never grows
+            router._query_cell(f"BBOX(geom,{i},0,{i + 1},1)")
+        assert len(router._cell_memo) <= 8
+        gauge = REGISTRY.snapshot()["gauges"]["router.cell_memo.size"]
+        assert 0 < gauge <= 8
+        # still a memo: the most recent entry answers from cache
+        h0 = router._cell_memo.hits
+        router._query_cell("BBOX(geom,29,0,30,1)")
+        assert router._cell_memo.hits == h0 + 1
+    finally:
+        config.ROUTER_CELL_MEMO.unset()
+        a.close()
+
+
 # -- surfaces -----------------------------------------------------------------
 
 
