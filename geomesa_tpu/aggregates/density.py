@@ -77,6 +77,23 @@ def prepare_density(planner, f, bbox, width: int = 256, height: int = 256,
     if plan.empty:
         return run_empty
 
+    from geomesa_tpu.index.api import UnionScanPlan
+    if isinstance(plan, UnionScanPlan) and weight_attr is None:
+        # OR-of-covers: when every branch is a device-exact scan on one
+        # index, the whole union renders in ONE fused dispatch (the branch
+        # masks OR in-program); otherwise per-branch select + host grid
+        from geomesa_tpu.index import compiled as _fused
+
+        def run_union():
+            with _trace.trace("density", type=planner.sft.name):
+                out = _fused.try_union_density(planner, plan, auths, bbox,
+                                               width, height)
+            if out is None:
+                return _host_density(planner, f, plan, bbox, width, height,
+                                     weight_attr, auths)
+            return DensityGrid(tuple(bbox), width, height, out[0])
+        return run_union
+
     idx = plan.index
     weight_on_device = weight_attr is None or (
         idx is not None and weight_attr in idx.device.columns

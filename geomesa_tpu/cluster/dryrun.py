@@ -61,6 +61,21 @@ DENSITY_QUERY = "BBOX(geom, -90, -45, 90, 45)"
 DENSITY_BBOX = (-90.0, -45.0, 90.0, 45.0)
 DENSITY_WH = (64, 32)
 
+# geometry-catalog battery: st_* function queries (banded kernels +
+# host refine per shard, psum-reduced — ClusterScan.count is device-only
+# and cannot host-refine Func residuals) and the point-in-polygon join
+FUNC_COUNT_QUERIES = [
+    "st_distance(geom, POINT(0 0)) < 25",
+    "st_contains(POLYGON((-30 -15, 30 -15, 30 15, -30 15, -30 -15)), geom)",
+    "st_intersects(geom, POLYGON((60 10, 120 10, 90 60, 60 10)))",
+]
+JOIN_POLYGONS = [
+    "POLYGON((-20 -20, 20 -20, 20 20, -20 20, -20 -20))",
+    "POLYGON((0 0, 40 0, 20 35, 0 0))",
+    "POLYGON((100 -30, 160 -30, 160 40, 130 5, 100 40, 100 -30))",
+]
+JOIN_MAX_PAIRS = 200
+
 
 # balance-drill corpus window: a 2-hour dtg span starting on an
 # epoch-week boundary keeps every row in ONE z3 time bin, so the
@@ -216,6 +231,20 @@ def run_battery(planner, scan, fids_sorted) -> dict:
         out.setdefault("select_ms", {})[q] = round(
             (time.perf_counter() - t0) * 1000.0, 3)
         out["selects"][q] = merged["fid"]
+
+    # geometry catalog: st_* function counts + the sharded spatial join
+    # (same code path on the oracle — inactive runtime collapses the
+    # psum/merge, so equality judges the distribution, not the kernels)
+    from geomesa_tpu.geom.join import func_counts, join_battery
+    rt = getattr(scan, "runtime", None)
+    t0 = time.perf_counter()
+    out["func_counts"] = func_counts(planner, FUNC_COUNT_QUERIES,
+                                     runtime=rt)
+    jb = join_battery(planner, JOIN_POLYGONS, runtime=rt,
+                      fids=fids_sorted, max_pairs=JOIN_MAX_PAIRS)
+    out["join"] = jb["stable"]
+    out["join_meta"] = jb["meta"]
+    out["geom_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
     return out
 
 
@@ -486,6 +515,11 @@ def _check(oracle: dict, ranks: List[Optional[dict]], n: int,
         r["battery"]["density_sha"] == oracle["density_sha"] for r in live)
     checks["selects_equal"] = all(
         r["battery"]["selects"] == oracle["selects"] for r in live)
+    checks["func_counts_equal"] = all(
+        r["battery"].get("func_counts") == oracle["func_counts"]
+        for r in live)
+    checks["join_equal"] = all(
+        r["battery"].get("join") == oracle["join"] for r in live)
     checks["shards_strict_subset"] = all(
         0 < r["local_rows"] < n for r in live) and \
         sum(r["local_rows"] for r in live) == n

@@ -864,6 +864,30 @@ ROUTER_CELL_MEMO = _register(
     "memoization.")
 
 
+# -- geometry function catalog (ISSUE 18) ------------------------------------
+
+GEOM_KERNELS = _register(
+    "GEOMESA_TPU_GEOM_KERNELS", True, _parse_bool,
+    "Evaluate st_* residual predicates through the vmapped device "
+    "kernels (geom/catalog.py: certainty-banded classify + f64 host "
+    "refine of the uncertain sliver — results stay exact). Off: every "
+    "Func residual evaluates on the pure-numpy host oracle.")
+
+GEOM_FUSE = _register(
+    "GEOMESA_TPU_GEOM_FUSE", True, _parse_bool,
+    "Allow eligible Func residuals (st_contains/st_intersects polygon "
+    "literals, st_distance < r point literals, on the index geometry of "
+    "a point sft) to lower INTO the single-dispatch fused program. Off: "
+    "Func queries stage (still kernel-evaluated when GEOM_KERNELS is "
+    "on).")
+
+GEOM_CHUNK = _register(
+    "GEOMESA_TPU_GEOM_CHUNK", 4_000_000, int,
+    "Element budget for the catalog kernels' pairwise tables "
+    "(feature-segment x literal-segment); predicate/distance batches "
+    "are chunked so B*S*L stays under it.")
+
+
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property
     (the CLI `config` listing / docs surface)."""

@@ -91,6 +91,11 @@ def _eval(f: ir.Filter, table: FeatureTable,
         wanted = set(f.fids)
         fids = table.fids if rows is None else table.fids_at(rows)
         return np.array([fid in wanted for fid in fids], dtype=bool)
+    if isinstance(f, (ir.Func, ir.FuncCmp)):
+        # host-oracle backend only: this evaluator IS the parity reference
+        # for the device catalog, so it must never route through it
+        from geomesa_tpu.geom.functions import eval_filter_node
+        return eval_filter_node(f, table, rows, kernels=False)
     raise NotImplementedError(f"Cannot evaluate {type(f).__name__}")
 
 

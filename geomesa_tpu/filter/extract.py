@@ -83,6 +83,11 @@ def extract_bboxes(f: ir.Filter, attr: Optional[str] = None) -> Extraction:
             x0, y0, x1, y1 = gn.literal_bbox(node.geometry)
             d = node.distance
             return _clamp_box((x0 - d, y0 - d, x1 + d, y1 + d)), False
+        if isinstance(node, (ir.Func, ir.FuncCmp)):
+            box = _func_box(node, attr)
+            if box is not None:
+                return _clamp_box(box), False   # always loose: host refines
+            return None, True
         if isinstance(node, ir.And):
             exact = True
             constrained = False
@@ -117,6 +122,32 @@ def extract_bboxes(f: ir.Filter, attr: Optional[str] = None) -> Extraction:
     if not boxes:
         return Extraction((), True)  # spatially unsatisfiable
     return Extraction(tuple(boxes), exact)
+
+
+def _func_box(node, attr: Optional[str]
+              ) -> Optional[Tuple[float, float, float, float]]:
+    """Sound spatial constraint of a geometry-function predicate on ``attr``:
+    st_contains/st_intersects of the raw attribute vs a constant literal
+    constrain to the literal's bbox; st_distance(attr, lit) < d expands it
+    by d. Everything else (nested exprs, attr-vs-attr) is unconstrained."""
+    args = node.args
+    attr_arg = lit = None
+    for a in args:
+        if isinstance(a, str):
+            attr_arg = a
+        elif isinstance(a, tuple):
+            lit = a
+    if attr_arg is None or lit is None or len(args) != 2:
+        return None
+    if attr is not None and attr_arg != attr:
+        return None
+    if isinstance(node, ir.Func):
+        return gn.literal_bbox(lit)
+    if node.name == "st_distance" and node.op in ("<", "<="):
+        d = max(float(node.value), 0.0)
+        x0, y0, x1, y1 = gn.literal_bbox(lit)
+        return (x0 - d, y0 - d, x1 + d, y1 + d)
+    return None
 
 
 def _is_rectangle(literal: tuple) -> bool:

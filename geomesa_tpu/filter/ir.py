@@ -109,6 +109,80 @@ class Dwithin(Filter):
     distance: float  # degrees
 
 
+# -- geometry function calls (≙ geomesa-spark-jts st_* UDFs) ----------------
+
+# canonical (lowercase) catalog names by kind
+FUNC_BOOLEAN = frozenset({"st_contains", "st_intersects"})
+FUNC_SCALAR = frozenset({"st_area", "st_length", "st_distance"})
+FUNC_GEOM = frozenset({"st_buffer", "st_centroid", "st_convexhull"})
+FUNC_NAMES = FUNC_BOOLEAN | FUNC_SCALAR | FUNC_GEOM
+
+
+@dataclass(frozen=True)
+class FuncExpr:
+    """A geometry-valued st_* expression (st_buffer/st_centroid/
+    st_convexHull) nested inside a predicate or projection — not itself a
+    filter. Each arg is an attribute name (str), a geometry literal
+    ``(type_code, nested lists)``, a float scalar, or a nested FuncExpr."""
+
+    name: str     # canonical lowercase
+    args: tuple
+
+
+@dataclass(frozen=True)
+class Func(Filter):
+    """Boolean st_* predicate call: st_contains(a, b) / st_intersects(a, b).
+    Args as in FuncExpr."""
+
+    name: str
+    args: tuple
+
+
+@dataclass(frozen=True)
+class FuncCmp(Filter):
+    """Scalar st_* call compared to a literal:
+    ``st_distance(geom, POINT(..)) < 5000``. op in {'=','<>','<','<=','>',
+    '>='}; args as in FuncExpr."""
+
+    op: str
+    name: str
+    args: tuple
+    value: float
+
+
+def _func_arg_attrs(args: tuple, out: set) -> None:
+    for a in args:
+        if isinstance(a, str):
+            out.add(a)
+        elif isinstance(a, FuncExpr):
+            _func_arg_attrs(a.args, out)
+
+
+def funcs_of(f: Filter) -> Tuple[str, ...]:
+    """Sorted distinct st_* function names referenced anywhere in the tree
+    (the workload plane's ``funcs`` flight dimension)."""
+    out: set = set()
+
+    def walk_args(args: tuple) -> None:
+        for a in args:
+            if isinstance(a, FuncExpr):
+                out.add(a.name)
+                walk_args(a.args)
+
+    def walk(f: Filter) -> None:
+        if isinstance(f, Not):
+            walk(f.child)
+        elif isinstance(f, (And, Or)):
+            for c in f.children:
+                walk(c)
+        elif isinstance(f, (Func, FuncCmp)):
+            out.add(f.name)
+            walk_args(f.args)
+
+    walk(f)
+    return tuple(sorted(out))
+
+
 # -- temporal ---------------------------------------------------------------
 
 @dataclass(frozen=True)
@@ -190,6 +264,10 @@ def attributes_of(f: Filter) -> Optional[set]:
             if sub is None:
                 return None
             out |= sub
+        return out
+    if isinstance(f, (Func, FuncCmp)):
+        out = set()
+        _func_arg_attrs(f.args, out)
         return out
     attr = getattr(f, "attr", None)
     return {attr} if attr is not None else None

@@ -10,6 +10,8 @@
                | BBOX '(' attr ',' num ',' num ',' num ',' num ')'
                | INTERSECTS|CONTAINS|WITHIN '(' attr ',' wkt ')'
                | DWITHIN '(' attr ',' wkt ',' num ',' units ')'
+               | ST_CONTAINS|ST_INTERSECTS '(' farg ',' farg ')'
+               | ST_AREA|ST_LENGTH|ST_DISTANCE '(' farg* ')' op num
                | attr DURING iso '/' iso
                | attr BETWEEN lit AND lit
                | attr IN '(' lit (',' lit)* ')'
@@ -18,6 +20,13 @@
                | attr ('='|'<>'|'<='|'>='|'<'|'>') lit
 
 Dates parse to int64 epoch millis; strings are single-quoted.
+
+Geometry function calls (≙ geomesa-spark-jts UDFs, case-insensitive):
+``farg`` is an attribute, a WKT literal, a number, or a nested geometry
+function (st_buffer/st_centroid/st_convexHull). Boolean calls
+(st_contains/st_intersects) stand alone as predicates; scalar calls
+(st_area/st_length/st_distance) must be compared to a number, e.g.
+``st_distance(geom, POINT(10 20)) < 0.5 AND st_contains(POLYGON(..), geom)``.
 """
 
 from __future__ import annotations
@@ -173,6 +182,59 @@ def _parse_literal(toks: _Tokens):
     raise ValueError(f"Expected literal, got {v!r}")
 
 
+def _parse_func_args(toks: _Tokens) -> tuple:
+    """Comma-separated function arguments inside (already-consumed) parens:
+    attribute names, WKT literals, numbers, or nested st_* calls."""
+    toks.expect("lparen")
+    args = []
+    while True:
+        tok = toks.peek()
+        if tok is None:
+            raise ValueError("Unterminated function call")
+        k, v = tok
+        if k == "word" and v.upper() in _GEOM_WORDS:
+            args.append(_parse_wkt_literal(toks))
+        elif k == "word" and v.lower() in ir.FUNC_NAMES:
+            name = v.lower()
+            if name not in ir.FUNC_GEOM:
+                raise ValueError(
+                    f"{v} does not return a geometry; only "
+                    "st_buffer/st_centroid/st_convexHull nest")
+            toks.next()
+            args.append(ir.FuncExpr(name, _parse_func_args(toks)))
+        elif k == "word":
+            args.append(toks.next()[1])   # attribute reference
+        elif k == "number":
+            args.append(float(toks.next()[1]))
+        else:
+            raise ValueError(f"Bad function argument {v!r}")
+        k2, _ = toks.next()
+        if k2 == "rparen":
+            return tuple(args)
+        if k2 != "comma":
+            raise ValueError(f"Expected ',' or ')' in function call, got {k2}")
+
+
+def _parse_func_predicate(toks: _Tokens) -> ir.Filter:
+    name = toks.expect("word").lower()
+    args = _parse_func_args(toks)
+    nxt = toks.peek()
+    if nxt is not None and nxt[0] == "op":
+        op = toks.next()[1]
+        val = _parse_literal(toks)
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            raise ValueError(f"{name} compares to a number, got {val!r}")
+        if name not in ir.FUNC_SCALAR:
+            raise ValueError(f"{name} is not numeric; only "
+                             "st_area/st_length/st_distance compare")
+        return ir.FuncCmp(op, name, args, float(val))
+    if name in ir.FUNC_BOOLEAN:
+        return ir.Func(name, args)
+    raise ValueError(
+        f"{name} is not a boolean predicate: compare it to a value "
+        "(e.g. st_distance(geom, POINT(0 0)) < 1)")
+
+
 def _parse_predicate(toks: _Tokens) -> ir.Filter:
     word = toks.peek_word()
     if word is None:
@@ -223,6 +285,9 @@ def _parse_predicate(toks: _Tokens) -> ir.Filter:
             toks.next()
         toks.expect("rparen")
         return ir.Dwithin(attr, geom, dist)
+
+    if word.lower() in ir.FUNC_NAMES:
+        return _parse_func_predicate(toks)
 
     if word == "IN":
         # bare IN(...) = feature-id filter

@@ -1,0 +1,7 @@
+"""Geometry function catalog (≙ geomesa-spark-jts).
+
+`oracle` — exact f64 numpy semantics for every st_* function.
+`catalog` — vmapped JAX device kernels + banded-predicate refine.
+`join` — mesh-sharded st_contains/st_intersects point-in-polygon joins.
+`functions` — the name → implementation registry the filter IR binds to.
+"""

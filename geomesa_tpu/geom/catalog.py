@@ -1,0 +1,629 @@
+"""Vmapped JAX kernels for the geometry function catalog.
+
+≙ geomesa-spark-jts: the st_* UDF surface, evaluated on-device over the
+columnar geometry table instead of per-row on executors. Features are packed
+into pow²-padded vertex/segment tables (`pack_features`) and every function
+is one jitted, vmapped program over the batch:
+
+  st_area / st_length / st_centroid  — one fused "unary" kernel
+  st_distance                        — banded min over segment pairs
+  st_contains / st_intersects        — certainty-banded (cin, cout) masks,
+                                       uncertain sliver refined by the f64
+                                       host oracle → booleans strictly exact
+  st_convexHull / st_buffer          — gift-wrap hull (buffer = hull of the
+                                       8-offset octagon sweep)
+
+Precision discipline (same as `index/scan.py`): device arithmetic is f32.
+Vertices are shifted per-feature to a grid-quantized local origin (multiples
+of 1/256 deg — exactly representable in f32, so the in-kernel literal shift
+adds no rounding beyond the literal's own f32 cast, ≤ `_IN_DELTA`). Boolean
+predicates use the `_pip_band`/`_segpair_band` certainty bands and are exact
+after refine; scalar kernels carry the documented forward-error bounds
+computed per-feature by `parity_report`.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.features import geometry as geo
+from geomesa_tpu.filter import geom_numpy as gn
+from geomesa_tpu.geom import oracle
+from geomesa_tpu.index.scan import ScanKernels, _pip_band, _segpair_band
+
+_EDGE_PAD_ROW = ScanKernels._EDGE_PAD
+
+# f32 eps and the |f64−f32| lon/lat coordinate bound — shared constants with
+# the scan-layer bands (values asserted against scan.py in tests)
+_EPS32 = 1.2e-7
+_DELTA = 2.5e-5
+
+# certain-miss distance band for predicates: true distance 0 can read at most
+# ~4·_DELTA on device, so anything beyond this is certainly disjoint
+_MISS_BAND = np.float32(1.5e-4)
+
+# per-op uncertain-sliver / host-refine counters (observability + tests)
+STATS: Dict[str, int] = {
+    "predicate_calls": 0, "predicate_rows": 0, "refined_rows": 0,
+    "unary_calls": 0, "distance_calls": 0, "hull_calls": 0,
+    "hull_host_fallbacks": 0,
+}
+_LOCK = threading.Lock()
+
+
+def _pow2(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+# -- feature packing ---------------------------------------------------------
+
+
+@dataclass
+class FeaturePack:
+    """Pow²-padded per-feature vertex/segment tables (see module doc)."""
+    n: int                  # real feature count (≤ B)
+    verts: jnp.ndarray      # (B, K, 2) f32, local-origin shifted
+    vmask: jnp.ndarray      # (B, K) bool
+    segs: jnp.ndarray       # (B, S, 4) f32, shifted, rings closed
+    smask: jnp.ndarray      # (B, S) bool
+    wsign: jnp.ndarray      # (B, S) f32 shoelace weights (0 off polygons)
+    mode: jnp.ndarray       # (B,) int32 centroid cascade (oracle rule)
+    poly: jnp.ndarray       # (B,) bool polygonal feature
+    ref: np.ndarray         # (B, 2) f64 local origins (f32-exact values)
+    ref32: jnp.ndarray      # (B, 2) f32
+
+
+def _quantize_ref(bb: np.ndarray) -> np.ndarray:
+    """(B, 2) grid-quantized bbox centers, exactly representable in f32."""
+    c = np.stack([(bb[:, 0] + bb[:, 2]) * 0.5, (bb[:, 1] + bb[:, 3]) * 0.5],
+                 axis=1)
+    return np.round(c * 256.0) / 256.0
+
+
+def pack_features(arr: geo.GeometryArray, rows: np.ndarray) -> FeaturePack:
+    rows = np.asarray(rows, dtype=np.int64)
+    n = len(rows)
+    B = _pow2(max(n, 1), 8)
+    bb = arr.bboxes()[rows].astype(np.float64) if n else np.zeros((0, 4))
+    ref = np.zeros((B, 2), dtype=np.float64)
+    if n:
+        ref[:n] = _quantize_ref(bb)
+    codes = arr.type_codes[rows] if n else np.zeros(0, dtype=np.int8)
+    poly = np.zeros(B, dtype=bool)
+    mode = np.zeros(B, dtype=np.int32)
+    if n and bool(np.all(codes == geo.POINT)):
+        # vectorized fast path: the dominant corpus shape (Z2/Z3 point sfts)
+        ci = arr.ring_offsets[arr.part_offsets[arr.geom_offsets[rows]]]
+        verts = np.zeros((B, 1, 2), dtype=np.float32)
+        verts[:n, 0] = (arr.coords[ci] - ref[:n]).astype(np.float32)
+        vmask = np.zeros((B, 1), dtype=bool)
+        vmask[:n, 0] = True
+        segs = np.tile(_EDGE_PAD_ROW, (B, 1, 1)).astype(np.float32)
+        smask = np.zeros((B, 1), dtype=bool)
+        wsign = np.zeros((B, 1), dtype=np.float32)
+    else:
+        vlists, slists, wlists = [], [], []
+        K = S = 1
+        for k in range(n):
+            i = int(rows[k])
+            pts = arr.feature_coords(i) - ref[k]
+            vlists.append(pts)
+            K = max(K, len(pts))
+            code = int(codes[k])
+            fsegs = gn.feature_segments(arr, i)
+            w = np.zeros(len(fsegs), dtype=np.float64)
+            if code in (geo.POLYGON, geo.MULTIPOLYGON):
+                poly[k] = True
+                # per-ring shoelace weight: +1 shells, −1 holes, ×
+                # orientation sign (== the oracle's w)
+                ws, off = [], 0
+                for ring, is_shell in oracle._feature_rings(arr, i):
+                    nseg = len(ring) - 1 if np.array_equal(
+                        ring[0], ring[-1]) else len(ring)
+                    sa = oracle._ring_signed_area(ring)
+                    sgn = (1.0 if is_shell else -1.0) \
+                        * (1.0 if sa >= 0 else -1.0)
+                    ws.append(np.full(nseg, sgn))
+                    off += nseg
+                if ws:
+                    w = np.concatenate(ws)
+            if len(fsegs):
+                slists.append(fsegs - np.concatenate([ref[k], ref[k]]))
+                wlists.append(w)
+                S = max(S, len(fsegs))
+            else:
+                slists.append(np.zeros((0, 4)))
+                wlists.append(w)
+            mode[k] = oracle.centroid_mode(arr, i)
+        K, S = _pow2(K), _pow2(S)
+        verts = np.zeros((B, K, 2), dtype=np.float32)
+        vmask = np.zeros((B, K), dtype=bool)
+        segs = np.tile(_EDGE_PAD_ROW, (B, S, 1)).astype(np.float32)
+        smask = np.zeros((B, S), dtype=bool)
+        wsign = np.zeros((B, S), dtype=np.float32)
+        for k in range(n):
+            v, s, w = vlists[k], slists[k], wlists[k]
+            verts[k, : len(v)] = v
+            vmask[k, : len(v)] = True
+            segs[k, : len(s)] = s
+            smask[k, : len(s)] = True
+            wsign[k, : len(w)] = w
+    return FeaturePack(
+        n=n, verts=jnp.asarray(verts), vmask=jnp.asarray(vmask),
+        segs=jnp.asarray(segs), smask=jnp.asarray(smask),
+        wsign=jnp.asarray(wsign), mode=jnp.asarray(mode),
+        poly=jnp.asarray(poly), ref=ref,
+        ref32=jnp.asarray(ref.astype(np.float32)))
+
+
+def pack_literal(literal: tuple) -> Tuple[jnp.ndarray, jnp.ndarray, bool]:
+    """((L, 4) padded f32 edges, (P, 2) f32 points, polygonal?) in the
+    global frame (kernels shift by each feature's ref)."""
+    lsegs = gn.literal_segments(literal)
+    L = _pow2(max(len(lsegs), 1))
+    ls = np.tile(_EDGE_PAD_ROW, (L, 1)).astype(np.float32)
+    ls[: len(lsegs)] = lsegs.astype(np.float32)
+    lc = gn.literal_coords(literal).astype(np.float32)
+    P = _pow2(max(len(lc), 1))
+    lp = np.full((P, 2), 3e9, dtype=np.float32)
+    lp[: len(lc)] = lc
+    return jnp.asarray(ls), jnp.asarray(lp), \
+        literal[0] in (geo.POLYGON, geo.MULTIPOLYGON)
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+def _pt_seg_d2(px, py, s):
+    """Squared point-to-segment distance, broadcasting."""
+    x1, y1, x2, y2 = s[..., 0], s[..., 1], s[..., 2], s[..., 3]
+    dx, dy = x2 - x1, y2 - y1
+    ll = dx * dx + dy * dy
+    t = jnp.clip(((px - x1) * dx + (py - y1) * dy)
+                 / jnp.where(ll == 0, 1, ll), 0.0, 1.0)
+    cx, cy = x1 + t * dx, y1 + t * dy
+    return (px - cx) ** 2 + (py - cy) ** 2
+
+
+def _pip_plain(px, py, e, evalid=None):
+    """Unbanded crossing-parity point-in-polygon (distance paths only)."""
+    x1, y1, x2, y2 = e[..., 0], e[..., 1], e[..., 2], e[..., 3]
+    cond = (y1 > py) != (y2 > py)
+    xs = x1 + (py - y1) * (x2 - x1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    cr = cond & (xs > px)
+    if evalid is not None:
+        cr = cr & evalid
+    return (jnp.sum(cr, axis=-1) % 2) == 1
+
+
+def _cross_plain(a, b):
+    """Any proper segment crossing between (..., S, 4) and (..., L, 4)."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+
+    def orient(ox, oy, px, py, qx, qy):
+        return (px - ox) * (qy - oy) - (py - oy) * (qx - ox)
+
+    d1 = orient(ax1, ay1, ax2, ay2, bx1, by1)
+    d2 = orient(ax1, ay1, ax2, ay2, bx2, by2)
+    d3 = orient(bx1, by1, bx2, by2, ax1, ay1)
+    d4 = orient(bx1, by1, bx2, by2, ax2, ay2)
+    return (d1 * d2 < 0) & (d3 * d4 < 0)
+
+
+def _unary_one(verts, vmask, segs, smask, wsign, mode):
+    """(area, length, cx, cy) of one packed feature (local frame)."""
+    x1, y1, x2, y2 = segs[:, 0], segs[:, 1], segs[:, 2], segs[:, 3]
+    sm = smask.astype(jnp.float32)
+    cross = (x1 * y2 - x2 * y1) * wsign
+    a2 = jnp.sum(cross)
+    area = jnp.maximum(a2 * 0.5, 0.0)
+    ln = jnp.hypot(x2 - x1, y2 - y1) * sm
+    length = jnp.sum(ln)
+    # areal moments
+    mx = jnp.sum((x1 + x2) * cross)
+    my = jnp.sum((y1 + y2) * cross)
+    safe_a2 = jnp.where(a2 == 0, 1.0, a2)
+    acx, acy = mx / (3.0 * safe_a2), my / (3.0 * safe_a2)
+    # lineal: length-weighted midpoints
+    tot = jnp.where(length == 0, 1.0, length)
+    lcx = jnp.sum(ln * (x1 + x2)) / (2.0 * tot)
+    lcy = jnp.sum(ln * (y1 + y2)) / (2.0 * tot)
+    # point: vertex mean
+    vm = vmask.astype(jnp.float32)
+    nv = jnp.maximum(jnp.sum(vm), 1.0)
+    pcx = jnp.sum(verts[:, 0] * vm) / nv
+    pcy = jnp.sum(verts[:, 1] * vm) / nv
+    cx = jnp.where(mode == 2, acx, jnp.where(mode == 1, lcx, pcx))
+    cy = jnp.where(mode == 2, acy, jnp.where(mode == 1, lcy, pcy))
+    return area, length, cx, cy
+
+
+_unary_batch = jax.jit(jax.vmap(_unary_one))
+
+
+def _dist_one(verts, vmask, segs, smask, poly, ref, lsegs, lpts, lit_poly):
+    lofs = jnp.concatenate([ref, ref])
+    le = lsegs - lofs
+    lp = lpts - ref
+    vx = jnp.where(vmask, verts[:, 0], 3e9)
+    vy = jnp.where(vmask, verts[:, 1], 3e9)
+    big = jnp.float32(9e18)
+    d2a = jnp.min(jnp.where(vmask[:, None],
+                            _pt_seg_d2(vx[:, None], vy[:, None], le[None]),
+                            big))
+    d2b = jnp.min(jnp.where(smask[None, :],
+                            _pt_seg_d2(lp[:, 0][:, None], lp[:, 1][:, None],
+                                       segs[None]), big))
+    d2c = jnp.min(jnp.where(vmask[:, None],
+                            (vx[:, None] - lp[None, :, 0]) ** 2
+                            + (vy[:, None] - lp[None, :, 1]) ** 2, big))
+    d2 = jnp.minimum(jnp.minimum(d2a, d2b), d2c)
+    zero = jnp.any(_cross_plain(jnp.where(smask[:, None], segs, 4e9),
+                                le))
+    if lit_poly:
+        zero |= jnp.any(_pip_plain(vx[:, None], vy[:, None], le[None])
+                        & vmask)
+    zero |= poly & jnp.any(
+        _pip_plain(lp[:, 0][:, None], lp[:, 1][:, None], segs[None],
+                   evalid=smask[None, :]))
+    return jnp.where(zero, 0.0, jnp.sqrt(d2))
+
+
+_dist_batch = jax.jit(jax.vmap(_dist_one, in_axes=(0, 0, 0, 0, 0, 0,
+                                                   None, None, None)),
+                      static_argnums=(8,))
+
+
+def _pred_one(verts, vmask, segs, smask, poly, ref, lsegs, lpts,
+              op, lit_poly, lit_ext):
+    """Banded (certainly-true, certainly-false) for one feature.
+
+    op: 0 = intersects, 1 = within (literal ⊇ feature),
+    2 = contains (feature ⊇ literal). Everything neither certain-true nor
+    certain-false goes to the f64 host oracle.
+    """
+    lofs = jnp.concatenate([ref, ref])
+    le = lsegs - lofs
+    lp = lpts - ref
+    vx = jnp.where(vmask, verts[:, 0], 3e9)
+    vy = jnp.where(vmask, verts[:, 1], 3e9)
+    # banded pip: feature verts vs literal edges (pad edges never cross)
+    vin, vout = _pip_band(vx[:, None], vy[:, None],
+                          le[None, :, 0], le[None, :, 1],
+                          le[None, :, 2], le[None, :, 3])
+    # banded pip: literal points vs feature edges
+    pin, pout = _pip_band(lp[:, 0][:, None], lp[:, 1][:, None],
+                          segs[None, :, 0], segs[None, :, 1],
+                          segs[None, :, 2], segs[None, :, 3],
+                          evalid=smask[None, :])
+    # banded segment pairs (S, L)
+    si, sm = _segpair_band(
+        segs[:, None, 0], segs[:, None, 1], segs[:, None, 2],
+        segs[:, None, 3], le[None, :, 0], le[None, :, 1],
+        le[None, :, 2], le[None, :, 3])
+    si = si & smask[:, None]
+    sm = sm | ~smask[:, None]
+    # certain-miss distance: true distance can't be 0 beyond the band
+    big = jnp.float32(9e18)
+    d2a = jnp.min(jnp.where(vmask[:, None],
+                            _pt_seg_d2(vx[:, None], vy[:, None], le[None]),
+                            big))
+    d2b = jnp.min(jnp.where(smask[None, :],
+                            _pt_seg_d2(lp[:, 0][:, None], lp[:, 1][:, None],
+                                       segs[None]), big))
+    d2c = jnp.min(jnp.where(vmask[:, None],
+                            (vx[:, None] - lp[None, :, 0]) ** 2
+                            + (vy[:, None] - lp[None, :, 1]) ** 2, big))
+    far = jnp.minimum(jnp.minimum(d2a, d2b), d2c) > _MISS_BAND * _MISS_BAND
+    has_v = jnp.any(vmask)
+    if op == 0:
+        cin = jnp.any(si)
+        if lit_poly:
+            cin |= jnp.any(vin & vmask)
+        cin |= poly & jnp.any(pin)
+        cout = far
+    elif op == 1:
+        cout = far
+        if lit_poly:
+            cin = has_v & jnp.all(vin | ~vmask) & jnp.all(sm)
+            cout |= jnp.any(vout & vmask)
+        else:
+            cin = jnp.bool_(False)
+    else:
+        cout = far | (poly & jnp.any(pout))
+        if lit_ext:
+            cout |= ~poly
+        cin = poly & jnp.all(pin) & jnp.all(sm)
+    return cin, cout
+
+
+_pred_batch = jax.jit(jax.vmap(_pred_one, in_axes=(0, 0, 0, 0, 0, 0,
+                                                   None, None, None,
+                                                   None, None)),
+                      static_argnums=(8, 9, 10))
+
+
+def _hull_one(verts, vmask):
+    """Gift-wrap convex hull of one padded vertex set.
+
+    Returns ((K, 2) hull verts CCW from the lexicographic min, count,
+    closed?) — `closed` False (wrap didn't return to start within K steps,
+    possible under f32 collinearity ties) → host fallback.
+    """
+    K = verts.shape[0]
+    big = jnp.float32(3e9)
+    vx = jnp.where(vmask, verts[:, 0], big)
+    vy = jnp.where(vmask, verts[:, 1], big)
+    minx = jnp.min(vx)
+    start = jnp.argmin(jnp.where(vx == minx, vy, big))
+
+    def pick_next(cur):
+        cx, cy = vx[cur], vy[cur]
+
+        def scan_r(r, q):
+            qx, qy = vx[q], vy[q]
+            rx, ry = vx[r], vy[r]
+            cr = (qx - cx) * (ry - cy) - (qy - cy) * (rx - cx)
+            d2q = (qx - cx) ** 2 + (qy - cy) ** 2
+            d2r = (rx - cx) ** 2 + (ry - cy) ** 2
+            valid = vmask[r] & (r != cur)
+            better = valid & ((cr < 0) | (q == cur)
+                              | ((cr == 0) & (d2r > d2q)))
+            return jnp.where(better, r, q)
+
+        return jax.lax.fori_loop(0, K, scan_r, cur)
+
+    def body(k, st):
+        cur, out, cnt, done = st
+        nxt = pick_next(cur)
+        # close on COORDS, not index, so duplicate start points still wrap
+        closing = ((vx[nxt] == vx[start]) & (vy[nxt] == vy[start])) \
+            | (nxt == cur)
+        write = ~done & ~closing
+        out = out.at[k].set(jnp.where(write,
+                                      jnp.stack([vx[nxt], vy[nxt]]),
+                                      out[k]))
+        cnt = jnp.where(write, cnt + 1, cnt)
+        return nxt, out, cnt, done | closing
+
+    steps = min(K, 160)   # hull sizes beyond this fall back to the host
+    out0 = jnp.zeros((K, 2), dtype=jnp.float32)
+    out0 = out0.at[0].set(jnp.stack([vx[start], vy[start]]))
+    cur, out, cnt, done = jax.lax.fori_loop(
+        1, steps + 1, body, (start, out0, jnp.int32(1), jnp.bool_(False)))
+    return out, cnt, done
+
+
+_hull_batch = jax.jit(jax.vmap(_hull_one))
+
+
+# -- batch entry points ------------------------------------------------------
+
+
+def _row_chunks(rows: np.ndarray, lit_items: int):
+    """Split a row batch so the (B, S, L) pair tables stay under the
+    GEOM_CHUNK element budget (S estimated at 64)."""
+    budget = max(int(config.GEOM_CHUNK.get()), 1024)
+    per = max(1, budget // max(1, 64 * lit_items))
+    for s in range(0, len(rows), per):
+        yield rows[s: s + per]
+
+
+def unary_values(arr: geo.GeometryArray, rows: np.ndarray) -> Dict[str, np.ndarray]:
+    """{'area', 'length', 'cx', 'cy'} f64 arrays via the fused unary kernel
+    (centroids un-shifted back into the global frame in f64)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    with _LOCK:
+        STATS["unary_calls"] += 1
+    if len(rows) == 0:
+        z = np.zeros(0, dtype=np.float64)
+        return {"area": z, "length": z.copy(), "cx": z.copy(),
+                "cy": z.copy()}
+    p = pack_features(arr, rows)
+    area, length, cx, cy = (np.asarray(a) for a in _unary_batch(
+        p.verts, p.vmask, p.segs, p.smask, p.wsign, p.mode))
+    n = p.n
+    return {
+        "area": area[:n].astype(np.float64),
+        "length": length[:n].astype(np.float64),
+        "cx": cx[:n].astype(np.float64) + p.ref[:n, 0],
+        "cy": cy[:n].astype(np.float64) + p.ref[:n, 1],
+    }
+
+
+def batch_distance(arr: geo.GeometryArray, rows: np.ndarray,
+                   literal: tuple) -> np.ndarray:
+    """(len(rows),) f64 kernel distances (documented tol: ≤ 1e-4 + 1e-5·d
+    vs the exact oracle — boundary-sliver rows read ≤ band instead of 0)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    with _LOCK:
+        STATS["distance_calls"] += 1
+    if len(rows) == 0:
+        return np.zeros(0, dtype=np.float64)
+    ls, lp, lit_poly = pack_literal(literal)
+    parts = []
+    for sub in _row_chunks(rows, ls.shape[0] + lp.shape[0]):
+        p = pack_features(arr, sub)
+        d = np.asarray(_dist_batch(p.verts, p.vmask, p.segs, p.smask,
+                                   p.poly, p.ref32, ls, lp, lit_poly))
+        parts.append(d[: p.n].astype(np.float64))
+    return np.concatenate(parts)
+
+
+_OP_CODE = {"intersects": 0, "within": 1, "contains": 2}
+
+
+def batch_predicate(arr: geo.GeometryArray, rows: np.ndarray, op: str,
+                    literal: tuple) -> np.ndarray:
+    """Exact boolean predicate batch: banded device kernel + f64 host-oracle
+    refine of the uncertain sliver.
+
+    op: 'intersects' (symmetric), 'within' (literal contains feature),
+    'contains' (feature contains literal). Boundary-inclusive throughout.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    if len(rows) == 0:
+        return np.zeros(0, dtype=bool)
+    code = _OP_CODE[op]
+    ls, lp, lit_poly = pack_literal(literal)
+    lit_ext = literal[0] not in (geo.POINT, geo.MULTIPOINT)
+    cins, couts = [], []
+    for sub in _row_chunks(rows, ls.shape[0] + lp.shape[0]):
+        p = pack_features(arr, sub)
+        ci, co = _pred_batch(p.verts, p.vmask, p.segs, p.smask, p.poly,
+                             p.ref32, ls, lp, code, lit_poly, lit_ext)
+        cins.append(np.asarray(ci)[: p.n])
+        couts.append(np.asarray(co)[: p.n])
+    cin = np.concatenate(cins)
+    cout = np.concatenate(couts)
+    out = cin.copy()
+    unc = ~cin & ~cout
+    nunc = int(np.count_nonzero(unc))
+    with _LOCK:
+        STATS["predicate_calls"] += 1
+        STATS["predicate_rows"] += len(rows)
+        STATS["refined_rows"] += nunc
+    if nunc:
+        sub = rows[unc]
+        if op == "intersects":
+            out[unc] = oracle.intersects(arr, sub, literal)
+        elif op == "within":
+            out[unc] = oracle.contains_literal(arr, sub, literal)
+        else:
+            out[unc] = oracle.feature_contains(arr, sub, literal)
+    return out
+
+
+def kernel_hulls(arr: geo.GeometryArray, rows: np.ndarray):
+    """[(H_i, 2) f64 hull vertex arrays] via the gift-wrap kernel, falling
+    back to the host oracle for unclosed wraps (f32 collinearity ties)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    with _LOCK:
+        STATS["hull_calls"] += 1
+    if len(rows) == 0:
+        return []
+    p = pack_features(arr, rows)
+    hv, cnt, ok = (np.asarray(a) for a in _hull_batch(p.verts, p.vmask))
+    out = []
+    for k in range(p.n):
+        if ok[k] and cnt[k] >= 1:
+            out.append(hv[k, : cnt[k]].astype(np.float64) + p.ref[k])
+        else:
+            with _LOCK:
+                STATS["hull_host_fallbacks"] += 1
+            out.append(oracle.convex_hull_of(arr, int(rows[k])))
+    return out
+
+
+def kernel_buffers(arr: geo.GeometryArray, rows: np.ndarray, d: float):
+    """[(H_i, 2) f64 octagonal-buffer hull vertex arrays] (same error bound
+    as the oracle's vertex-offset buffer, plus the f32 hull tolerance)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    if len(rows) == 0:
+        return []
+    p = pack_features(arr, rows)
+    offs = jnp.asarray(oracle.octagon_offsets(d).astype(np.float32))
+    K = p.verts.shape[1]
+    swept = (p.verts[:, :, None, :] + offs[None, None, :, :]).reshape(
+        p.verts.shape[0], K * 8, 2)
+    smask = jnp.repeat(p.vmask, 8, axis=1)
+    hv, cnt, ok = (np.asarray(a) for a in _hull_batch(swept, smask))
+    out = []
+    for k in range(p.n):
+        if ok[k] and cnt[k] >= 1:
+            out.append(hv[k, : cnt[k]].astype(np.float64) + p.ref[k])
+        else:
+            with _LOCK:
+                STATS["hull_host_fallbacks"] += 1
+            shape = oracle.buffer_shapes(arr, [int(rows[k])], d)[0]
+            out.append(np.asarray(gn.literal_coords(shape)))
+    return out
+
+
+def stats_snapshot() -> Dict[str, int]:
+    with _LOCK:
+        return dict(STATS)
+
+
+# -- parity ------------------------------------------------------------------
+
+
+def _hull_area(pts: np.ndarray) -> float:
+    if len(pts) < 3:
+        return 0.0
+    x, y = pts[:, 0], pts[:, 1]
+    return 0.5 * abs(float(np.sum(x * np.roll(y, -1) - np.roll(x, -1) * y)))
+
+
+def parity_report(arr: geo.GeometryArray, rows: np.ndarray,
+                  literal: tuple, d: float = 0.05) -> Dict[str, int]:
+    """Kernel-vs-oracle mismatch counts for every catalog function.
+
+    Booleans compare strictly; scalars compare against per-feature forward
+    error bounds computed in f64 from the kernel's own term magnitudes (the
+    documented bounds — see README). All axes pin 0.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    rep = {k: 0 for k in ("st_area", "st_length", "st_centroid",
+                          "st_distance", "st_contains", "st_within",
+                          "st_intersects", "st_convexhull", "st_buffer")}
+    if len(rows) == 0:
+        return rep
+    u = unary_values(arr, rows)
+    o_area = oracle.area(arr, rows)
+    o_len = oracle.length(arr, rows)
+    o_cx, o_cy = oracle.centroid(arr, rows)
+    bb = arr.bboxes()[rows].astype(np.float64)
+    ext = np.maximum(np.maximum(bb[:, 2] - bb[:, 0], bb[:, 3] - bb[:, 1]),
+                     1e-12)
+    mag = np.maximum(np.max(np.abs(bb), axis=1), 1.0)
+    # per-feature forward bounds: K f32 ops over terms ≤ ext² (area),
+    # ext (length) or ext³/area (centroid), plus the f32 input rounding of
+    # shifted coords (≤ ext·2^-24 each)
+    nseg = np.asarray([len(gn.feature_segments(arr, int(i))) + 1
+                       for i in rows], dtype=np.float64)
+    t_area = 64.0 * nseg * _EPS32 * ext * ext + 8.0 * nseg * _EPS32 * ext * mag
+    t_len = 64.0 * nseg * _EPS32 * ext + 8.0 * nseg * _EPS32 * mag
+    rep["st_area"] = int(np.sum(np.abs(u["area"] - o_area) > t_area))
+    rep["st_length"] = int(np.sum(np.abs(u["length"] - o_len) > t_len))
+    safe_a = np.maximum(o_area, oracle.AREAL_REL * ext * ext * 0.25)
+    t_cen = (256.0 * nseg * _EPS32 * ext * ext * ext) / safe_a \
+        + 64.0 * nseg * _EPS32 * ext + 1e-6
+    rep["st_centroid"] = int(np.sum(
+        np.maximum(np.abs(u["cx"] - o_cx), np.abs(u["cy"] - o_cy)) > t_cen))
+    kd = batch_distance(arr, rows, literal)
+    od = oracle.distance(arr, rows, literal)
+    rep["st_distance"] = int(np.sum(
+        np.abs(kd - od) > 2e-4 + 1e-5 * np.abs(od)))
+    for name, op, ofn in (
+            ("st_intersects", "intersects", oracle.intersects),
+            ("st_within", "within", oracle.contains_literal),
+            ("st_contains", "contains", oracle.feature_contains)):
+        rep[name] = int(np.sum(batch_predicate(arr, rows, op, literal)
+                               != ofn(arr, rows, literal)))
+    hulls = kernel_hulls(arr, rows)
+    for k, i in enumerate(rows):
+        oh = oracle.convex_hull_of(arr, int(i))
+        tol = 512.0 * _EPS32 * ext[k] * ext[k] + 1e-10
+        if abs(_hull_area(hulls[k]) - _hull_area(oh)) > tol:
+            rep["st_convexhull"] += 1
+    bufs = kernel_buffers(arr, rows, d)
+    oshapes = oracle.buffer_shapes(arr, rows, d)
+    for k in range(len(rows)):
+        oc = np.asarray(gn.literal_coords(oshapes[k]))
+        e = ext[k] + 2.0 * d * oracle.BUFFER_SEC
+        tol = 512.0 * _EPS32 * e * e + 1e-10
+        if abs(_hull_area(bufs[k]) - _hull_area(oc)) > tol:
+            rep["st_buffer"] += 1
+    return rep

@@ -33,11 +33,23 @@ Three layers:
    bind instructions, so a repeat *shape* skips ``planner.plan()`` and range
    decomposition entirely: extract boxes/windows, pack, dispatch.
 
+Union (OR-of-covers) plans lower too when every branch is a device-exact
+point_boxes scan on one index: the per-branch masks OR inside a single
+program (``_build_union``), so union selects and density grids are one
+dispatch with inherent dedup instead of per-branch scans + host unions.
+
+Geometry-catalog residuals (geom/catalog.py st_* calls) ride the refine
+modes: ``st_contains(POLYGON, geom)`` / ``st_intersects(geom, POLYGON)``
+lower to the certainty-band point-in-polygon classifier and
+``st_distance(geom, POINT) < r`` to a banded radial test (``_refine_spec``);
+the uncertain sliver re-evaluates on host in exact f64 either way.
+
 Fallback rules (always exact — the staged path is the oracle): attribute
--index plans, FID filters, union/OR plans, vocab-less string predicates,
-host residuals other than single-polygon INTERSECTS over point layers,
-tables under 4 gather blocks, and any structure-key drift between the
-lowered and interpreted residuals.
+-index plans, FID filters, union plans with host residuals or mixed
+indexes, vocab-less string predicates, host residuals other than the
+single-predicate refine shapes above over point layers, tables under 4
+gather blocks, and any structure-key drift between the lowered and
+interpreted residuals.
 
 Knobs: ``GEOMESA_TPU_FUSED_QUERY`` (master switch),
 ``GEOMESA_TPU_PALLAS_REFINE`` (Pallas point-in-polygon inner loop),
@@ -101,6 +113,13 @@ _GATE_SLACK = np.float32(1e-3)
 _SELECT_TIERS = (1 << 10, 1 << 13, 1 << 16, 1 << 19, 1 << 22)
 
 _UNC_CAP = 4096  # refine-mode uncertain-row capacity (host fallback past it)
+
+# radial-distance certainty band (degrees) for the "dist" refine kind: must
+# exceed the f32 error of hypot over the f32 coordinate planes — coordinate
+# rounding ≤ 2.5e-5 per axis plus a few ulp of arithmetic at |coord| ≤ 360
+# (< 5e-4 total) plus the radius literal's own f32 cast (≤ 2.2e-5). Rows
+# inside the band re-evaluate on host in exact f64.
+_DIST_BAND = np.float32(1e-3)
 
 
 def _pow2(x: int) -> int:
@@ -425,7 +444,7 @@ class _Program:
 def _jit_program(mode: str, slots: tuple, six: Dict[str, int], emit,
                  T: int, n: int, bsz: int, cap: int, sel_cap: int,
                  unc_cap: int, use_pallas: bool, has_bin: bool,
-                 width: int, height: int):
+                 width: int, height: int, refine: str = "pip"):
     """Build + jit one fused program. Everything here is structure; values
     arrive through the packed vector at dispatch time."""
     import jax
@@ -487,8 +506,14 @@ def _jit_program(mode: str, slots: tuple, six: Dict[str, int], emit,
             return mask_of(g, membership), rows.reshape(-1), g
 
         def refine_of(c, m):
-            edges = get(packed, six["edges"])
-            cin, cout = _pip_flags(c["xf"], c["yf"], edges, use_pallas)
+            if refine == "dist":
+                dz = get(packed, six["dist"])
+                d = jnp.sqrt((c["xf"] - dz[0]) ** 2 + (c["yf"] - dz[1]) ** 2)
+                cin = d <= dz[2] - _DIST_BAND
+                cout = d >= dz[2] + _DIST_BAND
+            else:
+                edges = get(packed, six["edges"])
+                cin, cout = _pip_flags(c["xf"], c["yf"], edges, use_pallas)
             return m & cin, m & ~cin & ~cout
 
         if mode == "count":
@@ -595,7 +620,8 @@ def _gate_of(boxes_geo, B: int) -> np.ndarray:
 
 def _build(index, sft, vocabs, mode: str, boxes: np.ndarray,
            gate: np.ndarray, windows: Optional[np.ndarray], dev_ir,
-           vis: Optional[np.ndarray], edges: Optional[np.ndarray],
+           vis: Optional[np.ndarray],
+           refine_spec: Optional[Tuple[str, np.ndarray]],
            grid, width: int, height: int, capacity: Optional[int],
            expected_key: Optional[str] = None) -> Optional[_Program]:
     """Assemble layout + values for one query and fetch (or compile) its
@@ -637,11 +663,12 @@ def _build(index, sft, vocabs, mode: str, boxes: np.ndarray,
         # structure drift between the lowered and interpreted residuals:
         # stay staged rather than risk a divergent program
         return None
-    ne = 0
-    if edges is not None:
-        ne = len(edges)
-        six["edges"] = layout.add((ne, 4), f32=True)
-        values.append(edges)
+    refine = ""
+    if refine_spec is not None:
+        refine, rdata = refine_spec
+        six["dist" if refine == "dist" else "edges"] = layout.add(
+            rdata.shape, f32=True)
+        values.append(rdata)
     if grid is not None:
         six["grid"] = layout.add((4,), f32=True)
         values.append(np.asarray(grid, dtype=np.float32))
@@ -651,19 +678,19 @@ def _build(index, sft, vocabs, mode: str, boxes: np.ndarray,
         nb * float(config.PRUNE_MAX_FRACTION.get()))))), _pow2(nb))
     sel_cap = min(_tier(capacity), _pow2(n)) \
         if mode in ("select", "select_refine") else 0
-    unc_cap = _UNC_CAP if ne else 0
-    use_pallas = bool(ne) and _pallas_available()
+    unc_cap = _UNC_CAP if refine else 0
+    use_pallas = refine == "pip" and _pallas_available()
     has_bin = T > 0 and "bin" in cols
 
     # value-free program key: geometry/time/residual VALUES ride in the
     # packed vector; only structure lands here, so N distinct bboxes of one
     # shape share one compile (the recompile-churn pin)
-    key = ("fq", mode, res_key, layout.signature(), n, bsz, cap, sel_cap,
-           unc_cap, use_pallas, has_bin, width, height)
+    key = ("fq", mode, res_key, refine, layout.signature(), n, bsz, cap,
+           sel_cap, unc_cap, use_pallas, has_bin, width, height)
     slots = tuple(layout.slots)
     fn = _PROGRAMS.get(key, lambda: _jit_program(
         mode, slots, dict(six), emit, T, n, bsz, cap, sel_cap, unc_cap,
-        use_pallas, has_bin, width, height))
+        use_pallas, has_bin, width, height, refine))
     summ = _block_summaries(index, bsz)
     return _Program(fn, cols, summ, layout.pack(values), mode, sel_cap,
                     unc_cap, n, res_key, key, layout)
@@ -672,24 +699,60 @@ def _build(index, sft, vocabs, mode: str, boxes: np.ndarray,
 # -- plan qualification -------------------------------------------------------
 
 
-def _refine_edges(plan) -> Optional[np.ndarray]:
-    """Padded f32 edge table when the host residual is exactly one
-    polygon-INTERSECTS on the plan's geometry (the point-layer refine shape
-    the fused program classifies with certainty bands)."""
+def _refine_spec(plan) -> Optional[Tuple[str, np.ndarray]]:
+    """(kind, f32 constants) when the host residual is a single predicate
+    the fused program can classify with certainty bands over a point layer:
+
+    - ``("pip", edges)`` — point-in-polygon against a padded edge table, for
+      ``Intersects`` with a POLYGON literal and for the equivalent catalog
+      calls ``st_contains(POLYGON, geom)`` / ``st_intersects(geom, POLYGON)``
+      (a point intersects/lies-within a polygon iff it is in the polygon);
+    - ``("dist", [cx, cy, r])`` — banded radial distance, for
+      ``st_distance(geom, POINT) < r`` (or ``<=``; rows within ``_DIST_BAND``
+      of the circle classify uncertain, so the strictness of the comparison
+      resolves in the exact host refine).
+
+    None → the staged path serves the plan.
+    """
     res = plan.residual_host
-    if not isinstance(res, ir.Intersects):
-        return None
-    if getattr(plan.index, "geom", None) != res.attr:
-        return None
+    geom_attr = getattr(plan.index, "geom", None)
     from geomesa_tpu.features import geometry as geo
-    if res.geometry[0] != geo.POLYGON:
+    lit = None
+    if isinstance(res, ir.Intersects):
+        if res.attr != geom_attr:
+            return None
+        lit = res.geometry
+    elif isinstance(res, ir.Func) and len(res.args) == 2:
+        a, b = res.args
+        if res.name == "st_contains":
+            if isinstance(a, tuple) and b == geom_attr:
+                lit = a
+        elif res.name == "st_intersects":
+            if isinstance(a, tuple) and b == geom_attr:
+                lit = a
+            elif isinstance(b, tuple) and a == geom_attr:
+                lit = b
+        if lit is None:
+            return None
+    elif isinstance(res, ir.FuncCmp) and res.name == "st_distance" \
+            and res.op in ("<", "<=") and len(res.args) == 2:
+        a, b = res.args
+        pt = a if isinstance(a, tuple) else b if isinstance(b, tuple) else None
+        attr_arg = b if isinstance(a, tuple) else a
+        if pt is None or attr_arg != geom_attr or pt[0] != geo.POINT:
+            return None
+        r = float(res.value)
+        if not r >= 0.0:
+            return None
+        return "dist", np.array([pt[1][0], pt[1][1], r], dtype=np.float32)
+    if lit is None or lit[0] != geo.POLYGON:
         return None
     from geomesa_tpu.filter.geom_numpy import literal_segments
-    edges = literal_segments(res.geometry).astype(np.float32)
+    edges = literal_segments(lit).astype(np.float32)
     ne = max(4, _pow2(len(edges)))
     ep = np.tile(ScanKernels._EDGE_PAD, (ne, 1))
     ep[: len(edges)] = edges
-    return ep
+    return "pip", ep
 
 
 def _from_plan(planner, plan, mode: str, capacity: Optional[int] = None,
@@ -714,10 +777,10 @@ def _from_plan(planner, plan, mode: str, capacity: Optional[int] = None,
     boxes_geo = plan.explain.get("boxes")
     if not boxes_geo or len(boxes_geo) > len(plan.boxes_loose):
         return None
-    edges = None
+    refine_spec = None
     if mode in ("count_refine", "select_refine"):
-        edges = _refine_edges(plan)
-        if edges is None:
+        refine_spec = _refine_spec(plan)
+        if refine_spec is None:
             return None
     elif plan.residual_host is not None:
         return None
@@ -728,8 +791,9 @@ def _from_plan(planner, plan, mode: str, capacity: Optional[int] = None,
         vis = np.asarray(plan.residual_device[1][-1], dtype=np.int32)
     gate = _gate_of(boxes_geo, len(plan.boxes_loose))
     prog = _build(plan.index, planner.sft, plan.index.vocabs, mode,
-                  plan.boxes_loose, gate, plan.windows, dev_ir, vis, edges,
-                  grid, width, height, capacity, expected_key=pkey)
+                  plan.boxes_loose, gate, plan.windows, dev_ir, vis,
+                  refine_spec, grid, width, height, capacity,
+                  expected_key=pkey)
     try:
         if cache is None:
             cache = {}
@@ -799,6 +863,8 @@ def try_count_refine(planner, plan) -> Optional[int]:
     the shape doesn't qualify or uncertainty overflowed."""
     prog = _from_plan(planner, plan, "count_refine")
     if prog is None:
+        if config.FUSED_QUERY.get():
+            STATS["fallbacks"] += 1
         return None
     _rdl.check_current("fused_dispatch")
     STATS["queries"] += 1
@@ -830,6 +896,8 @@ def try_select_refine(planner, plan, capacity: Optional[int]) \
     while True:
         prog = _from_plan(planner, plan, "select_refine", capacity=cap)
         if prog is None:
+            if config.FUSED_QUERY.get():
+                STATS["fallbacks"] += 1
             return None
         _rdl.check_current("fused_dispatch")
         STATS["queries"] += 1
@@ -876,6 +944,255 @@ def try_density(planner, plan, grid_bbox, width: int, height: int):
     return np.asarray(grid), int(cnt)
 
 
+# -- union (Or-of-covers) lowering --------------------------------------------
+
+
+def _jit_union_program(mode: str, slots: tuple, branches: tuple,
+                       six_g: Dict[str, int], n: int, bsz: int, cap: int,
+                       sel_cap: int, has_bin: bool, width: int, height: int):
+    """One device program for an OR-of-covers plan: per-branch primary/time/
+    residual/vis masks OR *inside* the program (dedup is inherent — the OR is
+    one mask), so a union select or density render is ONE dispatch instead of
+    per-branch scans + host row-set union. ``branches`` is a tuple of
+    (slot-index dict, residual emit | None, window count) from
+    ``_build_union``; the block gate keeps a block alive when ANY branch's
+    envelope set touches it."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    get = _make_get(slots)
+    total = cap * bsz
+
+    def run(cols, summ, packed):
+        alive = jnp.zeros(summ["bxmin"].shape[0], dtype=bool)
+        for six, _, T in branches:
+            gate = get(packed, six["gate"])
+            a = jnp.any(
+                (summ["bxmax"][:, None] >= gate[None, :, 0])
+                & (summ["bxmin"][:, None] <= gate[None, :, 2])
+                & (summ["bymax"][:, None] >= gate[None, :, 1])
+                & (summ["bymin"][:, None] <= gate[None, :, 3]), axis=1)
+            if T and has_bin:
+                windows = get(packed, six["windows"])
+                blo, bhi = windows[:, 0], windows[:, 2]
+                a = a & jnp.any(
+                    (blo <= bhi)[None, :]
+                    & (summ["binmin"][:, None] <= bhi[None, :])
+                    & (summ["binmax"][:, None] >= blo[None, :]), axis=1)
+            alive = alive | a
+        n_alive = jnp.sum(alive)
+
+        def mask_of(c, membership=None):
+            m = None
+            for six, emit, T in branches:
+                bm = PRIMARY_FNS["point_boxes"](c, get(packed, six["boxes"]))
+                if T:
+                    bm = bm & _time_mask(c, get(packed, six["windows"]))
+                if emit is not None:
+                    bm = bm & emit(c, packed, get)
+                if "vis" in six:
+                    codes = get(packed, six["vis"])
+                    bm = bm & jnp.any(
+                        c["__vis__"][:, None] == codes[None, :], axis=1)
+                m = bm if m is None else (m | bm)
+            if "__valid__" in c:
+                m = m & c["__valid__"]
+            if membership is not None:
+                m = m & membership
+            return m
+
+        def gathered():
+            bids = jnp.nonzero(
+                alive, size=cap, fill_value=-1)[0].astype(jnp.int32)
+            bids = jnp.where(bids < nb_blocks, bids, -1)
+            starts = bids * bsz
+            astart = jnp.clip(starts, 0, max(0, n - bsz))
+            rows = astart[:, None] + jnp.arange(bsz, dtype=jnp.int32)[None, :]
+            membership = ((bids >= 0)[:, None]
+                          & (rows >= starts[:, None])
+                          & (rows < starts[:, None] + bsz)).reshape(-1)
+            g = _LazyBlockGather(cols, astart, bsz, total)
+            return mask_of(g, membership), rows.reshape(-1), g
+
+        if mode == "select":
+            def pruned(_):
+                m, rowids, _ = gathered()
+                sel = jnp.nonzero(m, size=sel_cap, fill_value=total)[0]
+                rows = jnp.where(
+                    sel < total, rowids[jnp.clip(sel, 0, total - 1)], n)
+                return jnp.concatenate([
+                    jnp.sum(m)[None].astype(jnp.int32),
+                    rows.astype(jnp.int32)])
+
+            def full(_):
+                m = mask_of(cols)
+                sel = jnp.nonzero(m, size=sel_cap, fill_value=n)[0]
+                return jnp.concatenate([
+                    jnp.sum(m)[None].astype(jnp.int32),
+                    sel.astype(jnp.int32)])
+
+            return lax.cond(n_alive <= cap, pruned, full, 0)
+
+        if mode == "density":
+            grid = get(packed, six_g["grid"])
+
+            def pruned(_):
+                m, _, g = gathered()
+                return (_grid_scatter(g["xf"], g["yf"], m, None, grid,
+                                      width, height),
+                        jnp.sum(m).astype(jnp.int32))
+
+            def full(_):
+                m = mask_of(cols)
+                return (_grid_scatter(cols["xf"], cols["yf"], m, None, grid,
+                                      width, height),
+                        jnp.sum(m).astype(jnp.int32))
+
+            return lax.cond(n_alive <= cap, pruned, full, 0)
+
+        raise ValueError(mode)
+
+    nb_blocks = -(-n // bsz)
+    STATS["programs_built"] += 1
+    jitted = jax.jit(run)
+    if _attrib.enabled():
+        jitted = _attrib.compile_probe(jitted, f"fused_union_{mode}", cap)
+    return jitted
+
+
+def _build_union(planner, plan, mode: str, auths,
+                 capacity: Optional[int] = None, grid=None, width: int = 0,
+                 height: int = 0) -> Optional[_Program]:
+    """Qualify an OR-of-covers (UnionScanPlan) for single-dispatch execution:
+    every branch must be a device-exact point_boxes scan on ONE shared index
+    (the same precondition as the fused OR-of-masks count). Auths fold
+    per-branch exactly as the staged union path does — vis code sets ride the
+    packed vector. Any decline returns None and the per-branch staged path
+    serves the query."""
+    if not config.FUSED_QUERY.get():
+        return None
+    idx = plan.same_index_device_exact()
+    if idx is None:
+        return None
+    cols = idx.device.columns
+    if "xf" not in cols or "yf" not in cols:
+        return None
+    n = int(cols["xf"].shape[0])
+    bsz = int(_prune.BLOCK_SIZE)
+    if n < 4 * bsz:
+        return None
+    layout = _Layout()
+    values: list = []
+    branches: list = []
+    res_keys: list = []
+    for _, bp in plan.branches:
+        bp = planner._apply_auths(bp, auths)
+        if bp.empty:
+            continue  # auths folded this branch to nothing
+        if bp.primary_kind != "point_boxes" \
+                or bp.candidate_slices is not None \
+                or bp.boxes_loose is None or bp.residual_host is not None:
+            return None
+        boxes_geo = bp.explain.get("boxes")
+        if not boxes_geo or len(boxes_geo) > len(bp.boxes_loose):
+            return None
+        T = 0 if bp.windows is None else len(bp.windows)
+        if T and ("bin" not in cols or "off" not in cols):
+            return None
+        six: Dict[str, int] = {}
+        six["boxes"] = layout.add(bp.boxes_loose.shape)
+        values.append(bp.boxes_loose)
+        gate = _gate_of(boxes_geo, len(bp.boxes_loose))
+        six["gate"] = layout.add(gate.shape, f32=True)
+        values.append(gate)
+        if T:
+            six["windows"] = layout.add(bp.windows.shape)
+            values.append(bp.windows)
+        dev_ir = bp.explain.get("residual_device")
+        try:
+            res_key, emit = _lower_residual(dev_ir, planner.sft, idx.vocabs,
+                                            set(cols), layout, values)
+        except Unsupported:
+            return None
+        pkey = bp.residual_device[0] if bp.residual_device else "none"
+        if bp.explain.get("__vis_applied__") and pkey.startswith("vis"):
+            vis = np.asarray(bp.residual_device[1][-1], dtype=np.int32)
+            six["vis"] = layout.add((len(vis),))
+            values.append(vis)
+            res_key = f"vis{len(vis)}&({res_key})"
+        if res_key != pkey:
+            return None   # lowered/interpreted drift: stay staged
+        branches.append((six, emit, T))
+        res_keys.append(f"{res_key}|b{len(bp.boxes_loose)}w{T}"
+                        + ("v" if "vis" in six else ""))
+    if not branches:
+        return None
+    six_g: Dict[str, int] = {}
+    if grid is not None:
+        six_g["grid"] = layout.add((4,), f32=True)
+        values.append(np.asarray(grid, dtype=np.float32))
+    nb = -(-n // bsz)
+    cap = min(_pow2(max(4, int(np.ceil(
+        nb * float(config.PRUNE_MAX_FRACTION.get()))))), _pow2(nb))
+    sel_cap = min(_tier(capacity), _pow2(n)) if mode == "select" else 0
+    has_bin = "bin" in cols
+
+    key = ("fqu", mode, tuple(res_keys), layout.signature(), n, bsz, cap,
+           sel_cap, has_bin, width, height)
+    slots = tuple(layout.slots)
+    bspec = tuple(branches)
+    fn = _PROGRAMS.get(key, lambda: _jit_union_program(
+        mode, slots, bspec, dict(six_g), n, bsz, cap, sel_cap, has_bin,
+        width, height))
+    summ = _block_summaries(idx, bsz)
+    return _Program(fn, cols, summ, layout.pack(values), mode, sel_cap,
+                    0, n, "|".join(res_keys), key, layout)
+
+
+def try_union_select(planner, plan, auths,
+                     capacity: Optional[int] = None) -> Optional[np.ndarray]:
+    """One-dispatch select for an OR-of-covers plan → FINAL sorted table
+    rows (branch overlaps dedup in the in-program OR), or None (per-branch
+    scans + host union serve instead)."""
+    cap = capacity
+    while True:
+        prog = _build_union(planner, plan, "select", auths, capacity=cap)
+        if prog is None:
+            if config.FUSED_QUERY.get():
+                STATS["fallbacks"] += 1
+            return None
+        _rdl.check_current("fused_dispatch")
+        STATS["queries"] += 1
+        REGISTRY.inc("fused.queries")
+        with _attrib.kernel("fused_union_select", prog.sel_cap):
+            out = np.asarray(_fetch(prog.dispatch))
+        cnt = int(out[0])
+        if cnt <= prog.sel_cap:
+            pos = out[1: 1 + cnt].astype(np.int64)
+            idx = plan.same_index_device_exact()
+            return np.sort(idx.map_rows(pos))
+        STATS["overflow_retries"] += 1
+        cap = _pow2(cnt)
+
+
+def try_union_density(planner, plan, auths, grid_bbox, width: int,
+                      height: int):
+    """One-dispatch union heat-map: ((H, W) f32 grid, count) or None."""
+    prog = _build_union(planner, plan, "density", auths, grid=grid_bbox,
+                        width=width, height=height)
+    if prog is None:
+        if config.FUSED_QUERY.get():
+            STATS["fallbacks"] += 1
+        return None
+    _rdl.check_current("fused_dispatch")
+    STATS["queries"] += 1
+    REGISTRY.inc("fused.queries")
+    with _attrib.kernel("fused_union_density"):
+        grid, cnt = _fetch(prog.dispatch)
+    return np.asarray(grid), int(cnt)
+
+
 # -- shape-keyed recipe fast path (skip planning entirely) --------------------
 
 
@@ -903,7 +1220,29 @@ def _shape_key(f: ir.Filter) -> str:
         return f"cmp{f.op}:{f.attr}"
     if isinstance(f, ir.In):
         return f"in{_pow2(len(f.values))}:{f.attr}"
+    if isinstance(f, ir.Func):
+        return f"fn:{f.name}({_func_args_sig(f.args)})"
+    if isinstance(f, ir.FuncCmp):
+        return f"fc{f.op}:{f.name}({_func_args_sig(f.args)})"
     raise Unsupported(type(f).__name__)
+
+
+def _func_args_sig(args: tuple) -> str:
+    """Value-free signature of st_* call arguments: attributes by name,
+    geometry literals by type code, scalars as 'f' — two calls with this
+    signature in common differ only in literal VALUES, the same normalization
+    the rest of the shape key uses."""
+    parts = []
+    for a in args:
+        if isinstance(a, str):
+            parts.append(f"a:{a}")
+        elif isinstance(a, tuple):
+            parts.append(f"l{a[0]}")
+        elif isinstance(a, ir.FuncExpr):
+            parts.append(f"{a.name}({_func_args_sig(a.args)})")
+        else:
+            parts.append("f")
+    return ",".join(parts)
 
 
 def _auths_key(auths) -> Optional[tuple]:

@@ -519,7 +519,13 @@ class QueryPlanner:
 
     def _union_select(self, plan: UnionScanPlan, auths) -> np.ndarray:
         """Union of per-branch row sets (sorted unique — OR-branch overlaps
-        dedup here, ≙ the reference's de-duplication across strategies)."""
+        dedup here, ≙ the reference's de-duplication across strategies).
+        When every branch is a device-exact scan on one index the whole
+        union lowers to a single fused dispatch (the OR dedups in-program)."""
+        from geomesa_tpu.index import compiled as _fused
+        rows = _fused.try_union_select(self, plan, auths)
+        if rows is not None:
+            return rows
         sets = [self.select_indices(c, plan=bp, auths=auths)
                 for c, bp in plan.branches]
         if not sets:
@@ -580,8 +586,33 @@ class QueryPlanner:
             return rows
         _rdl.check_current("refine")
         with _trace.span("refine", kind="refine", rows=len(rows)):
-            mask = _evaluate_at(plan.residual_host, self.table, rows)
+            mask = self._refine_mask(plan.residual_host, rows)
             return rows[mask]
+
+    def _refine_mask(self, res: ir.Filter, rows: np.ndarray) -> np.ndarray:
+        """Residual mask over candidate rows. st_* catalog calls in an AND
+        residual route through the device kernels when enabled
+        (GEOMESA_TPU_GEOM_KERNELS): the banded classify + exact-f64 refine of
+        the uncertain sliver produces the SAME mask as the host oracle, so
+        the staged path stays exact while the bulk of the predicate runs
+        vmapped on device."""
+        from geomesa_tpu import config as _cfg
+        parts = res.children if isinstance(res, ir.And) else (res,)
+        if _cfg.GEOM_KERNELS.get() \
+                and any(isinstance(p, (ir.Func, ir.FuncCmp)) for p in parts):
+            from geomesa_tpu.geom.functions import eval_filter_node
+            mask = np.ones(len(rows), dtype=bool)
+            rest = []
+            for p in parts:
+                if isinstance(p, (ir.Func, ir.FuncCmp)):
+                    mask &= eval_filter_node(p, self.table, rows,
+                                             kernels=True)
+                else:
+                    rest.append(p)
+            if rest:
+                mask &= _evaluate_at(ir.and_filters(rest), self.table, rows)
+            return mask
+        return _evaluate_at(res, self.table, rows)
 
 
 class PreparedQuery:

@@ -310,6 +310,7 @@ def event_from_request(req, fut) -> dict:
         "priority": req.priority,
         "tenant": req.tenant,
         "cell": req.cell,
+        "funcs": list(getattr(req, "funcs", ()) or ()) or None,
         "deadline_budget_ms": req.budget_ms,
         "deadline_slack_ms": None if req.deadline is None
         else round(req.deadline.remaining_ms(), 3),

@@ -323,6 +323,7 @@ class WorkloadAnalytics:
         self.plans = _sk.SpaceSaving(cap)
         self.tenants = _sk.SpaceSaving(cap)
         self.cells = _sk.SpaceSaving(cap)
+        self.funcs = _sk.SpaceSaving(cap)
         self.consumed = 0
         self.dropped = 0
 
@@ -388,6 +389,11 @@ class WorkloadAnalytics:
         cell = ev.get("cell")
         if cell:
             self.cells.offer(str(cell))
+        # each distinct st_* name counts ONCE per query (funcs_of dedups
+        # repeated occurrences at IR level), so sketch totals are
+        # queries-touching-the-function, never call-site counts
+        for fn in (ev.get("funcs") or ()):
+            self.funcs.offer(str(fn))
         if self._meter:
             label = tenant_metric_label(tenant)
             _metrics.inc(f"tenant.{label}.queries")
@@ -429,6 +435,7 @@ class WorkloadAnalytics:
         return {"total": self.plans.n_total,
                 "plans": entries(self.plans),
                 "cells": entries(self.cells, with_bbox=True),
+                "funcs": entries(self.funcs),
                 "sketch_capacity": self.plans.capacity}
 
     def top_tenants(self, k: int = 10) -> List[dict]:
@@ -473,6 +480,7 @@ class WorkloadAnalytics:
             self.plans = _sk.SpaceSaving(cap)
             self.tenants = _sk.SpaceSaving(cap)
             self.cells = _sk.SpaceSaving(cap)
+            self.funcs = _sk.SpaceSaving(cap)
             self.consumed = 0
             self.dropped = 0
 
@@ -490,6 +498,7 @@ class WorkloadAnalytics:
                 "plans": self.plans.to_state(),
                 "tenants": self.tenants.to_state(),
                 "cells": self.cells.to_state(),
+                "funcs": self.funcs.to_state(),
                 "consumed": self.consumed,
                 "dropped": self.dropped,
             }
@@ -512,6 +521,7 @@ class WorkloadAnalytics:
         w.plans = _sk.SpaceSaving.from_state(state.get("plans") or {})
         w.tenants = _sk.SpaceSaving.from_state(state.get("tenants") or {})
         w.cells = _sk.SpaceSaving.from_state(state.get("cells") or {})
+        w.funcs = _sk.SpaceSaving.from_state(state.get("funcs") or {})
         w.consumed = int(state.get("consumed", 0))
         w.dropped = int(state.get("dropped", 0))
         return w
@@ -522,7 +532,7 @@ def merge_states(states: List[dict]) -> dict:
     merges histograms: windows with equal (span, start) merge by bucket/
     count summation; sketches merge per obs/sketches.py (commutative)."""
     spans: Dict[str, Dict[float, _Window]] = {}
-    plan_sk, ten_sk, cell_sk = [], [], []
+    plan_sk, ten_sk, cell_sk, func_sk = [], [], [], []
     consumed = dropped = 0
     for st in states:
         if not st:
@@ -532,6 +542,7 @@ def merge_states(states: List[dict]) -> dict:
         plan_sk.append(_sk.SpaceSaving.from_state(st.get("plans") or {}))
         ten_sk.append(_sk.SpaceSaving.from_state(st.get("tenants") or {}))
         cell_sk.append(_sk.SpaceSaving.from_state(st.get("cells") or {}))
+        func_sk.append(_sk.SpaceSaving.from_state(st.get("funcs") or {}))
         for s_str, windows in (st.get("spans") or {}).items():
             tier = spans.setdefault(s_str, {})
             for wst in windows:
@@ -555,6 +566,8 @@ def merge_states(states: List[dict]) -> dict:
         if ten_sk else {},
         "cells": _sk.SpaceSaving.merge_all(cell_sk).to_state()
         if cell_sk else {},
+        "funcs": _sk.SpaceSaving.merge_all(func_sk).to_state()
+        if func_sk else {},
         "consumed": consumed,
         "dropped": dropped,
     }

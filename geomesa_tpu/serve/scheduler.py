@@ -244,7 +244,7 @@ class Request:
                  "plan_cache_hit", "cover_cache_hit", "batch_id",
                  "rows_scanned", "shed", "breaker_open", "retries",
                  # workload-analytics dimensions (obs/workload.py)
-                 "tenant", "cell",
+                 "tenant", "cell", "funcs",
                  # hot-result cache (serve/cache.py): True = served from
                  # memory with no device round trip
                  "result_cache_hit")
@@ -288,6 +288,10 @@ class Request:
         self.retries = 0
         self.tenant = tenant
         self.cell: Optional[str] = None
+        # distinct st_* function names in the filter (workload ``funcs``
+        # dimension; () for function-free queries)
+        from geomesa_tpu.filter import ir as _ir
+        self.funcs = _ir.funcs_of(f_ir) if f_ir is not None else ()
         self.result_cache_hit: Optional[bool] = None
 
     def result(self, timeout: Optional[float] = None) -> int:

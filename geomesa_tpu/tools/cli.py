@@ -139,6 +139,29 @@ def cmd_export(args):
     if args.max is not None and len(table) > args.max:
         import numpy as np
         table = table.take(np.arange(args.max))
+    if getattr(args, "select", None):
+        # geometry-catalog projections: st_* terms through the vmapped
+        # kernels, geometry values as WKT — CSV or JSON columns
+        from geomesa_tpu.geom.functions import projection_columns
+        cols = projection_columns(table, None, args.select)
+        if args.format == "json":
+            out = json.dumps({"count": len(table), "columns": cols})
+        else:
+            import csv as _csv
+            import io as _io
+            buf = _io.StringIO()
+            w = _csv.writer(buf)
+            w.writerow(list(cols))
+            for row in zip(*cols.values()):
+                w.writerow(row)
+            out = buf.getvalue()
+        if args.output:
+            with open(args.output, "w") as fh:
+                fh.write(out)
+            print(f"Exported {len(table)} projected rows to {args.output}")
+        else:
+            sys.stdout.write(out)
+        return
     out = export(table, args.format, args.output)
     if args.output:
         print(f"Exported {len(table)} features to {args.output}")
@@ -815,6 +838,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="|".join(_EXPORT_FORMATS))
     sp.add_argument("-o", "--output")
     sp.add_argument("--max", type=int)
+    sp.add_argument("--select",
+                    help="projection list, e.g. "
+                         "'st_centroid(geom) AS c, val' (st_* terms "
+                         "evaluate through the geometry kernels; "
+                         "geometry values export as WKT; csv/json only)")
     sp.set_defaults(fn=cmd_export)
 
     sp = sub.add_parser("explain", help="show the query plan")

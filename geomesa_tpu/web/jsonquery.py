@@ -15,6 +15,18 @@ scala:30-60), the JSON query language of the GeoJSON REST API:
     { "$or" : [ q1, q2 ] }                    → q1 OR q2
     multiple keys in one object               → AND
 
+Geometry-catalog function operators (st_* kernels, geom/):
+
+    { "geometry" : { "$stContains"   : { "$geometry" : <geojson> } } }
+                                              → st_contains(<lit>, geometry)
+    { "geometry" : { "$stIntersects" : { "$geometry" : ... } } }
+                                              → st_intersects(geometry, <lit>)
+    { "geometry" : { "$stDistance" : { "$geometry" : <point>,
+                                       "$lt" : 0.5 } } }
+                                              → st_distance(geometry, <lit>) < 0.5
+    { "geometry" : { "$stArea" | "$stLength" : { "$gt" : 10 } } }
+                                              → st_area(geometry) > 10
+
 Property names starting with ``$.`` (JSON-path style) strip the prefix —
 attributes here are real SFT columns, not nested documents. ``geometry``
 maps to the type's default geometry attribute.
@@ -39,6 +51,13 @@ _UNIT_TO_DEG = {
 }
 
 _CMP_OPS = {"$lt": "<", "$lte": "<=", "$gt": ">", "$gte": ">=", "$ne": "<>"}
+
+# geometry-catalog operators (lower-cased lookup: the DSL is camelCase)
+_FUNC_BOOL_OPS = {"$stcontains": "st_contains",
+                  "$stintersects": "st_intersects"}
+_FUNC_CMP_OPS = {"$stdistance": "st_distance", "$starea": "st_area",
+                 "$stlength": "st_length"}
+_FUNC_CMP_BOUNDS = {**_CMP_OPS, "$eq": "="}
 
 
 def parse_json_query(q: Union[str, dict, None], sft) -> ir.Filter:
@@ -89,6 +108,27 @@ def _predicate(attr: str, obj: dict) -> ir.Filter:
 
 
 def _one_op(attr: str, op: str, v) -> ir.Filter:
+    low = op.lower()
+    if low in _FUNC_BOOL_OPS:
+        name = _FUNC_BOOL_OPS[low]
+        lit = _geometry(v)
+        # st_contains(lit, geom): the literal contains the feature (the
+        # useful direction for a constant query geometry); st_intersects
+        # is symmetric — keep the attr-first spelling the parser produces
+        args = (lit, attr) if name == "st_contains" else (attr, lit)
+        return ir.Func(name, args)
+    if low in _FUNC_CMP_OPS:
+        name = _FUNC_CMP_OPS[low]
+        if not isinstance(v, dict):
+            raise ValueError(f"{op} expects an object with a comparison "
+                             "bound")
+        bounds = [(b, bv) for b, bv in v.items() if b in _FUNC_CMP_BOUNDS]
+        if len(bounds) != 1:
+            raise ValueError(f"{op} needs exactly one comparison bound "
+                             f"({sorted(_FUNC_CMP_BOUNDS)})")
+        args = (attr, _geometry(v)) if name == "st_distance" else (attr,)
+        bop, bval = bounds[0]
+        return ir.FuncCmp(_FUNC_CMP_BOUNDS[bop], name, args, float(bval))
     if op in _CMP_OPS:
         return ir.Cmp(_CMP_OPS[op], attr, v)
     if op == "$in":

@@ -22,6 +22,9 @@ Routes:
   GET  /types                          → type names
   GET  /types/{t}                      → schema + row count
   GET  /types/{t}/features?cql=&limit=&sort=&crs=   → GeoJSON FeatureCollection
+  GET  /types/{t}/features?cql=&select=st_centroid(geom) AS c,val
+                                       → projected columns (geometry terms
+                                         as WKT, st_* scalars as floats)
   GET  /types/{t}/count?cql=           → {"count": n}  (concurrent requests
                                          coalesce through the micro-batching
                                          scheduler, serve/scheduler.py)
@@ -486,6 +489,16 @@ class GeoJsonApi:
                     hints["crs"] = query["crs"][0]
                 res = self.store.query(t, cql, hints=hints or None,
                                        auths=auths)
+                if "select" in query:
+                    # geometry-catalog projections: st_* terms evaluate
+                    # through the vmapped kernels (GEOM_KERNELS knob),
+                    # geometry results serialize as WKT
+                    from geomesa_tpu.geom.functions import \
+                        projection_columns
+                    cols = projection_columns(res.table, None,
+                                              query["select"][0])
+                    return 200, {"type": t, "count": len(res.table),
+                                 "columns": cols}
                 from geomesa_tpu.io.export import export
                 return 200, json.loads(export(res.table, "geojson"))
             if rest == ["features"] and method == "POST":
