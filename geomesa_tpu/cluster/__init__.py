@@ -26,9 +26,17 @@ Modules:
   dryrun    spawned N-process CPU-backend dryrun + single-process
             oracle comparison (the CI acceptance surface and bench
             cfg12 engine).
+  cells     shard cells (cluster v2): each Morton key-range shard as a
+            replicated primary+follower group with its own fencing
+            epoch — the ownership map the shard-aware router routes
+            writes by, the per-cell admit matrix, graceful ownership
+            handoff, and the node-local ingest ownership gate.
 """
 
 from geomesa_tpu.cluster.runtime import (ClusterRuntime, runtime,
                                          cluster_active)
+from geomesa_tpu.cluster.cells import (CellInfo, NotOwnedError,
+                                       ShardCells)
 
-__all__ = ["ClusterRuntime", "runtime", "cluster_active"]
+__all__ = ["ClusterRuntime", "runtime", "cluster_active",
+           "CellInfo", "NotOwnedError", "ShardCells"]

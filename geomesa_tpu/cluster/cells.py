@@ -1,0 +1,405 @@
+"""Shard cells: per-Morton-shard replicated primary+follower groups.
+
+≙ the reference's tablet-server model: every tablet (contiguous key
+range) is hosted by one server with write-ahead durability, and the
+master moves ownership between servers under a fencing discipline.
+Here the composition is explicit — `cluster/` contributes the
+contiguous Morton key ranges (`ClusterLayout.key_ranges`) and
+`replication/` contributes the WAL frame protocol, fencing epochs and
+promote-by-highest-applied-seq — and this module is where they meet:
+
+  ShardCells      the fleet-wide ownership map: shard id -> [key_lo,
+                  key_hi] + member nodes, with O(log S) key routing
+                  (`owner_of` / `route` / `route_points`). Ranges are
+                  half-open on the NEXT shard's lo, so every int64 key
+                  has exactly one owner (edge keys clamp to the edge
+                  cells — growth never strands a write).
+  CellFence       the per-cell fencing admit matrix composed over
+                  `replication/fence.py`: a stale epoch from the SAME
+                  cell is rejected and answered with a fence (split-
+                  brain inside the cell stops here); a frame from a
+                  DIFFERENT cell is rejected outright WITHOUT touching
+                  the receiver's epoch — cross-cell traffic must never
+                  fence a healthy owner.
+  cell frames     `pack_cell_frame`/`unpack_cell_frame`: the (cell id,
+                  epoch) envelope around a WAL frame that makes the
+                  admit matrix checkable before the frame body is even
+                  CRC-verified.
+  hand_off        the graceful ownership handoff discipline: drain the
+                  old owner, wait for the successor to reach the old
+                  WAL head, then bump the successor's epoch so the old
+                  owner is fenced BEFORE the successor accepts writes.
+                  Epochs persist through `replication/fence.py`, so a
+                  restart of either side cannot resurrect the old
+                  owner.
+  CELLS           the process-global registry: which cell (if any)
+                  this node serves, surfaced on `/cells` and enforced
+                  by the web ingest gate (`ensure_owned`).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.replication import fence as _fence
+
+KEY_MIN = -(1 << 62)
+KEY_MAX = (1 << 62) - 1
+
+
+class NotOwnedError(ValueError):
+    """A write's routing key falls outside the local cell's range."""
+
+    def __init__(self, cell: str, key: int, owner: Optional[str]):
+        super().__init__(
+            f"key {key} is not owned by cell {cell}"
+            + (f" (owner: {owner})" if owner else ""))
+        self.cell = cell
+        self.key = int(key)
+        self.owner = owner
+
+
+@dataclass
+class CellInfo:
+    """One shard cell: a contiguous key range + its member nodes."""
+
+    shard: str
+    key_lo: int
+    key_hi: int
+    members: List[str] = field(default_factory=list)
+
+    def summary(self) -> dict:
+        return {"shard": self.shard,
+                "key_range": [int(self.key_lo), int(self.key_hi)],
+                "members": list(self.members)}
+
+
+class ShardCells:
+    """The fleet ownership map: sorted, contiguous shard key ranges."""
+
+    def __init__(self, cells: Sequence[CellInfo]):
+        if not cells:
+            raise ValueError("ShardCells needs at least one cell")
+        self.cells: List[CellInfo] = sorted(cells,
+                                            key=lambda c: int(c.key_lo))
+        seen = set()
+        for c in self.cells:
+            if c.shard in seen:
+                raise ValueError(f"duplicate shard id {c.shard!r}")
+            seen.add(c.shard)
+            if int(c.key_hi) < int(c.key_lo):
+                raise ValueError(
+                    f"cell {c.shard}: key_hi {c.key_hi} < key_lo "
+                    f"{c.key_lo}")
+        for a, b in zip(self.cells, self.cells[1:]):
+            if int(b.key_lo) <= int(a.key_lo):
+                raise ValueError(
+                    f"cells {a.shard}/{b.shard} share key_lo "
+                    f"{b.key_lo}")
+        self._los = np.asarray([int(c.key_lo) for c in self.cells],
+                               dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self.cells)
+
+    def cell(self, shard: str) -> CellInfo:
+        for c in self.cells:
+            if c.shard == shard:
+                return c
+        raise KeyError(f"no shard {shard!r}")
+
+    def route(self, keys) -> np.ndarray:
+        """Cell index per key. Half-open on the next cell's lo; keys
+        below the first lo clamp to cell 0 (edge cells absorb growth at
+        the boundaries, so every key has exactly one owner)."""
+        keys = np.asarray(keys, dtype=np.int64)
+        idx = np.searchsorted(self._los, keys, side="right") - 1
+        return np.clip(idx, 0, len(self.cells) - 1)
+
+    def owner_of(self, key: int) -> CellInfo:
+        return self.cells[int(self.route([int(key)])[0])]
+
+    def route_points(self, xs, ys,
+                     bits: Optional[int] = None) -> np.ndarray:
+        """Cell index per (lon, lat) point via the coarse Z2 routing
+        key — the serving write path's geometry-only router (the table
+        partition itself uses the exact z3-derived keys)."""
+        return self.route(geo_key(xs, ys, bits=bits))
+
+    def summary(self) -> dict:
+        return {"shards": [c.summary() for c in self.cells]}
+
+    @classmethod
+    def from_key_ranges(cls, key_ranges: Sequence[Sequence[int]],
+                        members: Optional[Dict[str, List[str]]] = None
+                        ) -> "ShardCells":
+        """Build from `ClusterLayout.key_ranges` order: shard i is the
+        i-th contiguous range (the dryrun/table side of the map)."""
+        members = members or {}
+        return cls([CellInfo(str(i), int(lo), int(hi),
+                             members.get(str(i), []))
+                    for i, (lo, hi) in enumerate(key_ranges)])
+
+    @classmethod
+    def from_specs(cls, specs: Sequence[str]) -> "ShardCells":
+        """Parse CLI cell specs ``SHARD=LO:HI[=MEMBER[,MEMBER...]]``
+        (members name router endpoints, first member = seed primary)."""
+        cells = []
+        for spec in specs:
+            parts = spec.split("=")
+            if len(parts) not in (2, 3) or ":" not in parts[1]:
+                raise ValueError(
+                    f"bad shard spec {spec!r} "
+                    "(want SHARD=LO:HI[=MEMBER,...])")
+            lo, hi = parts[1].split(":", 1)
+            mem = [m for m in parts[2].split(",") if m] \
+                if len(parts) == 3 else []
+            cells.append(CellInfo(parts[0], int(lo), int(hi), mem))
+        return cls(cells)
+
+
+def geo_key(xs, ys, bits: Optional[int] = None) -> np.ndarray:
+    """Vectorized coarse Z2 routing key: interleave ``bits`` lon/lat
+    grid bits (lon major, same orientation as obs/sketches.cell_key) —
+    deterministic, monotone-in-space, and computable anywhere a
+    feature's coordinates are known (a router has no store)."""
+    if bits is None:
+        bits = int(config.CELL_GEO_KEY_BITS.get())
+    bits = max(1, min(16, int(bits)))
+    n = 1 << bits
+    xs = np.asarray(xs, dtype=np.float64)
+    ys = np.asarray(ys, dtype=np.float64)
+    gx = np.clip(((xs + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
+    gy = np.clip(((ys + 90.0) / 180.0 * n).astype(np.int64), 0, n - 1)
+    z = np.zeros(gx.shape, dtype=np.int64)
+    for b in range(bits):
+        z |= ((gx >> b) & 1) << (2 * b + 1)
+        z |= ((gy >> b) & 1) << (2 * b)
+    return z
+
+
+# -- the per-cell fencing admit matrix ----------------------------------------
+
+
+ADMIT_OK = "ok"
+ADMIT_ADOPT = "adopt"
+REJECT_STALE = "reject_stale"          # same cell, lower epoch: fence it
+REJECT_FOREIGN = "reject_foreign"      # different cell: drop, no fencing
+
+
+class CellFence:
+    """Fencing epochs scoped to ONE cell, persisted in the cell
+    directory via replication/fence.py (so a handoff or restart can
+    never resurrect a lower epoch).
+
+    The admit matrix is the split-brain contract:
+
+      same cell, epoch == mine   -> ok
+      same cell, epoch >  mine   -> adopt (durably witness the higher
+                                   epoch, then ok)
+      same cell, epoch <  mine   -> reject_stale: refused AND answered
+                                   with the higher epoch (the sender
+                                   lost primaryship of THIS cell)
+      different cell, any epoch  -> reject_foreign: refused WITHOUT
+                                   touching the receiver's epoch — a
+                                   stale frame leaking across cells
+                                   must never fence a healthy owner.
+    """
+
+    def __init__(self, cell: str, directory: str):
+        self.cell = str(cell)
+        self.dir = str(directory)
+        self.epoch = _fence.load_epoch(self.dir)
+        self.stale_rejects = 0
+        self.foreign_rejects = 0
+
+    def bump(self, at_least: int = 0) -> int:
+        self.epoch = _fence.bump_epoch(self.dir, at_least=max(
+            int(at_least), self.epoch))
+        return self.epoch
+
+    def admit(self, cell: str, epoch: int) -> str:
+        """Classify one (cell, epoch) envelope; adopts/refuses as the
+        matrix says and returns the verdict string."""
+        epoch = int(epoch)
+        if str(cell) != self.cell:
+            self.foreign_rejects += 1
+            _metrics.inc("cells.foreign_frame_rejects")
+            return REJECT_FOREIGN
+        if epoch < self.epoch:
+            self.stale_rejects += 1
+            _metrics.inc("cells.stale_frame_rejects")
+            return REJECT_STALE
+        if epoch > self.epoch:
+            self.epoch = _fence.save_epoch(self.dir, epoch)
+            return ADMIT_ADOPT
+        return ADMIT_OK
+
+    def stats(self) -> dict:
+        return {"cell": self.cell, "epoch": self.epoch,
+                "stale_rejects": self.stale_rejects,
+                "foreign_rejects": self.foreign_rejects}
+
+
+# -- cell frame envelope ------------------------------------------------------
+
+_CF_MAGIC = b"GMCF"
+
+
+def pack_cell_frame(cell: str, epoch: int, frame: bytes) -> bytes:
+    """Wrap one WAL frame in the (cell, epoch) envelope the admit
+    matrix classifies — checked BEFORE the frame body is CRC-verified,
+    so a foreign or stale frame costs one header parse, not an apply."""
+    cid = str(cell).encode("utf-8")
+    return (_CF_MAGIC + struct.pack(">HQ", len(cid), int(epoch))
+            + cid + frame)
+
+
+def unpack_cell_frame(data: bytes):
+    """-> (cell, epoch, frame). Raises ValueError on a malformed
+    envelope (same fail-loudly discipline as WAL frame CRC)."""
+    if len(data) < 14 or data[:4] != _CF_MAGIC:
+        raise ValueError("bad cell frame envelope (magic)")
+    clen, epoch = struct.unpack(">HQ", data[4:14])
+    if len(data) < 14 + clen:
+        raise ValueError("bad cell frame envelope (truncated cell id)")
+    cell = data[14:14 + clen].decode("utf-8")
+    return cell, int(epoch), data[14 + clen:]
+
+
+# -- ownership handoff --------------------------------------------------------
+
+
+def hand_off(old, new, wait_s: Optional[float] = None,
+             clock=None) -> dict:
+    """Graceful ownership handoff inside one cell: drain the OLD owner,
+    wait for the NEW owner to prove it reached the old WAL head, then
+    fence the old owner under the bumped epoch BEFORE the new owner
+    accepts writes — acked writes either land on the old owner (and are
+    replicated) or are refused; none straddle the swap.
+
+    ``old``/``new`` duck-type the router Endpoint surface: ``drain()``,
+    ``probe()`` (applied_seq / last epoch), ``fence(epoch)`` on old,
+    ``promote(port)`` on new. Returns the handoff report (durations +
+    the fencing epoch)."""
+    import time as _time
+    clock = clock or _time.monotonic
+    wait_s = float(wait_s if wait_s is not None
+                   else config.CELL_HANDOFF_DRAIN_S.get())
+    t0 = clock()
+    try:
+        old.drain()
+    except Exception:
+        pass  # an unreachable old owner is already not accepting writes
+    old.last_probe_ts = 0.0
+    op = old.probe() or {}
+    head = int(op.get("applied_seq") or 0)
+    old_epoch = int(op.get("epoch") or 0)
+    deadline = t0 + wait_s
+    caught_up = False
+    while clock() < deadline:
+        new.last_probe_ts = 0.0
+        np_ = new.probe() or {}
+        if int(np_.get("applied_seq") or 0) >= head:
+            caught_up = True
+            break
+        _time.sleep(0.02)
+    # fence FIRST: after this point the old owner refuses writes even
+    # if the promote below fails — fail closed, never two owners
+    epoch = old_epoch + 1
+    try:
+        old.fence(epoch)
+    except Exception:
+        pass  # dead old owner: the epoch bump below still wins
+    result = new.promote(port=0)
+    return {"caught_up": caught_up,
+            "head_seq": head,
+            "epoch": int(result.get("epoch") or epoch),
+            "duration_ms": round((clock() - t0) * 1000.0, 1),
+            "promoted": result}
+
+
+# -- process-global cell registry ---------------------------------------------
+
+
+class CellRegistry:
+    """Which cell THIS node serves (one per process, like the
+    Federator): the web `/cells` surface and the ingest ownership
+    gate read it; the CLI `--cell` flag writes it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.topology: Optional[ShardCells] = None
+        self.local: Optional[CellInfo] = None
+        self.fence: Optional[CellFence] = None
+        self.gate_refusals = 0
+        self.gate_rows = 0
+
+    def configure(self, topology: Optional[ShardCells] = None,
+                  local: Optional[CellInfo] = None,
+                  directory: Optional[str] = None) -> None:
+        with self._lock:
+            self.topology = topology
+            self.local = local
+            self.fence = (CellFence(local.shard, directory)
+                          if local is not None and directory else None)
+
+    def active(self) -> bool:
+        return self.local is not None
+
+    def ensure_owned(self, xs, ys) -> int:
+        """The ingest ownership gate: every row's routing key must fall
+        in the local cell's range. Raises NotOwnedError naming the
+        owning shard (when the topology knows it); CELL_ENFORCE=0
+        counts but accepts."""
+        with self._lock:
+            local, topo = self.local, self.topology
+        if local is None:
+            return 0
+        keys = geo_key(xs, ys)
+        self.gate_rows += int(len(keys))
+        bad = (keys < int(local.key_lo)) | (keys > int(local.key_hi))
+        n_bad = int(bad.sum())
+        if n_bad == 0:
+            return 0
+        self.gate_refusals += n_bad
+        _metrics.inc("cells.gate_refusals", n_bad)
+        if not config.CELL_ENFORCE.get():
+            return n_bad
+        k = int(keys[np.flatnonzero(bad)[0]])
+        owner = None
+        if topo is not None:
+            try:
+                owner = topo.owner_of(k).shard
+            except Exception:
+                owner = None
+        raise NotOwnedError(local.shard, k, owner)
+
+    def state(self) -> dict:
+        """The `/cells` payload."""
+        with self._lock:
+            local, topo, fence = self.local, self.topology, self.fence
+        return {
+            "active": local is not None,
+            "local": local.summary() if local else None,
+            "fence": fence.stats() if fence else None,
+            "topology": topo.summary() if topo else None,
+            "gate": {"rows": self.gate_rows,
+                     "refusals": self.gate_refusals,
+                     "enforce": bool(config.CELL_ENFORCE.get())},
+        }
+
+
+CELLS = CellRegistry()
+
+
+def _reset_for_tests() -> None:
+    global CELLS
+    CELLS = CellRegistry()

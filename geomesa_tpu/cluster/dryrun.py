@@ -25,6 +25,12 @@ full corpus.
 The corpus deliberately contains duplicated (point, time) rows so the
 tie-break discipline (original-gid plane through the partition, local
 row order in the index) is exercised, not just probable.
+
+The default (non-drill) run additionally exercises the cluster knn
+radius exchange against a brute-force oracle and the distributed WRITE
+path: a fresh extra corpus routed by Morton key ownership, each process
+ingesting only its owned rows, and the post-ingest cluster table proven
+byte-equal to the oracle that ingested everything single-process.
 """
 
 from __future__ import annotations
@@ -75,6 +81,19 @@ JOIN_POLYGONS = [
     "POLYGON((100 -30, 160 -30, 160 40, 130 5, 100 40, 100 -30))",
 ]
 JOIN_MAX_PAIRS = 200
+
+# cluster knn battery: (cql, x, y, k) — device-exact plans only (knn
+# rejects host residuals). k=7 overlaps the duplicated corpus tail so
+# the (distance, gid) tie-break is exercised, not just probable.
+KNN_QUERIES = [
+    ("INCLUDE", 0.0, 0.0, 5),
+    ("BBOX(geom, -60, -60, 60, 60)", 10.0, -5.0, 7),
+    ("BBOX(geom, -10, -10, 10, 10) AND dtg DURING "
+     "2020-01-05T00:00:00Z/2020-01-20T00:00:00Z", -3.0, 4.0, 6),
+]
+
+# write-path stage: the extra corpus is this fraction of the base one
+WRITE_EXTRA_DIV = 8
 
 
 # balance-drill corpus window: a 2-hour dtg span starting on an
@@ -248,6 +267,156 @@ def run_battery(planner, scan, fids_sorted) -> dict:
     return out
 
 
+# -- cluster knn + the distributed write path ---------------------------------
+
+
+def _knn_key(q: str, x: float, y: float, k: int) -> str:
+    return f"{q}|{x},{y},k={k}"
+
+
+def run_knn(planner, scan) -> dict:
+    """The bounded-radius-exchange battery: every query's (ids, dists)
+    plus the number of collective rounds it took (the dryrun asserts
+    rounds are counted and stay under the cap)."""
+    from geomesa_tpu.cluster.exec import KNN_STATS
+    out: dict = {"results": {}, "rounds": {}}
+    for q, x, y, k in KNN_QUERIES:
+        plan = planner.plan(q)
+        before = KNN_STATS["rounds_total"]
+        ids, d = scan.knn(plan, x, y, k)
+        out["results"][_knn_key(q, x, y, k)] = {
+            "ids": [int(i) for i in ids],
+            "d": [float(v) for v in np.asarray(d, dtype=np.float32)]}
+        out["rounds"][_knn_key(q, x, y, k)] = \
+            KNN_STATS["rounds_total"] - before
+    out["stats"] = dict(KNN_STATS)
+    return out
+
+
+def oracle_knn(planner, scan) -> dict:
+    """The brute-force oracle: no top-k machinery at all — f64 haversine
+    over EVERY masked row, (distance, gid) lexsort, take k. What the
+    radius exchange must match byte-for-byte."""
+    from geomesa_tpu.process.geo import haversine_m
+    gx, gy = scan.sharded.host_xy
+    out = {}
+    for q, x, y, k in KNN_QUERIES:
+        idx = np.flatnonzero(scan.mask(planner.plan(q)))
+        d = haversine_m(np.asarray(gx)[idx].astype(np.float64),
+                        np.asarray(gy)[idx].astype(np.float64),
+                        float(x), float(y))
+        top = np.lexsort((idx, d))[:k]
+        out[_knn_key(q, x, y, k)] = {
+            "ids": [int(i) for i in idx[top]],
+            "d": [float(v) for v in d[top].astype(np.float32)]}
+    return out
+
+
+def _extra_table(sft, extra: Dict[str, np.ndarray], ids: np.ndarray):
+    from geomesa_tpu.features.table import FeatureTable
+    return FeatureTable.build(sft, {
+        "name": extra["name"][ids],
+        "val": extra["val"][ids].astype(np.int32),
+        "dtg": extra["dtg"][ids].astype(np.int64),
+        "geom": (extra["x"][ids], extra["y"][ids])},
+        fids=["e%09d" % g for g in ids])
+
+
+def run_post_battery(planner, scan, fids_sorted) -> dict:
+    """Post-ingest exactness battery (counts + density sha + merged
+    selects): byte-equality against the oracle's post-ingest run IS the
+    'writes landed on the owning cell' proof — a row on the wrong shard
+    breaks rank-order merge, a lost row breaks every count."""
+    out: dict = {"counts": {}, "selects": {}}
+    for q in COUNT_QUERIES:
+        out["counts"][q] = int(scan.count(planner.plan(q)))
+    grid = scan.density(planner.plan(DENSITY_QUERY), DENSITY_BBOX,
+                        *DENSITY_WH)
+    g32 = np.ascontiguousarray(np.asarray(grid, dtype=np.float32))
+    out["density_sha"] = hashlib.sha256(g32.tobytes()).hexdigest()
+    for q in SELECT_QUERIES:
+        out["selects"][q] = scan.select_merged(
+            planner.plan(q), {"fid": fids_sorted})["fid"]
+    return out
+
+
+def run_write_path(rt: ClusterRuntime, ds, scan, n: int, seed: int,
+                   span_ms: Optional[int] = None,
+                   start: Optional[str] = None) -> dict:
+    """The distributed durable write path: a fresh extra corpus routes
+    by Morton key ownership (ShardCells over the layout's key ranges),
+    each process ingests ONLY its owned rows, the cluster table
+    reassembles, and the post-ingest battery must be byte-equal to the
+    oracle that ingested everything single-process."""
+    from geomesa_tpu.cluster.cells import ShardCells
+    from geomesa_tpu.cluster.exec import ClusterScan
+    from geomesa_tpu.cluster.table import ClusterShardedTable
+    from geomesa_tpu.features.table import FeatureTable
+
+    t0 = time.perf_counter()
+    n_extra = max(64, n // WRITE_EXTRA_DIV)
+    extra = make_corpus(n_extra, seed + 1, span_ms=span_ms, start=start)
+    sft = ds.get_schema(TYPE)
+    keys = _partition_keys(sft, FeatureTable.build(sft, {
+        "name": extra["name"], "val": extra["val"].astype(np.int32),
+        "dtg": extra["dtg"].astype(np.int64),
+        "geom": (extra["x"], extra["y"])}))
+    if rt.active() and scan.layout.key_ranges:
+        owners = ShardCells.from_key_ranges(
+            scan.layout.key_ranges).route(keys)
+        mine = np.flatnonzero(owners == rt.process_id)
+    else:
+        mine = np.arange(n_extra, dtype=np.int64)
+    if len(mine):
+        ds.load(TYPE, _extra_table(sft, extra, mine))
+
+    planner = ds.planner(TYPE)        # flush: extras merge into the index
+    idx = next(i for i in planner.indexes if i.name == "z3")
+    host_cols = {k: np.asarray(v) for k, v in idx.device.columns.items()}
+    post_keys = _partition_keys(sft, planner.table)
+    st = ClusterShardedTable.from_local_columns(
+        rt, host_cols,
+        key_bounds=(int(post_keys.min()), int(post_keys.max())))
+    scan2 = ClusterScan(st)
+    fids_sorted = np.asarray(planner.table.fids)[np.asarray(idx.perm)]
+    post = run_post_battery(planner, scan2, fids_sorted)
+    return {
+        "n_extra": int(n_extra),
+        "ingested": int(len(mine)),
+        "owned_sha": hashlib.sha256(
+            np.asarray(mine, dtype=np.int64).tobytes()).hexdigest(),
+        "post": post,
+        "key_range": st.layout.key_ranges[rt.process_id]
+            if st.layout.key_ranges else None,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _expected_routing(key_ranges, n: int, seed: int) -> List[dict]:
+    """What ownership routing SHOULD do, recomputed independently by the
+    orchestrator from each rank's reported key range."""
+    from geomesa_tpu.cluster.cells import ShardCells
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.features.table import FeatureTable
+
+    n_extra = max(64, n // WRITE_EXTRA_DIV)
+    extra = make_corpus(n_extra, seed + 1)
+    sft = SimpleFeatureType.from_spec(TYPE, SPEC)
+    keys = _partition_keys(sft, FeatureTable.build(sft, {
+        "name": extra["name"], "val": extra["val"].astype(np.int32),
+        "dtg": extra["dtg"].astype(np.int64),
+        "geom": (extra["x"], extra["y"])}))
+    owners = ShardCells.from_key_ranges(key_ranges).route(keys)
+    out = []
+    for p in range(len(key_ranges)):
+        mine = np.flatnonzero(owners == p)
+        out.append({"ingested": int(len(mine)),
+                    "owned_sha": hashlib.sha256(
+                        np.asarray(mine, dtype=np.int64).tobytes())
+                        .hexdigest()})
+    return out
+
+
 # -- the balance drill --------------------------------------------------------
 
 
@@ -350,6 +519,11 @@ def worker_main(out_path: str) -> int:
         span_ms=int(span_ms) if span_ms else None, start=start)
     battery = run_battery(planner, scan, fids_sorted)
     drill_report = run_drill(rt, drill, seed) if drill else None
+    # knn + the distributed write path ride the default dryrun; the
+    # drill variant keeps its historical (cfg13-scored) shape
+    knn_report = run_knn(planner, scan) if not drill else None
+    write_report = run_write_path(rt, ds, scan, n, seed) \
+        if not drill else None
 
     fleet = None
     balance_http = None
@@ -387,6 +561,8 @@ def worker_main(out_path: str) -> int:
         "battery": battery,
         "stages": stages,
         "fleet": fleet,
+        "knn": knn_report,
+        "write": write_report,
         "drill": drill_report,
         "balance_http": balance_http,
         "wall_s": round(time.perf_counter() - t_start, 3),
@@ -458,9 +634,12 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
     # oracle while the workers run: same battery, inactive runtime
     # (same corpus window as the workers so equality still holds)
     rt0 = inactive_runtime()
-    _, planner, scan, fids_sorted, ostages = build_local(
+    ds0, planner, scan, fids_sorted, ostages = build_local(
         rt0, n, seed, span_ms=span_ms, start=start)
     oracle = run_battery(planner, scan, fids_sorted)
+    if not drill:
+        oracle["knn_brute"] = oracle_knn(planner, scan)
+        oracle["write"] = run_write_path(rt0, ds0, scan, n, seed)
 
     deadline = time.monotonic() + timeout_s
     rcs = [None] * num_processes
@@ -482,7 +661,8 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
         except Exception:
             ranks.append(None)
 
-    checks = _check(oracle, ranks, n, num_processes, web, drill)
+    checks = _check(oracle, ranks, n, num_processes, web, drill,
+                    seed=seed)
     report = {
         "ok": all(checks.values()) and all(rc == 0 for rc in rcs),
         "num_processes": num_processes,
@@ -504,7 +684,8 @@ def run_dryrun(num_processes: int = 2, n: int = 20000, seed: int = 7,
 
 def _check(oracle: dict, ranks: List[Optional[dict]], n: int,
            num_processes: int, web: bool,
-           drill: Optional[str] = None) -> Dict[str, bool]:
+           drill: Optional[str] = None,
+           seed: int = 7) -> Dict[str, bool]:
     live = [r for r in ranks if r is not None]
     checks = {"all_ranks_reported": len(live) == num_processes}
     if not checks["all_ranks_reported"]:
@@ -545,6 +726,39 @@ def _check(oracle: dict, ranks: List[Optional[dict]], n: int,
         checks["drill_ledger_active"] = bool(
             r0 and ((r0.get("drill") or {}).get("balance")
                     or {}).get("active"))
+    else:
+        from geomesa_tpu import config
+        # cluster knn: every rank's radius exchange byte-equals the
+        # brute-force oracle, with the collective rounds counted and
+        # under the cap (exactly 2 per exact query)
+        brute = oracle.get("knn_brute")
+        checks["knn_exact"] = all(
+            (r.get("knn") or {}).get("results") == brute for r in live)
+        cap = max(2, int(config.CELL_KNN_MAX_ROUNDS.get()))
+        checks["knn_rounds_bounded"] = all(
+            (r.get("knn") or {}).get("rounds")
+            and all(0 < v <= cap
+                    for v in r["knn"]["rounds"].values())
+            for r in live)
+        # write path: each rank ingested EXACTLY the rows ownership
+        # routing assigns it (recomputed independently here), and the
+        # post-ingest cluster table byte-equals the oracle that
+        # ingested everything single-process
+        expected = _expected_routing(kr, n, seed) \
+            if checks["key_ranges_ordered"] else None
+        by_pid = {r["process_id"]: (r.get("write") or {}) for r in live}
+        checks["write_landed_on_owner"] = bool(expected) and all(
+            by_pid.get(p, {}).get("ingested") == e["ingested"]
+            and by_pid.get(p, {}).get("owned_sha") == e["owned_sha"]
+            for p, e in enumerate(expected))
+        n_extra = max(64, n // WRITE_EXTRA_DIV)
+        checks["write_strict_subset"] = (
+            sum(w.get("ingested", 0) for w in by_pid.values()) == n_extra
+            and all(w.get("ingested", 0) < n_extra
+                    for w in by_pid.values()))
+        checks["write_post_equal"] = all(
+            (r.get("write") or {}).get("post") == oracle["write"]["post"]
+            for r in live)
     return checks
 
 

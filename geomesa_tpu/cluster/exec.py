@@ -15,9 +15,17 @@ devices; across PROCESSES only two things change, and both live here:
     is partitioned by contiguous key range), so concatenation in rank
     order IS the global sort order: no re-sort, no k-way heap.
 
-knn is explicitly rejected on an active cluster for now: the f64 host
-re-rank needs candidate coordinates that live on other hosts, and a
-silent f32-only answer would violate the documented contract.
+  - knn runs a bounded radius exchange: each process ranks its LOCAL
+    matches in f64 (its block's coordinates are host-addressable, so
+    the exact re-rank needs nothing remote), round 1 exchanges every
+    rank's local kth distance and takes the min — a proven upper bound
+    on the global kth, since any rank holding k points within d has
+    shown k global points within d — and round 2 exchanges only the
+    ≤ k per-rank candidates inside that radius. Exactly two collective
+    rounds for an exact answer, counted in ``KNN_STATS`` and capped by
+    ``GEOMESA_TPU_CELL_KNN_MAX_ROUNDS``. Ties at the kth boundary break
+    on (distance, global row id) so every process — and the
+    single-process oracle — agrees byte-for-byte.
 """
 
 from __future__ import annotations
@@ -29,6 +37,11 @@ import numpy as np
 from geomesa_tpu.cluster.runtime import note_collective
 from geomesa_tpu.cluster.table import ClusterShardedTable
 from geomesa_tpu.parallel.dist import DistributedScan, _build_mask
+
+
+# radius-exchange accounting: the dryrun asserts rounds are counted and
+# bounded (exactly 2 per exact query)
+KNN_STATS = {"rounds_total": 0, "last_rounds": 0, "queries": 0}
 
 
 class ClusterScan(DistributedScan):
@@ -78,11 +91,80 @@ class ClusterScan(DistributedScan):
         return out
 
     def knn(self, plan, x: float, y: float, k: int):
+        """Exact cluster knn via bounded radius exchange (module
+        docstring): (global row ids, distances_m f32), every process
+        returning the identical answer. Falls back to the single-shard
+        DistributedScan path when the cluster runtime is inactive."""
         if not self._active():
             return super().knn(plan, x, y, k)
-        raise NotImplementedError(
-            "cluster knn: the exact f64 re-rank needs remote candidate "
-            "coordinates; run knn against a replicated table")
+        if plan.residual_host is not None \
+                or plan.candidate_slices is not None:
+            raise ValueError(
+                "cluster knn needs a device-exact plan (host residuals "
+                "cannot refine a k-limited result)")
+        if self.sharded.host_xy is None:
+            raise ValueError("cluster knn needs host coordinates "
+                             "(ClusterShardedTable.host_xy)")
+        import time as _time
+
+        from geomesa_tpu import config
+        from geomesa_tpu.process.geo import haversine_m
+
+        k = int(k)
+        max_rounds = max(2, int(config.CELL_KNN_MAX_ROUNDS.get()))
+        rounds = 0
+
+        def exchange(payload):
+            nonlocal rounds
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError(
+                    f"cluster knn exceeded {max_rounds} radius-exchange "
+                    f"rounds (GEOMESA_TPU_CELL_KNN_MAX_ROUNDS)")
+            self.runtime.note_psum_round()
+            t0 = _time.perf_counter()
+            out = self.runtime.exchange(payload, op="knn_radius")
+            note_collective("knn_radius", _time.perf_counter() - t0)
+            return out
+
+        # local exact ranking: f64 re-rank of this process's matches
+        idx = np.flatnonzero(self.local_mask(plan))
+        gx, gy = self.sharded.host_xy
+        d = haversine_m(np.asarray(gx)[idx].astype(np.float64),
+                        np.asarray(gy)[idx].astype(np.float64),
+                        float(x), float(y))
+        order = np.argsort(d, kind="stable")
+        idx, d = idx[order], d[order]
+        row0 = int(sum(int(r) for r in
+                       self.layout.proc_rows[: self.layout.process_id]))
+        gids = row0 + idx.astype(np.int64)
+
+        # round 1: min over every rank's local kth distance == a proven
+        # upper bound on the global kth distance
+        local_kth = float(d[k - 1]) if len(d) >= k else None
+        kths = [p["kth"] for p in exchange({"kth": local_kth})]
+        finite = [v for v in kths if v is not None]
+        radius = min(finite) if finite else float("inf")
+
+        # round 2: only candidates within the radius travel (≤ k/rank)
+        n_send = min(k, int(np.searchsorted(d, radius, side="right"))
+                     if np.isfinite(radius) else len(d))
+        cand = [[int(g), float(v)]
+                for g, v in zip(gids[:n_send], d[:n_send])]
+        parts = exchange({"cand": cand})
+        all_g = np.asarray([g for p in parts for g, _ in p["cand"]],
+                           dtype=np.int64)
+        all_d = np.asarray([v for p in parts for _, v in p["cand"]],
+                           dtype=np.float64)
+        KNN_STATS["last_rounds"] = rounds
+        KNN_STATS["rounds_total"] += rounds
+        KNN_STATS["queries"] += 1
+        if not len(all_g):
+            return (np.empty(0, dtype=np.int64),
+                    np.empty(0, dtype=np.float32))
+        # deterministic kth-boundary ties: (distance, global row id)
+        top = np.lexsort((all_g, all_d))[:k]
+        return all_g[top], all_d[top].astype(np.float32)
 
     # -- local compaction + ordered merge -------------------------------------
 

@@ -888,6 +888,55 @@ GEOM_CHUNK = _register(
     "are chunked so B*S*L stays under it.")
 
 
+# -- shard cells: replicated write cells + shard-aware serving (ISSUE 19) -----
+
+CELL_ENFORCE = _register(
+    "GEOMESA_TPU_CELL_ENFORCE", True, _parse_bool,
+    "When this node is registered as a member of a shard cell "
+    "(cluster/cells.py), refuse ingests whose routing keys fall outside "
+    "the cell's Morton key range (HTTP 409 naming the owning shard). "
+    "Off: the gate logs a metric but accepts — migration escape hatch.")
+
+CELL_SHARD_BUDGET_FRACTION = _register(
+    "GEOMESA_TPU_CELL_SHARD_BUDGET_FRACTION", 0.45, float,
+    "Fraction of the REMAINING request deadline carved out as one "
+    "shard attempt's deadline budget in the router's scatter-gather "
+    "(passed downstream as deadline_ms). < 0.5 leaves room for one "
+    "follower retry against the same shard inside the request deadline.")
+
+CELL_SHARD_MIN_BUDGET_MS = _register(
+    "GEOMESA_TPU_CELL_SHARD_MIN_BUDGET_MS", 50.0, float,
+    "Floor on a per-shard deadline budget: a nearly-spent request "
+    "deadline still gives each shard attempt at least this much, so "
+    "budget carving degrades to bounded attempts instead of zero-ms "
+    "budgets that can never succeed.")
+
+CELL_RETRY_FOLLOWERS = _register(
+    "GEOMESA_TPU_CELL_RETRY_FOLLOWERS", True, _parse_bool,
+    "On a shard primary failure mid-scatter, retry that shard against "
+    "its remaining cell members (the demoted-not-dropped tier) before "
+    "declaring the shard missing in the partial-result envelope.")
+
+CELL_KNN_MAX_ROUNDS = _register(
+    "GEOMESA_TPU_CELL_KNN_MAX_ROUNDS", 8, int,
+    "Hard cap on cluster-knn radius-exchange collective rounds. The "
+    "bounded-radius algorithm is exact in 2 (kth-distance psum + "
+    "candidate gather); the cap is the runaway guard the dryrun check "
+    "pins against.")
+
+CELL_HANDOFF_DRAIN_S = _register(
+    "GEOMESA_TPU_CELL_HANDOFF_DRAIN_S", 10.0, float,
+    "Ownership handoff budget for draining the old cell owner and "
+    "waiting for the successor to reach the old owner's WAL head "
+    "before the epoch bump fences the old owner.")
+
+CELL_GEO_KEY_BITS = _register(
+    "GEOMESA_TPU_CELL_GEO_KEY_BITS", 8, int,
+    "Per-axis bits of the coarse Z2 routing key used to assign "
+    "features to shard cells on the serving write path (the dryrun's "
+    "table partition uses the exact z3-derived keys instead).")
+
+
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property
     (the CLI `config` listing / docs surface)."""

@@ -71,6 +71,8 @@ RULES: Dict[str, Tuple[str, str]] = {
                                   "max-over-mean ratio over bar"),
     "collective_straggler": ("ticket", "one rank repeatedly slowest in "
                                        "collective rounds"),
+    "shard_dark": ("page", "a shard cell with ZERO serving endpoints "
+                           "in the router topology"),
 }
 
 
@@ -83,13 +85,15 @@ class DoctorEngine:
     def __init__(self, registry=None, clock=time.monotonic,
                  slo_engine=None, store: Optional[IncidentStore] = None,
                  journal_path: Optional[str] = None,
-                 federator=None, workload=None, shardwatch=None):
+                 federator=None, workload=None, shardwatch=None,
+                 router=None):
         self._reg = registry if registry is not None else _metrics
         self._clock = clock
         self._slo = slo_engine          # None -> late-bind slo.ENGINE
         self._federator = federator     # None -> late-bind federation
         self._workload = workload       # None -> late-bind WORKLOAD
         self._shardwatch = shardwatch   # None -> late-bind WATCH
+        self._router = router           # shard_dark: the routing view
         self.store = store if store is not None else IncidentStore(
             journal_path=journal_path, registry=self._reg,
             node=_trace.node_id())
@@ -461,6 +465,44 @@ class DoctorEngine:
             })
         return alerts
 
+    def attach_router(self, router) -> None:
+        """Bind the shard-aware router whose topology the shard_dark
+        detector should watch (RouterApi does this on startup)."""
+        with self._lock:
+            self._router = router
+
+    def _check_shard_dark(self, now: float) -> List[dict]:
+        """shard_dark: a shard cell with ZERO serving endpoints in the
+        router's topology — every read scatter answers partial and every
+        owned write has nowhere to land. One deduped incident per shard,
+        naming the dark key range and its last-known cell members (the
+        page carries exactly what the operator must respawn)."""
+        router = self._router
+        if router is None or getattr(router, "topology", None) is None:
+            return []
+        try:
+            health = router.shard_health()
+        except Exception:
+            return []
+        alerts: List[dict] = []
+        for sid, row in sorted(health.items()):
+            if int(row.get("serving", 0)) > 0:
+                continue
+            alerts.append({
+                "rule": "shard_dark", "severity": "page",
+                "cause": f"shard:{sid}",
+                "detail": {
+                    "key_range": row.get("key_range"),
+                    "members": row.get("members"),
+                    "healthy": int(row.get("healthy", 0))},
+                "suspect": {"shard": sid,
+                            "key_range": row.get("key_range"),
+                            "members": sorted(
+                                (row.get("members") or {}).keys())},
+                "match": {},
+            })
+        return alerts
+
     def _check_straggler(self, now: float, counters: dict) -> List[dict]:
         """collective_straggler: cluster/runtime.py charges one count
         against the slowest rank of every collective round whose spread
@@ -551,6 +593,7 @@ class DoctorEngine:
                           lambda: self._check_reindex(now, counters),
                           lambda: self._check_skew(now),
                           lambda: self._check_shard_imbalance(now),
+                          lambda: self._check_shard_dark(now),
                           lambda: self._check_straggler(now, counters)):
                 try:
                     alerts.extend(check())

@@ -126,6 +126,22 @@ _OVERRIDES = {
     "cfg15_func_count_mismatch": "exact",
     "cfg15_join_dryrun_ok": "exact",
     "cfg15_join_num_processes": "exact",
+    # cluster cell soak (cfg16, two-sided like cfg11): every robustness
+    # verdict is a correctness axis — zero acked-write loss through
+    # failover/handoff/dark-shard chaos, per-cell fingerprint equality,
+    # BOTH fenced split-brain losers refusing, failover inside the
+    # budget, the doctor's shard_dark precision/recall, the honest
+    # partial-result envelope, and a silent clean half. ANY drift from
+    # the baselined values fails --check; the failover/handoff/steady
+    # latencies ride the statistical gate via their suffixes.
+    "cfg16_failover_within_budget": "exact",
+    "cfg16_acked_write_loss": "exact",
+    "cfg16_split_brain_refused": "exact",
+    "cfg16_doctor_precision": "exact",
+    "cfg16_doctor_recall": "exact",
+    "cfg16_clean_incidents": "exact",
+    "cfg16_shard_dark_fired": "exact",
+    "cfg16_partial_envelope_seen": "exact",
 }
 
 

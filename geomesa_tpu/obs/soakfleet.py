@@ -37,6 +37,11 @@ SOAK_WAIT_S (incident/catch-up wait ceiling), SOAK_FOLLOWERS,
 SOAK_CATCHUP_BUDGET_S, and SOAK_STRETCH — a multiplier on injected
 chaos magnitudes used by the gate self-test (stretch > 1 makes the
 lag-spike genuinely worse, so ``perfwatch --check`` must fail).
+
+obs/soakcells.py is this soak's cluster-v2 sibling: the same
+launch/drive/score skeleton over a SHARDED fleet of replicated cells
+behind the shard-aware router, scored as bench cfg16 (cell failover,
+ownership handoff, cross-cell split-brain, dark-shard envelopes).
 """
 from __future__ import annotations
 

@@ -16,6 +16,12 @@ is a correct KNN result.
 The expanding-radius path survives as the fallback for plans the device
 kernel can't serve (extent layers without point coords, host residuals,
 k beyond the kernel tier cap).
+
+Under a sharded cluster this module answers the LOCAL shard only;
+cluster/exec.py's ClusterScan.knn wraps it in the bounded radius
+exchange (each shard proves an upper bound from its local kth distance,
+then ships only candidates inside the agreed radius) and falls back to
+these single-process paths verbatim when the runtime is inactive.
 """
 
 from __future__ import annotations

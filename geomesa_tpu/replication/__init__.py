@@ -15,6 +15,11 @@ with health-, overload- and lag-aware balancing.
   protocol             the length-prefixed socket transport
   drills               deterministic fleet fault drills (replica kill,
                        lag spike, torn shipped frame, partition fencing)
+
+Cluster v2 composes these primitives per shard: cluster/cells.py scopes
+one fencing-epoch directory and one shipper/follower pair to each Morton
+key-range cell, so split-brain and failover are contained inside the
+cell that lost its primary while the other shards keep serving.
 """
 
 from geomesa_tpu.replication.fence import FencedError  # noqa: F401

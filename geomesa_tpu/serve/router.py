@@ -112,6 +112,17 @@ class Endpoint:
     def drain(self) -> None:
         raise NotImplementedError
 
+    def fence(self, epoch: int) -> dict:
+        """Durably fence this node under ``epoch``: it refuses every
+        subsequent write until re-promoted (the ownership-handoff and
+        split-brain loser discipline)."""
+        raise NotImplementedError
+
+    def ingest(self, type_name: str, fc: dict,
+               deadline_ms: Optional[float] = None) -> dict:
+        """Write one GeoJSON FeatureCollection to this node."""
+        raise NotImplementedError
+
     # -- probing --------------------------------------------------------------
 
     def probe(self, ttl_s: Optional[float] = None,
@@ -263,6 +274,24 @@ class LocalEndpoint(Endpoint):
     def drain(self) -> None:
         self.store.scheduler().admission.drain(True)
 
+    def fence(self, epoch: int) -> dict:
+        from geomesa_tpu.replication import fence as _f
+        store = self.store
+        repl = getattr(store, "replication", None)
+        if repl is not None and hasattr(repl, "_fence_self"):
+            repl._fence_self(int(epoch))
+        else:
+            _f.save_epoch(store.durability.path, int(epoch))
+            store.durability.read_only = True
+        self.last_probe_ts = 0.0
+        return {"fenced": True, "epoch": int(epoch)}
+
+    def ingest(self, type_name, fc, deadline_ms=None) -> dict:
+        from geomesa_tpu.web.server import GeoJsonApi
+        api = GeoJsonApi(self.store)
+        written = api._ingest_geojson(type_name, fc)
+        return {"written": int(written)}
+
 
 class HttpEndpoint(Endpoint):
     """Remote node addressed by its REST base URL (web/server.py)."""
@@ -273,8 +302,13 @@ class HttpEndpoint(Endpoint):
         self.timeout_s = float(timeout_s)
 
     def _request(self, path: str, method: str = "GET",
-                 propagate: bool = False) -> dict:
-        req = urllib.request.Request(self.base + path, method=method)
+                 propagate: bool = False,
+                 body: Optional[bytes] = None,
+                 timeout_s: Optional[float] = None) -> dict:
+        req = urllib.request.Request(self.base + path, method=method,
+                                     data=body)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
         if propagate:
             # cross-process trace context: the remote node opens its
             # request trace as a child of the current span, so the
@@ -283,7 +317,9 @@ class HttpEndpoint(Endpoint):
             for k, v in _t.inject_headers().items():
                 req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            with urllib.request.urlopen(
+                    req, timeout=(timeout_s if timeout_s is not None
+                                  else self.timeout_s)) as r:
                 return json.loads(r.read().decode())
         except urllib.error.HTTPError as e:
             body = None
@@ -349,20 +385,45 @@ class HttpEndpoint(Endpoint):
     def drain(self) -> None:
         self._request("/replication/drain", method="POST")
 
+    def fence(self, epoch: int) -> dict:
+        out = self._request(f"/replication/fence?epoch={int(epoch)}",
+                            method="POST")
+        self.last_probe_ts = 0.0
+        return out
+
+    def ingest(self, type_name, fc, deadline_ms=None) -> dict:
+        path = f"/types/{type_name}/features"
+        if deadline_ms:
+            path += f"?deadline_ms={float(deadline_ms)}"
+        out = self._request(
+            path, method="POST",
+            body=json.dumps(fc).encode(),
+            timeout_s=(max(1.0, float(deadline_ms) / 1000.0 + 1.0)
+                       if deadline_ms else None))
+        return {"written": int(out.get("ingested", 0))}
+
 
 class ReplicaRouter:
     """Spread queries across primary + replicas; fail over reads around
     sick nodes; orchestrate promote-by-highest-acked-seq failover."""
 
     def __init__(self, endpoints: List[Endpoint],
-                 staleness_ms: Optional[float] = None):
+                 staleness_ms: Optional[float] = None,
+                 topology=None):
         self.endpoints: Dict[str, Endpoint] = {e.name: e for e in endpoints}
         self._staleness_ms = staleness_ms
+        # shard topology (cluster/cells.ShardCells): when present, reads
+        # scatter-gather across cells and writes route by key ownership
+        self.topology = topology
         self._lock = threading.Lock()
         self._rr = 0
         self._n_requests = 0
         self._n_failovers = 0
         self._n_promotions = 0
+        self._n_scatters = 0
+        self._n_partials = 0
+        self._n_shard_retries = 0
+        self._n_handoffs = 0
         # cell affinity: LRU-bounded cql -> Morton cell memo (a
         # high-cardinality filter stream evicts instead of growing or
         # clearing wholesale) + a short-TTL snapshot of the workload
@@ -392,8 +453,9 @@ class ReplicaRouter:
             out[name] = ep.probe()
         return out
 
-    def _primary(self) -> Optional[Endpoint]:
-        for ep in self.endpoints.values():
+    def _primary(self, eps: Optional[Dict[str, Endpoint]] = None) \
+            -> Optional[Endpoint]:
+        for ep in (eps or self.endpoints).values():
             p = ep.probe()
             if p is not None and p.get("role") == "primary" \
                     and not p.get("fenced"):
@@ -525,23 +587,279 @@ class ReplicaRouter:
         raise last if last is not None else NoEndpointAvailable(
             "no candidate endpoints")
 
+    # -- shard-aware scatter-gather -------------------------------------------
+
+    def _cell_members(self, shard: str) -> Dict[str, Endpoint]:
+        cell = self.topology.cell(shard)
+        return {n: self.endpoints[n] for n in cell.members
+                if n in self.endpoints}
+
+    def shard_candidates(self, shard: str,
+                         writes: bool = False) -> List[Endpoint]:
+        """Ordered members of one cell to try: healthy in rotation,
+        then demoted (the demoted-not-dropped tier — a stale follower
+        still answers when its cell's primary is gone). ``writes``
+        leads with the cell primary instead of rotating (only it can
+        accept mutations; followers stay as retry probes that surface
+        a just-promoted successor)."""
+        staleness = self._staleness()
+        healthy, demoted = [], []
+        for ep in self._cell_members(shard).values():
+            c = ep.classify(staleness)
+            if c == HEALTHY:
+                healthy.append(ep)
+            elif c == DEMOTED:
+                demoted.append(ep)
+        if writes:
+            healthy.sort(
+                key=lambda e: (e.last_probe or {}).get("role")
+                != "primary")
+            return healthy + demoted
+        with self._lock:
+            self._rr += 1
+            rot = self._rr
+        if healthy:
+            healthy = healthy[rot % len(healthy):] \
+                + healthy[:rot % len(healthy)]
+        return healthy + demoted
+
+    def scatter_shards(self, call, deadline_ms: Optional[float] = None,
+                       writes: bool = False):
+        """Run ``call(endpoint, budget_ms, shard)`` once per shard cell,
+        concurrently, with per-shard deadline budgets carved from the
+        request deadline (CELL_SHARD_BUDGET_FRACTION of the REMAINING
+        deadline per attempt, floored at CELL_SHARD_MIN_BUDGET_MS) and
+        partial-shard retry against the cell's remaining members.
+
+        Returns ``(results, meta)``: ``results`` maps shard -> the
+        call's value IN KEY-RANGE ORDER (so concatenating per-shard
+        payloads is the rank-order merge — the same discipline as
+        cluster/exec.ordered_merge), with None for a shard every member
+        refused; ``meta`` carries served_by/retries per shard."""
+        topo = self.topology
+        if topo is None:
+            raise ValueError("scatter_shards needs a shard topology")
+        with self._lock:
+            self._n_scatters += 1
+        _metrics.inc("router.scatters")
+        t0 = time.monotonic()
+        frac = float(config.CELL_SHARD_BUDGET_FRACTION.get())
+        floor_ms = float(config.CELL_SHARD_MIN_BUDGET_MS.get())
+        retry = bool(config.CELL_RETRY_FOLLOWERS.get())
+        results: Dict[str, object] = {c.shard: None for c in topo.cells}
+        meta: Dict[str, dict] = {c.shard: {"served_by": None,
+                                           "retries": 0,
+                                           "error": None}
+                                 for c in topo.cells}
+
+        def budget() -> Optional[float]:
+            if deadline_ms is None:
+                return None
+            remaining = float(deadline_ms) \
+                - (time.monotonic() - t0) * 1000.0
+            return max(floor_ms, remaining * frac)
+
+        def spent() -> bool:
+            return deadline_ms is not None and \
+                (time.monotonic() - t0) * 1000.0 >= float(deadline_ms)
+
+        def one_shard(shard: str) -> None:
+            cands = self.shard_candidates(shard, writes=writes)
+            if not retry:
+                cands = cands[:1]
+            for i, ep in enumerate(cands):
+                if i > 0 and spent():
+                    meta[shard]["error"] = "deadline"
+                    return
+                try:
+                    results[shard] = call(ep, budget(), shard)
+                    meta[shard]["served_by"] = ep.name
+                    meta[shard]["retries"] = i
+                    if i > 0:
+                        with self._lock:
+                            self._n_shard_retries += 1
+                        _metrics.inc("router.shard_retries")
+                    return
+                except EndpointDeadline as e:
+                    # terminal for the whole request's clock: another
+                    # member cannot beat a deadline that expired
+                    meta[shard]["error"] = f"deadline: {e}"
+                    return
+                except (EndpointDown, EndpointOverloaded) as e:
+                    if isinstance(e, EndpointDown):
+                        ep.last_probe = None
+                        ep.failures += 1
+                    _metrics.inc("router.endpoint_errors")
+                    meta[shard]["error"] = str(e)
+            if not cands:
+                meta[shard]["error"] = "no live member"
+
+        threads = [threading.Thread(target=one_shard, args=(c.shard,),
+                                    daemon=True) for c in topo.cells]
+        for th in threads:
+            th.start()
+        join_s = (float(deadline_ms) / 1000.0 + 5.0) \
+            if deadline_ms else 60.0
+        for th in threads:
+            th.join(timeout=max(0.1, join_s - (time.monotonic() - t0)))
+        return results, meta
+
+    def _partial_envelope(self, results: dict, meta: dict) -> dict:
+        """The explicit missing-shard contract: when a shard is truly
+        dark the answer says WHICH key range is absent instead of
+        silently undercounting."""
+        topo = self.topology
+        missing = [dict(topo.cell(s).summary(),
+                        error=meta[s].get("error"))
+                   for s, v in results.items() if v is None]
+        out = {"partial": bool(missing),
+               "shards": {s: {"value": v, **meta[s]}
+                          for s, v in results.items()}}
+        if missing:
+            out["missing_shards"] = missing
+            with self._lock:
+                self._n_partials += 1
+            _metrics.inc("router.partial_results")
+        return out
+
+    def count_scatter(self, type_name: str, cql: str = "INCLUDE",
+                      auths: Optional[list] = None,
+                      deadline_ms: Optional[float] = None,
+                      priority: str = "interactive",
+                      tenant: Optional[str] = None) -> dict:
+        """Scatter one count across every shard cell and sum. The
+        response envelope carries per-shard attribution and flips
+        ``partial: true`` + ``missing_shards`` when a cell is dark."""
+        results, meta = self.scatter_shards(
+            lambda ep, bdg, _s: int(ep.count(
+                type_name, cql, auths=auths, deadline_ms=bdg,
+                priority=priority, tenant=tenant)),
+            deadline_ms=deadline_ms)
+        env = self._partial_envelope(results, meta)
+        env["count"] = int(sum(v for v in results.values()
+                               if v is not None))
+        return env
+
+    def ingest_scatter(self, type_name: str, fc: dict,
+                       deadline_ms: Optional[float] = None) -> dict:
+        """Route one FeatureCollection's writes by Morton key ownership:
+        split the batch by each point's routing key (cells.geo_key),
+        send every sub-batch to its owning cell (primary-first, with
+        follower probes surfacing a just-promoted successor), and
+        report per-shard landings. A dark cell's sub-batch is refused
+        loudly in the envelope — never silently dropped."""
+        feats = fc.get("features", [])
+        if not feats:
+            return {"written": 0, "partial": False, "shards": {}}
+        from geomesa_tpu.cluster import cells as _cells
+        xs, ys = [], []
+        for f in feats:
+            g = f.get("geometry") or {}
+            if (g.get("type") or "Point").upper() != "POINT":
+                raise ValueError("shard-routed ingest supports Point "
+                                 "features (cells route by point key)")
+            xs.append(float(g["coordinates"][0]))
+            ys.append(float(g["coordinates"][1]))
+        owners = self.topology.route_points(xs, ys)
+        by_shard: Dict[str, list] = {}
+        for f, o in zip(feats, owners):
+            by_shard.setdefault(self.topology.cells[int(o)].shard,
+                                []).append(f)
+
+        def write(ep, bdg, shard):
+            feats_s = by_shard.get(shard)
+            if not feats_s:
+                # this cell owns no rows of the batch: nothing to send,
+                # and the shard is not "missing" — it was never addressed
+                return 0
+            out = ep.ingest(type_name,
+                            {"type": "FeatureCollection",
+                             "features": feats_s},
+                            deadline_ms=bdg)
+            return int(out.get("written", 0))
+
+        results, meta = self.scatter_shards(
+            write, deadline_ms=deadline_ms, writes=True)
+        env = self._partial_envelope(results, meta)
+        env["written"] = int(sum(v for v in results.values()
+                                 if v is not None))
+        env["routed"] = {s: len(v) for s, v in by_shard.items()}
+        return env
+
+    def shard_health(self) -> Dict[str, dict]:
+        """Per-shard endpoint health for the doctor's ``shard_dark``
+        rule: healthy/demoted/down member counts + the key range."""
+        if self.topology is None:
+            return {}
+        staleness = self._staleness()
+        out = {}
+        for cell in self.topology.cells:
+            states = {}
+            for name, ep in self._cell_members(cell.shard).items():
+                states[name] = ep.classify(staleness)
+            out[cell.shard] = {
+                "key_range": [int(cell.key_lo), int(cell.key_hi)],
+                "members": states,
+                "healthy": sum(1 for s in states.values()
+                               if s == HEALTHY),
+                "serving": sum(1 for s in states.values()
+                               if s in (HEALTHY, DEMOTED)),
+            }
+        return out
+
+    def handoff(self, shard: str, wait_s: Optional[float] = None) -> dict:
+        """Graceful ownership handoff inside one cell: drain + fence
+        the old owner BEFORE the successor accepts (cells.hand_off)."""
+        from geomesa_tpu.cluster import cells as _cells
+        eps = self._cell_members(shard)
+        for ep in eps.values():
+            ep.last_probe_ts = 0.0
+        old = self._primary(eps)
+        if old is None:
+            raise NoEndpointAvailable(f"shard {shard}: no live primary "
+                                      "to hand off from")
+        cands = sorted(
+            ((int((ep.probe() or {}).get("applied_seq") or 0), n, ep)
+             for n, ep in eps.items()
+             if ep is not old and ep.probe() is not None
+             and (ep.last_probe or {}).get("role") == "replica"),
+            reverse=True)
+        if not cands:
+            raise NoEndpointAvailable(f"shard {shard}: no live replica "
+                                      "to hand off to")
+        _seq, new_name, new = cands[0]
+        report = _cells.hand_off(old, new, wait_s=wait_s)
+        self.probe_all(force=True)
+        with self._lock:
+            self._n_handoffs += 1
+        _metrics.inc("router.handoffs")
+        return dict(report, shard=shard, old_owner=old.name,
+                    new_owner=new_name)
+
     # -- failover -------------------------------------------------------------
 
-    def promote(self, port: int = 0) -> dict:
+    def promote(self, port: int = 0,
+                shard: Optional[str] = None) -> dict:
         """Failover: drain the old primary (when reachable), promote the
         replica with the highest applied seq under a fresh fencing epoch,
         and report whether the whole operation landed inside the
-        configured failover deadline budget."""
+        configured failover deadline budget. ``shard`` scopes the whole
+        operation to ONE cell's members — in-cell failover never touches
+        the other shards' primaries."""
         t0 = time.monotonic()
-        self.probe_all(force=True)
-        old = self._primary()
+        eps = self.endpoints if shard is None \
+            else self._cell_members(shard)
+        for ep in eps.values():
+            ep.last_probe_ts = 0.0
+            ep.probe()
+        old = self._primary(eps)
         if old is not None:
             try:
                 old.drain()
             except Exception:
                 pass  # a dead primary cannot be drained — that's the point
         replicas = [(ep.last_probe.get("applied_seq") or 0, name, ep)
-                    for name, ep in self.endpoints.items()
+                    for name, ep in eps.items()
                     if ep.last_probe is not None
                     and ep.last_probe.get("role") == "replica"]
         if not replicas:
@@ -549,12 +867,15 @@ class ReplicaRouter:
         replicas.sort(reverse=True)
         seq, name, winner = replicas[0]
         result = winner.promote(port=port)
-        self.probe_all(force=True)
+        for ep in eps.values():
+            ep.last_probe_ts = 0.0
+            ep.probe()
         dur_ms = (time.monotonic() - t0) * 1000.0
         budget = float(config.REPL_FAILOVER_BUDGET_MS.get())
         self._n_promotions += 1
         _metrics.inc("router.promotions")
         return {"promoted": name, "acked_seq": seq, "result": result,
+                "shard": shard,
                 "old_primary": old.name if old is not None else None,
                 "duration_ms": round(dur_ms, 1),
                 "budget_ms": budget,
@@ -564,13 +885,17 @@ class ReplicaRouter:
 
     def stats(self) -> dict:
         staleness = self._staleness()
-        return {
+        out = {
             "staleness_ms": staleness,
             "requests": self._n_requests,
             "read_failovers": self._n_failovers,
             "promotions": self._n_promotions,
             "affinity_pins": self._n_affinity,
             "affinity_enabled": bool(config.AFFINITY_ENABLED.get()),
+            "scatters": self._n_scatters,
+            "partial_results": self._n_partials,
+            "shard_retries": self._n_shard_retries,
+            "handoffs": self._n_handoffs,
             "endpoints": {
                 name: {"state": ep.classify(staleness),
                        "role": ep.role,
@@ -578,6 +903,9 @@ class ReplicaRouter:
                        "probe": ep.last_probe}
                 for name, ep in self.endpoints.items()},
         }
+        if self.topology is not None:
+            out["topology"] = self.topology.summary()
+        return out
 
     def node_targets(self) -> Dict[str, Optional[str]]:
         """name -> base URL (None for in-process endpoints) — the node
@@ -616,10 +944,28 @@ class RouterApi:
                                              tree for global trace id G
                                              (+ the collected halves)
       GET /router                            router stats (states, probes)
+      GET /shards                            per-shard cell health (key
+                                             ranges, member states) when
+                                             a shard topology is set
       GET /metrics[?format=prometheus]       this router process's own
                                              registry
       GET /healthz                           router liveness + node id
-      POST /promote?port=                    router-orchestrated failover
+      POST /promote?port=[&shard=]           router-orchestrated failover
+                                             (scoped to one cell when a
+                                             ?shard= is named)
+      POST /handoff?shard=                   graceful ownership handoff:
+                                             drain + fence the old cell
+                                             owner before the successor
+                                             accepts writes
+      POST /types/{t}/features               shard-routed ingest: the
+                                             batch splits by Morton key
+                                             ownership and each sub-batch
+                                             lands on its owning cell
+
+    With a shard topology, GET count scatter-gathers across cells with
+    per-shard deadline budgets and answers with the partial-result
+    envelope (``partial: true`` + ``missing_shards``) when a cell is
+    dark, instead of a silent undercount.
     """
 
     def __init__(self, router: ReplicaRouter, federator=None):
@@ -633,13 +979,19 @@ class RouterApi:
             nodes.setdefault(_trace_mod().node_id(), None)  # self
             federator = _fed.Federator(nodes)
         self.federator = federator
+        if router.topology is not None:
+            # the router's own doctor watches the shard map it routes
+            # by: a cell with zero live endpoints opens one shard_dark
+            # incident naming the key range + last-known members
+            from geomesa_tpu.obs.doctor import DOCTOR
+            DOCTOR.attach_router(router)
 
     # returns (status, payload, headers) — payload bytes are replayed
     # verbatim (the error-envelope contract), dicts serialize as JSON
     def handle(self, method: str, path: str, query: dict,
-               headers=None):
+               headers=None, body: Optional[bytes] = None):
         try:
-            return self._route(method, path, query, headers)
+            return self._route(method, path, query, headers, body)
         except NoEndpointAvailable as e:
             return 503, {"error": str(e), "kind": "no_endpoint"}, {}
         except EndpointOverloaded as e:
@@ -663,7 +1015,7 @@ class RouterApi:
             return 500, {"error": str(e), "kind": "internal",
                          "type": type(e).__name__}, {}
 
-    def _route(self, method, path, query, headers):
+    def _route(self, method, path, query, headers, body=None):
         from geomesa_tpu import trace as _t
         from geomesa_tpu.metrics import REGISTRY as _reg
         from geomesa_tpu.obs import federation as _fed
@@ -721,7 +1073,36 @@ class RouterApi:
                          "traces": halves}, {}
         if parts == ["promote"] and method == "POST":
             port = int(query.get("port", [0])[0])
-            return 200, self.router.promote(port=port), {}
+            shard = query.get("shard", [None])[0]
+            return 200, self.router.promote(port=port, shard=shard), {}
+        if parts == ["shards"]:
+            if self.router.topology is None:
+                return 404, {"error": "router has no shard topology "
+                                      "(start with --shard)"}, {}
+            return 200, {"shards": self.router.shard_health()}, {}
+        if parts == ["handoff"] and method == "POST":
+            shard = query.get("shard", [None])[0]
+            if not shard:
+                return 400, {"error": "handoff needs ?shard="}, {}
+            wait = query.get("wait_s", [None])[0]
+            return 200, self.router.handoff(
+                shard, wait_s=float(wait) if wait else None), {}
+        if len(parts) == 3 and parts[0] == "types" \
+                and parts[2] == "features" and method == "POST":
+            if self.router.topology is None:
+                return 404, {"error": "shard-routed ingest needs a "
+                                      "shard topology (--shard)"}, {}
+            import json as _json
+            fc = _json.loads(body or b"{}")
+            raw_dl = query.get("deadline_ms", [None])[0]
+            if raw_dl is None and headers is not None:
+                raw_dl = headers.get("X-Deadline-Ms")
+            with _t.trace("router.ingest", type=parts[1]) as tr:
+                env = self.router.ingest_scatter(
+                    parts[1], fc,
+                    deadline_ms=float(raw_dl) if raw_dl else None)
+                env["trace"] = tr.global_id if tr is not None else None
+            return (202 if env.get("partial") else 200), env, {}
         if len(parts) == 3 and parts[0] == "types" \
                 and parts[2] == "count":
             t = parts[1]
@@ -741,6 +1122,13 @@ class RouterApi:
             # (HttpEndpoint.count) parents the remote half
             with _t.trace("router.count", type=t, filter=cql,
                           freshness=freshness) as tr:
+                if self.router.topology is not None:
+                    env = self.router.count_scatter(
+                        t, cql, auths=auths, deadline_ms=deadline_ms,
+                        priority=priority, tenant=tenant)
+                    env["trace"] = tr.global_id if tr is not None \
+                        else None
+                    return (202 if env.get("partial") else 200), env, {}
                 n = self.router.count(t, cql, auths=auths,
                                       deadline_ms=deadline_ms,
                                       priority=priority,
@@ -770,9 +1158,11 @@ def serve_router(router: ReplicaRouter, host: str = "127.0.0.1",
         def _serve(self, method):
             try:
                 u = urllib.parse.urlparse(self.path)
+                blen = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(blen) if blen else None
                 status, payload, extra = api.handle(
                     method, u.path, urllib.parse.parse_qs(u.query),
-                    headers=self.headers)
+                    headers=self.headers, body=body)
             except Exception as e:
                 status, payload, extra = 500, {"error": str(e),
                                                "kind": "internal"}, {}

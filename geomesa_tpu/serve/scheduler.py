@@ -604,7 +604,10 @@ class QueryScheduler:
 
     def healthy(self) -> bool:
         """True while both workers are alive and accepting work (the store
-        replaces an unhealthy scheduler on next access)."""
+        replaces an unhealthy scheduler on next access). Surfaced through
+        /healthz overload state, where the replica/shard router reads it:
+        a node whose scheduler died classifies DEMOTED — still a retry
+        candidate for its cell, never the first choice."""
         return (self._running and self._collector.is_alive()
                 and self._completer.is_alive())
 

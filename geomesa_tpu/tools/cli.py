@@ -596,6 +596,19 @@ def cmd_config(args):
         print(f"{name} = {d['value']}{mark}\n    {d['doc']}")
 
 
+def _configure_cell(spec: str, directory):
+    """Bind this process to its shard cell (``--cell SHARD=LO:HI``): the
+    ingest gate starts refusing out-of-range writes with 409 and the
+    cell fence persists its epoch under the durable directory."""
+    from geomesa_tpu.cluster import cells as _cells
+    topo = _cells.ShardCells.from_specs([spec])
+    _cells.CELLS.configure(topology=None, local=topo.cells[0],
+                           directory=directory)
+    print(json.dumps({"cell": topo.cells[0].summary(),
+                      "fence_epoch": _cells.CELLS.fence.epoch
+                      if _cells.CELLS.fence else None}), flush=True)
+
+
 def cmd_serve(args):
     from geomesa_tpu.web import serve
     if args.durable:
@@ -605,6 +618,9 @@ def cmd_serve(args):
         store = TpuDataStore.open(args.store)
     else:
         store = _load(args.store, must_exist=True)
+    if args.cell:
+        _configure_cell(args.cell,
+                        args.store if args.durable else None)
     if args.ship_port is not None:
         from geomesa_tpu.replication.shipper import LogShipper
         shipper = LogShipper(store, host=args.host, port=args.ship_port)
@@ -625,6 +641,8 @@ def cmd_replica(args):
 
     from geomesa_tpu.replication.follower import Follower
     from geomesa_tpu.web import serve
+    if args.cell:
+        _configure_cell(args.cell, args.dir)
     f = Follower(args.dir, args.follow, follower_id=args.id)
     print(json.dumps({"replica": f.id, "dir": args.dir,
                       "following": args.follow}), flush=True)
@@ -658,7 +676,11 @@ def cmd_router(args):
         base = addr if addr.startswith("http") else f"http://{addr}"
         eps.append(HttpEndpoint(name, base))
         nodes[name] = base
-    router = ReplicaRouter(eps)
+    topology = None
+    if getattr(args, "shard", None):
+        from geomesa_tpu.cluster.cells import ShardCells
+        topology = ShardCells.from_specs(args.shard)
+    router = ReplicaRouter(eps, topology=topology)
     nodes[_t.node_id()] = None  # federate this router's own counters too
     fed = _fed.configure(nodes)
     print(json.dumps({"router": f"http://{args.host}:{args.port}",
@@ -721,6 +743,21 @@ def cmd_soak(args):
     board = soakfleet.run(mini=args.mini, scoreboard_path=args.scoreboard,
                           base_dir=args.dir, halves=halves)
     print(soakfleet.render_scoreboard(board))
+    if not board.get("ok"):
+        raise SystemExit(2)
+
+
+def cmd_soakcells(args):
+    """Run the cluster chaos soak: two replicated shard cells plus a
+    shard-aware router as subprocesses, shard-routed writes and
+    scatter-gather reads, then the cluster chaos timeline (cell
+    failover, mid-ingest handoff, split-brain refusal, shard_dark).
+    Exits nonzero when any scoreboard check fails."""
+    from geomesa_tpu.obs import soakcells
+    halves = ("chaos", "clean") if args.half == "both" else (args.half,)
+    board = soakcells.run(mini=args.mini, scoreboard_path=args.scoreboard,
+                          base_dir=args.dir, halves=halves)
+    print(soakcells.render_scoreboard(board))
     if not board.get("ok"):
         raise SystemExit(2)
 
@@ -964,6 +1001,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also start the replication log shipper on this "
                          "port (0 = ephemeral); followers connect with "
                          "`geomesa-tpu replica --follow host:port`")
+    sp.add_argument("--cell", default=None, metavar="SHARD=LO:HI",
+                    help="bind this node to a shard cell: ingests whose "
+                         "routing key falls outside [LO,HI] are refused "
+                         "with 409 not_owner; the cell fence epoch "
+                         "persists under the durable dir")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
@@ -978,6 +1020,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(repeatable; NAME= optional)")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8760)
+    sp.add_argument("--shard", action="append", default=None,
+                    metavar="SHARD=LO:HI=MEMBER[,MEMBER...]",
+                    help="one shard cell's key range + member endpoint "
+                         "names (repeatable). With a topology the router "
+                         "scatter-gathers counts across cells, routes "
+                         "writes by key ownership, and serves /shards + "
+                         "/handoff")
     sp.set_defaults(fn=cmd_router)
 
     sp = sub.add_parser(
@@ -1024,6 +1073,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_soak)
 
     sp = sub.add_parser(
+        "soakcells",
+        help="cluster chaos soak: two replicated shard cells + a "
+             "shard-aware router as subprocesses, shard-routed writes, "
+             "scatter-gather reads, cell failover / handoff / "
+             "split-brain / shard_dark chaos, scored scoreboard")
+    sp.add_argument("--mini", action="store_true",
+                    help="CI-sized run (short phases)")
+    sp.add_argument("--scoreboard", default=None, metavar="PATH",
+                    help="scoreboard JSON path (default "
+                         "SOAKCELLS_scoreboard.json)")
+    sp.add_argument("--half", choices=("both", "chaos", "clean"),
+                    default="both",
+                    help="run only one half (default: both)")
+    sp.add_argument("--dir", default=None,
+                    help="scratch directory for the cells' durable "
+                         "stores (default: a temp dir)")
+    sp.set_defaults(fn=cmd_soakcells)
+
+    sp = sub.add_parser(
         "cluster-dryrun",
         help="2-process CPU cluster dryrun: spawn worker subprocesses, "
              "shard one table across them by Morton key-range, check "
@@ -1058,6 +1126,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=0,
                     help="serve the read-only REST API here (0 = no HTTP)")
+    sp.add_argument("--cell", default=None, metavar="SHARD=LO:HI",
+                    help="bind this replica to its shard cell (see "
+                         "`serve --cell`); on promote it inherits the "
+                         "cell's ingest gate + fence")
     sp.set_defaults(fn=cmd_replica)
 
     return p

@@ -172,6 +172,7 @@ class GeoJsonApi:
                body: Optional[bytes] = None,
                headers=None) -> Tuple[int, object]:
         from geomesa_tpu import trace as _trace
+        from geomesa_tpu.cluster.cells import NotOwnedError
         from geomesa_tpu.index.guards import QueryGuardError, QueryTimeout
         from geomesa_tpu.replication.fence import FencedError
         from geomesa_tpu.serve.resilience import deadline as _rdl
@@ -198,6 +199,10 @@ class GeoJsonApi:
             return 504, {"error": str(e), "kind": "deadline"}
         except FencedError as e:      # read-only replica / fenced ex-primary
             return 403, {"error": str(e), "kind": "fenced"}
+        except NotOwnedError as e:    # write routed to the wrong cell
+            return 409, {"error": str(e), "kind": "not_owner",
+                         "cell": e.cell, "owner": e.owner,
+                         "key": e.key}
         except QueryGuardError as e:  # an interceptor vetoed the query
             return 400, {"error": str(e), "kind": "guard"}
         except (KeyError, ValueError, TypeError, IndexError,
@@ -369,6 +374,12 @@ class GeoJsonApi:
             # router. A cluster shard can still have read replicas.)
             from geomesa_tpu.cluster.runtime import runtime as _cluster_rt
             return 200, _cluster_rt(init=False).state()
+        if parts == ["cells"]:
+            # the shard-cell plane: which cell this node serves (key
+            # range, fencing epoch, ingest-gate counters) + the fleet
+            # topology when one was configured
+            from geomesa_tpu.cluster import cells as _cells
+            return 200, _cells.CELLS.state()
         if parts == ["healthz"]:
             import jax
             report = getattr(self.store, "recovery_report", None)
@@ -452,6 +463,13 @@ class GeoJsonApi:
                                  for a in sft.attributes],
                              "count": count}
             if rest == ["count"]:
+                # a freshly provisioned type (schema, zero rows) counts
+                # as 0 — a 4xx here would read as node death to the
+                # shard router and mark a healthy empty cell dark
+                d = self.store.deltas.get(t)
+                if self.store.tables.get(t) is None and \
+                        (d is None or len(d) == 0):
+                    return 200, {"count": 0}
                 # coalesced: concurrent counts micro-batch into shared
                 # fused device dispatches (serve/scheduler.py); the ambient
                 # request deadline propagates through the scheduler and an
@@ -530,6 +548,11 @@ class GeoJsonApi:
                                      replica) to primary under a fresh
                                      fencing epoch; ?port= picks the new
                                      shipper port (0 = ephemeral)
+          POST /replication/fence    durably fence THIS node under
+                                     ?epoch= (ownership handoff: the old
+                                     owner refuses every write until
+                                     re-promoted; survives restart via
+                                     the persisted epoch file)
         """
         repl = getattr(self.store, "replication", None)
         if not rest:
@@ -549,6 +572,20 @@ class GeoJsonApi:
             shipper = target.promote(port=port)
             return 200, {"role": "primary", "epoch": shipper.epoch,
                          "address": shipper.address}
+        if rest == ["fence"] and method == "POST":
+            from geomesa_tpu.cluster import cells as _cells
+            from geomesa_tpu.replication import fence as _f
+            epoch = int(query.get("epoch", [0])[0])
+            store = self.store
+            if repl is not None and hasattr(repl, "_fence_self"):
+                repl._fence_self(epoch)
+            else:
+                _f.save_epoch(store.durability.path, epoch)
+                store.durability.read_only = True
+            if _cells.CELLS.fence is not None:
+                _cells.CELLS.fence.epoch = max(
+                    _cells.CELLS.fence.epoch, epoch)
+            return 200, {"fenced": True, "epoch": epoch}
         return 404, {"error": f"no route {method} /replication/"
                               f"{'/'.join(rest)}"}
 
@@ -556,6 +593,18 @@ class GeoJsonApi:
         feats = fc.get("features", [])
         if not feats:
             return 0
+        from geomesa_tpu.cluster import cells as _cells
+        if _cells.CELLS.active():
+            # shard-cell ownership gate: every point's routing key must
+            # fall in this node's cell range BEFORE anything is written
+            # (atomic refusal — a misrouted batch lands zero rows)
+            pts = [f.get("geometry", {}).get("coordinates")
+                   for f in feats
+                   if (f.get("geometry", {}).get("type") or
+                       "Point").upper() == "POINT"]
+            if pts:
+                _cells.CELLS.ensure_owned([p[0] for p in pts],
+                                          [p[1] for p in pts])
         sft = self.store.get_schema(t)
         with self.store.get_writer(t) as w:
             for f in feats:

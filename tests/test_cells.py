@@ -1,0 +1,467 @@
+"""Shard cells: ownership map, fencing admit matrix, handoff and the
+shard-aware scatter-gather router (cluster/cells.py + serve/router.py).
+
+The cross-cell fencing edges here are the split-brain contract's fine
+print: a stale epoch from a DIFFERENT cell must be rejected WITHOUT
+fencing the receiver, and a cell's fencing epoch must survive a process
+restart (replication/fence.py persistence) so a handoff can never be
+undone by a reboot.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.cluster.cells import (
+    ADMIT_ADOPT,
+    ADMIT_OK,
+    REJECT_FOREIGN,
+    REJECT_STALE,
+    CellFence,
+    CellInfo,
+    CellRegistry,
+    NotOwnedError,
+    ShardCells,
+    geo_key,
+    hand_off,
+    pack_cell_frame,
+    unpack_cell_frame,
+)
+from geomesa_tpu.replication import fence as repl_fence
+from geomesa_tpu.serve.router import (
+    Endpoint,
+    EndpointDown,
+    ReplicaRouter,
+)
+
+
+# -- geo_key ------------------------------------------------------------------
+
+
+class TestGeoKey:
+    def test_hemisphere_split(self):
+        # bits=8 -> 16-bit keys; lon is the MAJOR interleave bit, so
+        # the top bit of the key is exactly the east/west split
+        keys = geo_key([-10.0, -0.1, 0.0, 10.0], [0.0] * 4, bits=8)
+        mid = 1 << 15
+        assert keys[0] < mid and keys[1] < mid
+        assert keys[2] >= mid and keys[3] >= mid
+
+    def test_deterministic_and_vectorized(self):
+        xs = np.linspace(-170, 170, 50)
+        ys = np.linspace(-80, 80, 50)
+        a = geo_key(xs, ys, bits=8)
+        b = geo_key(xs, ys, bits=8)
+        assert a.shape == (50,)
+        assert np.array_equal(a, b)
+
+    def test_clips_out_of_range_coords(self):
+        keys = geo_key([-500.0, 500.0], [-500.0, 500.0], bits=8)
+        lo = geo_key([-180.0], [-90.0], bits=8)[0]
+        hi = geo_key([179.99], [89.99], bits=8)[0]
+        assert keys[0] == lo and keys[1] == hi
+
+    def test_bits_clamped(self):
+        k = geo_key([0.0], [0.0], bits=99)
+        assert 0 <= int(k[0]) < (1 << 32)
+
+
+# -- ShardCells ---------------------------------------------------------------
+
+
+def _two_cells():
+    mid = 1 << 15
+    return ShardCells([
+        CellInfo("s0", 0, mid - 1, ["s0p", "s0r"]),
+        CellInfo("s1", mid, (1 << 16) - 1, ["s1p", "s1r"]),
+    ])
+
+
+class TestShardCells:
+    def test_route_and_owner(self):
+        cells = _two_cells()
+        mid = 1 << 15
+        idx = cells.route([0, mid - 1, mid, mid + 5])
+        assert idx.tolist() == [0, 0, 1, 1]
+        assert cells.owner_of(3).shard == "s0"
+        assert cells.owner_of(mid).shard == "s1"
+
+    def test_edge_keys_clamp_to_edge_cells(self):
+        cells = _two_cells()
+        # keys outside every declared range still have exactly one owner
+        assert cells.owner_of(-1).shard == "s0"
+        assert cells.owner_of(1 << 40).shard == "s1"
+
+    def test_route_points_matches_geo_key(self):
+        cells = _two_cells()
+        xs = [-10.0, 10.0]
+        ys = [5.0, -5.0]
+        idx = cells.route_points(xs, ys)
+        assert idx.tolist() == cells.route(geo_key(xs, ys)).tolist()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="at least one"):
+            ShardCells([])
+        with pytest.raises(ValueError, match="duplicate shard"):
+            ShardCells([CellInfo("a", 0, 1), CellInfo("a", 2, 3)])
+        with pytest.raises(ValueError, match="key_hi"):
+            ShardCells([CellInfo("a", 5, 1)])
+        with pytest.raises(ValueError, match="share key_lo"):
+            ShardCells([CellInfo("a", 0, 9), CellInfo("b", 0, 9)])
+
+    def test_from_specs(self):
+        cells = ShardCells.from_specs(
+            ["s0=0:99=n0,n1", "s1=100:199"])
+        assert cells.cell("s0").members == ["n0", "n1"]
+        assert cells.cell("s1").members == []
+        assert cells.cell("s1").key_lo == 100
+        with pytest.raises(ValueError, match="bad shard spec"):
+            ShardCells.from_specs(["nonsense"])
+        with pytest.raises(ValueError, match="bad shard spec"):
+            ShardCells.from_specs(["s0=whoops"])
+
+    def test_from_key_ranges_order_is_shard_id(self):
+        cells = ShardCells.from_key_ranges(
+            [(0, 9), (10, 19)], members={"1": ["b"]})
+        assert [c.shard for c in cells.cells] == ["0", "1"]
+        assert cells.cell("1").members == ["b"]
+
+    def test_summary_shape(self):
+        s = _two_cells().summary()
+        assert [c["shard"] for c in s["shards"]] == ["s0", "s1"]
+        assert s["shards"][0]["key_range"] == [0, (1 << 15) - 1]
+
+    def test_unknown_shard(self):
+        with pytest.raises(KeyError):
+            _two_cells().cell("nope")
+
+
+# -- CellFence: the per-cell admit matrix -------------------------------------
+
+
+class TestCellFence:
+    def test_admit_matrix(self, tmp_path):
+        f = CellFence("s0", str(tmp_path))
+        e = f.bump(at_least=5)  # strictly above at_least: 6
+        assert e == 6
+        assert f.admit("s0", e) == ADMIT_OK
+        assert f.admit("s0", e + 2) == ADMIT_ADOPT
+        assert f.epoch == e + 2
+        assert f.admit("s0", e + 1) == REJECT_STALE
+        assert f.stale_rejects == 1
+
+    def test_foreign_frame_rejected_without_fencing_receiver(
+            self, tmp_path):
+        """Satellite edge: a stale epoch from a DIFFERENT cell must be
+        dropped without touching the receiver's epoch — cross-cell
+        traffic can never fence a healthy owner."""
+        f = CellFence("s0", str(tmp_path))
+        e = f.bump(at_least=3)
+        # even a HIGHER epoch from another cell must not be adopted
+        assert f.admit("s1", 99) == REJECT_FOREIGN
+        assert f.admit("s1", 1) == REJECT_FOREIGN
+        assert f.epoch == e
+        assert f.foreign_rejects == 2
+        # ...and nothing was persisted for the foreign epoch
+        assert repl_fence.load_epoch(str(tmp_path)) == e
+
+    def test_epoch_persists_across_restart(self, tmp_path):
+        """Satellite edge: fencing epochs survive a handoff restart —
+        a rebooted old owner reloads the epoch that fenced it and still
+        refuses the stale world."""
+        f1 = CellFence("s0", str(tmp_path))
+        f1.admit("s0", 4)  # adopt persists durably
+        assert f1.epoch == 4
+        f2 = CellFence("s0", str(tmp_path))  # "restart"
+        assert f2.epoch == 4
+        assert f2.admit("s0", 3) == REJECT_STALE
+        assert f2.admit("s0", 4) == ADMIT_OK
+
+    def test_stats(self, tmp_path):
+        f = CellFence("s0", str(tmp_path))
+        s = f.stats()
+        assert s["cell"] == "s0" and s["epoch"] == 0
+        assert s["stale_rejects"] == 0 and s["foreign_rejects"] == 0
+
+
+# -- cell frame envelope ------------------------------------------------------
+
+
+class TestCellFrame:
+    def test_roundtrip(self):
+        data = pack_cell_frame("s0", 7, b"\x01\x02payload")
+        cell, epoch, frame = unpack_cell_frame(data)
+        assert (cell, epoch, frame) == ("s0", 7, b"\x01\x02payload")
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="magic"):
+            unpack_cell_frame(b"XXXX" + b"\x00" * 20)
+
+    def test_truncated(self):
+        data = pack_cell_frame("shard-name", 1, b"abc")
+        with pytest.raises(ValueError):
+            unpack_cell_frame(data[:15])
+
+
+# -- hand_off -----------------------------------------------------------------
+
+
+class _FakeOwner:
+    """Duck-typed Endpoint surface for hand_off: records the call
+    order so the fence-before-promote discipline is checkable."""
+
+    def __init__(self, log, name, applied_seq=0, epoch=1):
+        self.log = log
+        self.name = name
+        self.applied_seq = applied_seq
+        self.epoch = epoch
+        self.last_probe_ts = 0.0
+        self.fenced_at = None
+
+    def drain(self):
+        self.log.append((self.name, "drain"))
+
+    def probe(self):
+        self.log.append((self.name, "probe"))
+        return {"applied_seq": self.applied_seq, "epoch": self.epoch}
+
+    def fence(self, epoch):
+        self.log.append((self.name, "fence", epoch))
+        self.fenced_at = epoch
+
+    def promote(self, port=0):
+        self.log.append((self.name, "promote"))
+        return {"role": "primary", "epoch": self.epoch + 1,
+                "address": "127.0.0.1:0"}
+
+
+class TestHandOff:
+    def test_fence_before_promote(self):
+        log = []
+        old = _FakeOwner(log, "old", applied_seq=10, epoch=3)
+        new = _FakeOwner(log, "new", applied_seq=10, epoch=3)
+        rep = hand_off(old, new, wait_s=1.0)
+        assert rep["caught_up"] is True
+        assert rep["head_seq"] == 10
+        assert old.fenced_at == 4  # old_epoch + 1
+        assert rep["epoch"] == 4
+        ops = [(n, op) for n, op, *_ in log]
+        assert ops.index(("old", "fence")) < ops.index(("new", "promote"))
+
+    def test_laggy_successor_not_caught_up(self):
+        log = []
+        old = _FakeOwner(log, "old", applied_seq=10)
+        new = _FakeOwner(log, "new", applied_seq=3)
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.5
+            return t[0]
+
+        rep = hand_off(old, new, wait_s=1.0, clock=clock)
+        assert rep["caught_up"] is False
+        assert rep["promoted"]["role"] == "primary"  # promote still runs
+
+    def test_dead_old_owner_still_promotes(self):
+        log = []
+        old = _FakeOwner(log, "old", applied_seq=0)
+        old.fence = lambda epoch: (_ for _ in ()).throw(OSError("down"))
+        old.drain = lambda: (_ for _ in ()).throw(OSError("down"))
+        new = _FakeOwner(log, "new", applied_seq=0)
+        rep = hand_off(old, new, wait_s=0.2)
+        assert rep["promoted"]["role"] == "primary"
+
+
+# -- CellRegistry: the ingest ownership gate ----------------------------------
+
+
+class TestCellRegistry:
+    def test_inactive_is_noop(self):
+        reg = CellRegistry()
+        assert reg.ensure_owned([0.0], [0.0]) == 0
+        assert reg.state()["active"] is False
+
+    def test_gate_accepts_owned_rows(self):
+        reg = CellRegistry()
+        topo = _two_cells()
+        reg.configure(topology=topo, local=topo.cell("s0"))
+        assert reg.ensure_owned([-10.0, -5.0], [0.0, 1.0]) == 0
+        assert reg.gate_rows == 2 and reg.gate_refusals == 0
+
+    def test_gate_refuses_foreign_rows_naming_owner(self):
+        reg = CellRegistry()
+        topo = _two_cells()
+        reg.configure(topology=topo, local=topo.cell("s0"))
+        with pytest.raises(NotOwnedError) as ei:
+            reg.ensure_owned([-10.0, 10.0], [0.0, 0.0])
+        assert ei.value.cell == "s0"
+        assert ei.value.owner == "s1"
+        assert reg.gate_refusals == 1
+
+    def test_gate_counts_but_accepts_when_enforce_off(self):
+        reg = CellRegistry()
+        topo = _two_cells()
+        reg.configure(topology=topo, local=topo.cell("s0"))
+        config.CELL_ENFORCE.set(False)
+        try:
+            assert reg.ensure_owned([10.0], [0.0]) == 1
+        finally:
+            config.CELL_ENFORCE.unset()
+        assert reg.gate_refusals == 1
+
+    def test_state_shape(self, tmp_path):
+        reg = CellRegistry()
+        topo = _two_cells()
+        reg.configure(topology=topo, local=topo.cell("s1"),
+                      directory=str(tmp_path))
+        st = reg.state()
+        assert st["active"] is True
+        assert st["local"]["shard"] == "s1"
+        assert st["fence"]["cell"] == "s1"
+        assert [c["shard"] for c in st["topology"]["shards"]] \
+            == ["s0", "s1"]
+        assert st["gate"]["enforce"] is True
+
+
+# -- shard-aware scatter-gather router ----------------------------------------
+
+
+class StubEndpoint(Endpoint):
+    """In-memory node: healthy by default, scriptable into a dead or
+    fenced member for the retry/partial envelope drills."""
+
+    def __init__(self, name, role="follower", count_value=0,
+                 down=False, fenced=False):
+        super().__init__(name)
+        self._role = role  # Endpoint.role is a read-only property
+        self.count_value = count_value
+        self.down = down
+        self.fenced = fenced
+        self.counts = 0
+        self.ingested = []
+
+    def _probe(self):
+        if self.down:
+            raise ConnectionError("down")
+        return {"id": self.name, "role": self._role, "lag_ms": 0.0,
+                "applied_seq": 0, "epoch": 1, "fenced": self.fenced,
+                "scheduler_ok": True}
+
+    def count(self, type_name, cql="INCLUDE", auths=None,
+              deadline_ms=None, priority="interactive", tenant=None):
+        if self.down:
+            raise EndpointDown(f"{self.name} down")
+        self.counts += 1
+        return self.count_value
+
+    def ingest(self, type_name, fc, deadline_ms=None):
+        if self.down or self.fenced:
+            raise EndpointDown(f"{self.name} refuses writes")
+        feats = fc.get("features", [])
+        self.ingested.extend(feats)
+        return {"written": len(feats)}
+
+
+def _stub_fleet(**overrides):
+    eps = {
+        "s0p": StubEndpoint("s0p", role="primary", count_value=10),
+        "s0r": StubEndpoint("s0r", count_value=10),
+        "s1p": StubEndpoint("s1p", role="primary", count_value=5),
+        "s1r": StubEndpoint("s1r", count_value=5),
+    }
+    for name, kw in overrides.items():
+        for k, v in kw.items():
+            setattr(eps[name], k, v)
+    router = ReplicaRouter(list(eps.values()), topology=_two_cells())
+    return router, eps
+
+
+class TestScatterGather:
+    def test_count_scatter_sums_all_shards(self):
+        router, _ = _stub_fleet()
+        env = router.count_scatter("t")
+        assert env["count"] == 15
+        assert env["partial"] is False
+        assert set(env["shards"]) == {"s0", "s1"}
+
+    def test_partial_envelope_names_missing_key_range(self):
+        router, _ = _stub_fleet(s1p={"down": True}, s1r={"down": True})
+        env = router.count_scatter("t")
+        assert env["partial"] is True
+        assert env["count"] == 10  # the live shard still answers
+        missing = env["missing_shards"]
+        assert len(missing) == 1
+        assert missing[0]["shard"] == "s1"
+        assert missing[0]["key_range"] == [1 << 15, (1 << 16) - 1]
+        assert missing[0]["members"] == ["s1p", "s1r"]
+
+    def test_follower_retry_on_primary_death(self):
+        # pin the candidate order: the fenced follower is DEMOTED so
+        # the healthy primary is deterministically tried first, dies
+        # mid-call, and the demoted member absorbs the retry
+        router, eps = _stub_fleet(s0r={"fenced": True})
+
+        def dying(*a, **k):
+            raise EndpointDown("mid-call death")
+
+        eps["s0p"].count = dying
+        env = router.count_scatter("t")
+        assert env["partial"] is False
+        s0 = env["shards"]["s0"]
+        assert s0["served_by"] == "s0r"
+        assert s0["retries"] == 1
+
+    def test_fenced_member_demoted_not_dropped(self):
+        # the fenced loser is DEMOTED: still a read candidate of last
+        # resort when the rest of its cell is gone
+        router, eps = _stub_fleet(s1p={"down": True},
+                                  s1r={"fenced": True})
+        env = router.count_scatter("t")
+        assert env["partial"] is False
+        assert env["shards"]["s1"]["served_by"] == "s1r"
+
+    def test_ingest_scatter_routes_by_hemisphere(self):
+        router, eps = _stub_fleet()
+        fc = {"type": "FeatureCollection", "features": [
+            {"geometry": {"type": "Point", "coordinates": [x, 0.0]},
+             "properties": {}}
+            for x in (-10.0, -5.0, 5.0, 10.0, 15.0)]}
+        env = router.ingest_scatter("t", fc)
+        assert env["written"] == 5
+        assert env["partial"] is False
+        assert env["routed"] == {"s0": 2, "s1": 3}
+        # writes land on the cell PRIMARY, never a follower
+        assert len(eps["s0p"].ingested) == 2
+        assert len(eps["s1p"].ingested) == 3
+        assert not eps["s0r"].ingested and not eps["s1r"].ingested
+
+    def test_ingest_scatter_dark_cell_refused_loudly(self):
+        router, _ = _stub_fleet(s0p={"down": True}, s0r={"down": True})
+        fc = {"type": "FeatureCollection", "features": [
+            {"geometry": {"type": "Point", "coordinates": [x, 0.0]},
+             "properties": {}}
+            for x in (-10.0, 10.0)]}
+        env = router.ingest_scatter("t", fc)
+        assert env["partial"] is True
+        assert env["written"] == 1  # the live cell's half landed
+        assert [m["shard"] for m in env["missing_shards"]] == ["s0"]
+
+    def test_ingest_scatter_rejects_non_point(self):
+        router, _ = _stub_fleet()
+        fc = {"features": [{"geometry": {
+            "type": "Polygon", "coordinates": []}}]}
+        with pytest.raises(ValueError, match="Point"):
+            router.ingest_scatter("t", fc)
+
+    def test_shard_health_shape(self):
+        router, _ = _stub_fleet(s1r={"down": True})
+        h = router.shard_health()
+        assert h["s0"]["healthy"] == 2
+        assert h["s0"]["key_range"] == [0, (1 << 15) - 1]
+        assert h["s1"]["members"]["s1r"] == "down"
+        assert h["s1"]["serving"] == 1
+
+    def test_scatter_requires_topology(self):
+        router = ReplicaRouter([StubEndpoint("a")])
+        with pytest.raises(ValueError, match="topology"):
+            router.scatter_shards(lambda ep, b, s: 1)

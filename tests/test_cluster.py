@@ -208,3 +208,32 @@ def test_dryrun_fleet_and_observability(dryrun):
         assert st["active"] and st["num_processes"] == 2
         assert st["mesh"]["devices"] == 4  # 2 procs x 2 virtual devices
         assert st["tables"]  # ownership registered for the type
+
+
+def test_dryrun_cluster_knn_is_exact_and_rounds_bounded(dryrun):
+    """Cluster KNN via bounded radius exchange: every rank's answer
+    byte-equals the single-process brute-force oracle, and every query
+    counted its collective rounds under the CELL_KNN_MAX_ROUNDS cap."""
+    assert dryrun["checks"]["knn_exact"], json.dumps(
+        dryrun["checks"], indent=1)
+    assert dryrun["checks"]["knn_rounds_bounded"]
+    from geomesa_tpu import config
+    cap = max(2, int(config.CELL_KNN_MAX_ROUNDS.get()))
+    for r in dryrun["ranks"]:
+        rounds = r["knn"]["rounds"]
+        assert rounds, "no per-query round ledger in the knn report"
+        assert all(0 < v <= cap for v in rounds.values()), rounds
+
+
+def test_dryrun_writes_route_to_the_owning_shard(dryrun):
+    """Distributed durable ingest: each rank persisted exactly the rows
+    the Morton ownership map assigns it (strict subset — no rank took
+    everything), and the post-ingest table byte-equals the oracle that
+    ingested the same rows single-process."""
+    ch = dryrun["checks"]
+    assert ch["write_landed_on_owner"], json.dumps(ch, indent=1)
+    assert ch["write_strict_subset"]
+    assert ch["write_post_equal"]
+    ingested = [r["write"]["ingested"] for r in dryrun["ranks"]]
+    total = sum(ingested)
+    assert all(0 < i < total for i in ingested), ingested

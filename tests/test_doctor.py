@@ -41,13 +41,15 @@ class _NoWorkload:
         return []
 
 
-def _mk_doctor(reg, clock, slo_engine=None, workload=None, store=None):
+def _mk_doctor(reg, clock, slo_engine=None, workload=None, store=None,
+               router=None):
     eng = slo_engine if slo_engine is not None \
         else SloEngine(registry=reg, clock=clock)
     return DoctorEngine(
         registry=reg, clock=clock, slo_engine=eng, federator=False,
         workload=workload or _NoWorkload(),
-        store=store or IncidentStore(journal_path="", registry=reg))
+        store=store or IncidentStore(journal_path="", registry=reg),
+        router=router)
 
 
 _KNOBS = (config.DOCTOR_ENABLED, config.DOCTOR_WINDOW_S,
@@ -338,7 +340,8 @@ def test_verdict_is_one_line_with_suspect_and_trace():
     assert set(RULES) == {"slo_burn", "replication_lag", "recompile_churn",
                           "shed_storm", "breaker_flapping",
                           "wal_fsync_stall", "hot_skew", "reindex_churn",
-                          "shard_imbalance", "collective_straggler"}
+                          "shard_imbalance", "collective_straggler",
+                          "shard_dark"}
 
 
 # -- journal: rotation + replay (satellite) -----------------------------------
@@ -572,3 +575,96 @@ def test_doctor_soak_clean_run_opens_zero_incidents(tmp_path):
     assert report["ok"], json.dumps(
         report.get("incidents"), default=str)
     assert report["opened_total"] == 0
+
+
+# -- shard_dark: a dark shard cell in the scatter-gather topology -------------
+
+
+class _StubShardRouter:
+    """The surface _check_shard_dark consumes: a topology marker plus
+    per-shard health rows (serve/router.ReplicaRouter.shard_health)."""
+
+    def __init__(self, health):
+        self.topology = object()
+        self._health = health
+
+    def shard_health(self):
+        return self._health
+
+
+def _dark_health(serving_s0=0):
+    return {
+        "s0": {"key_range": [0, 32767],
+               "members": {"s0p": "down", "s0r": "down"},
+               "healthy": 0, "serving": serving_s0},
+        "s1": {"key_range": [32768, 65535],
+               "members": {"s1p": "healthy", "s1r": "healthy"},
+               "healthy": 2, "serving": 2},
+    }
+
+
+def test_shard_dark_fires_once_names_range_and_members():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    health = _dark_health(serving_s0=0)
+    doc = _mk_doctor(reg, clock, router=_StubShardRouter(health))
+    out = doc.evaluate()
+    (alert,) = out["alerts"]
+    assert alert["rule"] == "shard_dark"
+    assert alert["severity"] == "page"
+    assert alert["cause"] == "shard:s0"
+    # the page carries exactly what the operator must respawn
+    assert alert["suspect"] == {"shard": "s0",
+                                "key_range": [0, 32767],
+                                "members": ["s0p", "s0r"]}
+    assert len(out["incidents"]) == 1
+    inc = out["incidents"][0]
+    # still dark on the next tick: deduped onto the same incident
+    clock.advance(1)
+    out = doc.evaluate()
+    assert [i["id"] for i in out["incidents"]] == [inc["id"]]
+    assert len(doc.store.all()) == 1
+
+
+def test_shard_dark_resolves_when_a_member_returns():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    config.DOCTOR_CLEAR_TICKS.set(2)
+    health = _dark_health(serving_s0=0)
+    doc = _mk_doctor(reg, clock, router=_StubShardRouter(health))
+    (inc,) = doc.evaluate()["incidents"]
+    health["s0"]["serving"] = 1        # one member respawned
+    clock.advance(1)
+    assert doc.evaluate()["resolved"] == []   # streak 1 of 2
+    clock.advance(1)
+    assert doc.evaluate()["resolved"] == [inc["id"]]
+    assert not doc.store.active()
+
+
+def test_shard_dark_demoted_member_still_counts_as_serving():
+    # a fenced/stale member is DEMOTED, not gone: the shard still
+    # answers reads, so no page (failover drills must not false-fire)
+    reg = MetricsRegistry()
+    health = _dark_health(serving_s0=1)
+    doc = _mk_doctor(reg, FakeClock(),
+                     router=_StubShardRouter(health))
+    assert doc.evaluate()["alerts"] == []
+
+
+def test_shard_dark_silent_without_router_or_topology():
+    reg = MetricsRegistry()
+    doc = _mk_doctor(reg, FakeClock())   # no router attached
+    assert doc.evaluate()["alerts"] == []
+    r = _StubShardRouter(_dark_health(0))
+    r.topology = None                    # router without a shard map
+    doc2 = _mk_doctor(MetricsRegistry(), FakeClock(), router=r)
+    assert doc2.evaluate()["alerts"] == []
+
+
+def test_shard_dark_attach_router_late_binding():
+    reg = MetricsRegistry()
+    doc = _mk_doctor(reg, FakeClock())
+    assert doc.evaluate()["alerts"] == []
+    doc.attach_router(_StubShardRouter(_dark_health(0)))
+    (alert,) = doc.evaluate()["alerts"]
+    assert alert["rule"] == "shard_dark"

@@ -43,6 +43,27 @@ def test_direction_inference():
     assert pw.metric_direction("host_cores") == "skip"
 
 
+def test_cfg16_correctness_axes_are_pinned_exact():
+    """The cluster-v2 soak gate: latency axes regress statistically, but
+    the correctness axes (write loss, split-brain refusals, doctor
+    precision/recall, envelope visibility) must be byte-stable — any
+    drift is a failure, not noise."""
+    assert pw.metric_direction("cfg16_steady_p50_ms") == "lower"
+    assert pw.metric_direction("cfg16_steady_p99_ms") == "lower"
+    assert pw.metric_direction("cfg16_failover_ms") == "lower"
+    assert pw.metric_direction("cfg16_handoff_ms") == "lower"
+    for axis in ("cfg16_failover_within_budget",
+                 "cfg16_acked_write_loss",
+                 "cfg16_split_brain_refused",
+                 "cfg16_doctor_precision",
+                 "cfg16_doctor_recall",
+                 "cfg16_clean_incidents",
+                 "cfg16_shard_dark_fired",
+                 "cfg16_partial_envelope_seen",
+                 "cfg16_fingerprints_matched"):
+        assert pw.metric_direction(axis) == "exact", axis
+
+
 def test_mad_thresholding_flags_only_past_k_mad():
     base = _baselines({"cfg4_knn10_ms": [100.0, 102.0, 98.0, 101.0, 99.0]})
     # within noise: median 100, MAD 1, k=4 -> threshold max(4, 10% floor)
