@@ -2005,6 +2005,88 @@ def main(args=None) -> int:
                  if not v}
              for h, half in (board16.get("halves") or {}).items()}
 
+    if "17" in configs:
+        # cfg17 — telemetry history plane overhead (obs/history.py +
+        # obs/forensics.py): what retention actually costs. Four axes:
+        # the cost of ONE sampler tick on a populated registry (every
+        # tick lands a fresh finest slot — the worst case), the
+        # amortized per-query overhead of riding the pre-drain hook at
+        # a realistic scrape cadence (one scrape per 50 queries, fake
+        # clock advancing so the throttle behaves as in production),
+        # the retained-ring memory bound, and the cost of freezing one
+        # memory-only forensic bundle. Host-side and CI-sized like
+        # cfg9; not in the default config lists — it rides the history
+        # CI job and explicit --update-baseline runs.
+        from geomesa_tpu.metrics import MetricsRegistry as _Reg17
+        from geomesa_tpu.obs.forensics import ForensicStore as _FS17
+        from geomesa_tpu.obs.history import TelemetryHistory as _TH17
+
+        t17_start = time.perf_counter()
+        reg17 = _Reg17()
+
+        def _traffic17(i):
+            # the registry writes one served query makes
+            reg17.inc("scheduler.queries")
+            if i % 7 == 0:
+                reg17.inc("admission.shed")
+            reg17.observe("query.count", 0.0005 * (1 + (i % 5)))
+            reg17.set_gauge("replication.lag_ms", float(i % 100))
+
+        clk17 = {"t": 1_000_000.0}
+        hist17 = _TH17(clock=lambda: clk17["t"], registry=reg17)
+        for i in range(64):
+            _traffic17(i)
+        hist17.sample_now(clk17["t"])
+        ticks17 = []
+        for i in range(200):
+            _traffic17(i)
+            clk17["t"] += 2.0      # fresh finest slot every tick
+            t0 = time.perf_counter()
+            hist17.sample_now(clk17["t"])
+            ticks17.append(time.perf_counter() - t0)
+        detail["cfg17_history_tick_us"] = round(_p50(ticks17) * 1000, 1)
+
+        iters17 = 2000
+
+        def _loop17(sample):
+            t0 = time.perf_counter()
+            for i in range(iters17):
+                _traffic17(i)
+                if i % 50 == 0:
+                    reg17.snapshot()      # the scrape
+                    if sample:            # what pre-drain adds to it
+                        clk17["t"] += 0.5  # 0.01s/query: sample ~1/4 scrapes
+                        hist17.maybe_sample()
+            return time.perf_counter() - t0
+
+        _loop17(False)                    # warm both paths
+        _loop17(True)
+        off17 = min(_loop17(False) for _ in range(3))
+        on17 = min(_loop17(True) for _ in range(3))
+        # pct is vs the BARE registry-traffic loop — a worst case whose
+        # denominator is a few microseconds of work per query; real
+        # queries are 1000x that, which is why the <5% guard on the
+        # real query path (tests/test_perf_budget.py) holds easily.
+        # The amortized absolute cost is the number to watch.
+        detail["cfg17_history_overhead_pct"] = round(
+            max(0.0, (on17 - off17) / off17 * 100.0), 2)
+        detail["cfg17_history_cost_us_per_query"] = round(
+            max(0.0, on17 - off17) / iters17 * 1e6, 3)
+        detail["cfg17_ring_memory_bytes"] = hist17.memory_bytes()
+
+        fstore17 = _FS17(dir_path="", registry=reg17, history=hist17,
+                         clock=lambda: clk17["t"])
+        caps17 = []
+        for i in range(20):
+            t0 = time.perf_counter()
+            fstore17.capture({"id": f"bench-{i}", "rule": "slo_trend",
+                              "cause": "bench", "severity": "page",
+                              "opened_ms": int(clk17["t"] * 1000),
+                              "timeline": {"trace_gids": []}})
+            caps17.append(time.perf_counter() - t0)
+        detail["cfg17_bundle_capture_ms"] = round(_p50(caps17), 3)
+        detail["cfg17_wall_s"] = round(time.perf_counter() - t17_start, 3)
+
     out = {
         "metric": "z3_bbox_time_count_p50_latency_100m",
         "value": round(headline_p50, 3) if headline_p50 is not None else None,

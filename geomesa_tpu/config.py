@@ -937,6 +937,96 @@ CELL_GEO_KEY_BITS = _register(
     "table partition uses the exact z3-derived keys instead).")
 
 
+# -- telemetry history plane + forensic bundles (ISSUE 20) --------------------
+
+HISTORY_ENABLED = _register(
+    "GEOMESA_TPU_HISTORY", True, _parse_bool,
+    "Master switch for the telemetry-history sampler: selected registry "
+    "series (counter rates, gauges, timer p50/p99 bucket deltas) are "
+    "snapshotted into wall-clock-aligned ring tiers on the registry "
+    "pre-drain hook, so producers pay nothing and readers pay at most "
+    "one snapshot per finest-tier interval.")
+
+HISTORY_TIERS = _register(
+    "GEOMESA_TPU_HISTORY_TIERS", "2:300,30:240", str,
+    "History ring tiers as comma-separated interval_s:slots pairs. The "
+    "default keeps 2s resolution for 10 minutes and 30s resolution for "
+    "2 hours; memory stays knob-bounded at slots x tracked series.")
+
+HISTORY_SERIES = _register(
+    "GEOMESA_TPU_HISTORY_SERIES", "", str,
+    "Extra registry series for the history sampler beyond the built-in "
+    "set (comma-separated counter/gauge/timer names; prefix match with "
+    "a trailing '.'). The built-ins cover scheduler traffic, sheds, "
+    "recompiles, replication lag and the query.count timer.")
+
+HISTORY_MAX_SERIES = _register(
+    "GEOMESA_TPU_HISTORY_MAX_SERIES", 64, int,
+    "Hard cap on distinct series the history sampler tracks per tier "
+    "(memory bound; series beyond the cap are dropped and counted "
+    "under history.series_dropped).")
+
+HISTORY_SLICE_S = _register(
+    "GEOMESA_TPU_HISTORY_SLICE_S", 120.0, float,
+    "Width of the history slice (seconds before the firing) captured "
+    "into a forensic bundle when the doctor opens an incident — the "
+    "timeline window an operator replays around the page.")
+
+FORENSICS_ENABLED = _register(
+    "GEOMESA_TPU_FORENSICS", True, _parse_bool,
+    "Capture a forensic bundle (history slices, matching flight events, "
+    "retained trace gids, replication/cell state, workload hot_set) "
+    "when the doctor opens an incident. Bundles stay fetchable in "
+    "memory at GET /incidents/{id}/bundle; a directory makes them "
+    "durable.")
+
+FORENSICS_DIR = _register(
+    "GEOMESA_TPU_FORENSICS_DIR", "", str,
+    "Directory for durable forensic bundles (atomic tmp+rename install, "
+    "newest GEOMESA_TPU_FORENSICS_KEEP kept). Empty keeps bundles "
+    "in-memory only.")
+
+FORENSICS_KEEP = _register(
+    "GEOMESA_TPU_FORENSICS_KEEP", 16, int,
+    "Size rotation for the forensic bundle directory: all but this many "
+    "newest bundle files are deleted after each capture (forensics.gc "
+    "counts the drops).")
+
+DOCTOR_TREND = _register(
+    "GEOMESA_TPU_DOCTOR_TREND", True, _parse_bool,
+    "Enable the predictive doctor rules: slo_trend (burn-rate slope "
+    "projects a page before slo_burn fires) and capacity_trend "
+    "(per-shard load growth slope projects time-to-imbalance).")
+
+DOCTOR_TREND_LEAD_S = _register(
+    "GEOMESA_TPU_DOCTOR_TREND_LEAD_S", 120.0, float,
+    "slo_trend projection horizon: an objective whose 5m burn rate, "
+    "extrapolated along its fitted slope this many seconds ahead, "
+    "crosses the page bar opens a predictive incident while the "
+    "current burn is still under it.")
+
+DOCTOR_TREND_MIN_POINTS = _register(
+    "GEOMESA_TPU_DOCTOR_TREND_MIN_POINTS", 5, int,
+    "Minimum history samples inside the doctor window before either "
+    "trend rule may fire (two points always fit a line; a trend is "
+    "only evidence once it persists).")
+
+DOCTOR_CAPACITY_LEAD_S = _register(
+    "GEOMESA_TPU_DOCTOR_CAPACITY_LEAD_S", 600.0, float,
+    "capacity_trend horizon: a shard whose guaranteed max-over-mean "
+    "load ratio is growing fast enough to cross the imbalance bar "
+    "within this many seconds opens a predictive incident carrying "
+    "the projected time-to-imbalance.")
+
+JOURNAL_KEEP = _register(
+    "GEOMESA_TPU_JOURNAL_KEEP", 1, int,
+    "Rotated generations kept for the incident and flight-recorder "
+    "JSONL journals (path.1 .. path.N). The default keeps one rotated "
+    "predecessor, matching the historical rotate-once discipline; long "
+    "soaks raise it and rely on the keep-N GC (journal.gc counts "
+    "dropped generations) to bound disk.")
+
+
 def describe() -> Dict[str, dict]:
     """name → {value, default, doc} for every registered property
     (the CLI `config` listing / docs surface)."""

@@ -73,10 +73,15 @@ def install() -> None:
     # queue all settle right before any snapshot-ish registry read, so
     # surfaces stay accurate without the per-query hot path paying for any
     def _pre_drain():
+        from geomesa_tpu.obs import history as _history
         from geomesa_tpu.obs import workload as _workload
         _sampling.SAMPLER.drain()
         _attrib.flush()
         _workload.WORKLOAD.drain()
+        # history sampler LAST, so a tick retains the just-drained state;
+        # self-throttled to the finest tier interval and reentrancy-guarded
+        # (taking a sample reads the registry, which re-enters this hook)
+        _history.HISTORY.maybe_sample()
 
     _metrics.set_pre_drain_hook(_pre_drain)
     _metrics.set_gauge("obs.flight_depth", lambda: len(_flight.RECORDER))

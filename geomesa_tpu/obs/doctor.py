@@ -48,12 +48,12 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from geomesa_tpu import config
 from geomesa_tpu import trace as _trace
 from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.obs.history import SeriesStore
 from geomesa_tpu.obs.incidents import IncidentStore
 
 # rule -> (severity default, one-line description — the CLI/docs table)
@@ -73,6 +73,10 @@ RULES: Dict[str, Tuple[str, str]] = {
                                        "collective rounds"),
     "shard_dark": ("page", "a shard cell with ZERO serving endpoints "
                            "in the router topology"),
+    "slo_trend": ("page", "burn-rate slope projects a page within the "
+                          "lead horizon before slo_burn fires"),
+    "capacity_trend": ("ticket", "per-shard load growth slope projects "
+                                 "imbalance within the lead horizon"),
 }
 
 
@@ -86,7 +90,7 @@ class DoctorEngine:
                  slo_engine=None, store: Optional[IncidentStore] = None,
                  journal_path: Optional[str] = None,
                  federator=None, workload=None, shardwatch=None,
-                 router=None):
+                 router=None, forensics=None):
         self._reg = registry if registry is not None else _metrics
         self._clock = clock
         self._slo = slo_engine          # None -> late-bind slo.ENGINE
@@ -98,8 +102,13 @@ class DoctorEngine:
             journal_path=journal_path, registry=self._reg,
             node=_trace.node_id())
         self._lock = threading.RLock()
-        # per-counter (ts, value) samples for the windowed rate detectors
-        self._rates: Dict[str, deque] = {}
+        self._forensics = forensics     # None -> late-bind FORENSICS;
+        #                                 False -> capture disabled
+        # per-counter retained series for the windowed rate detectors and
+        # the predictive trend rules (obs/history.py SeriesStore — each
+        # engine owns ONE, so a fresh doctor never fires on preexisting
+        # totals and tests stay isolated)
+        self.history = SeriesStore()
 
     # -- late-bound collaborators ---------------------------------------------
 
@@ -129,31 +138,34 @@ class DoctorEngine:
         from geomesa_tpu.obs import shardwatch as _shardwatch
         return _shardwatch.WATCH
 
+    def _fstore(self):
+        if self._forensics is False:    # capture explicitly disabled
+            return None
+        if self._forensics is not None:
+            return self._forensics
+        from geomesa_tpu.obs import forensics as _forensics
+        return _forensics.FORENSICS
+
     # -- windowed counter deltas ----------------------------------------------
 
     def _delta(self, key: str, value: float, now: float,
                window_s: float) -> Tuple[float, float]:
         """(per-minute rate, absolute delta) of a counter over the
-        trailing window. The first sighting of a counter contributes no
-        delta, so a fresh doctor never fires on preexisting totals."""
-        samples = self._rates.setdefault(key, deque())
-        samples.append((now, float(value)))
-        while samples and now - samples[0][0] > window_s:
-            samples.popleft()
-        if len(samples) < 2:
-            return 0.0, 0.0
-        dt = samples[-1][0] - samples[0][0]
-        dv = samples[-1][1] - samples[0][1]
-        if dt <= 0.0:
-            return 0.0, dv
-        return dv * 60.0 / dt, dv
+        trailing window, backed by the engine's retained SeriesStore
+        (obs/history.py) — the same store the predictive trend rules
+        query, replacing the ad-hoc per-detector deques. The first
+        sighting of a counter contributes no delta, so a fresh doctor
+        never fires on preexisting totals."""
+        self.history.observe(key, value, now, window_s=window_s)
+        return self.history.window(key, now, window_s)
 
     # -- detectors (each returns a list of alert dicts) -----------------------
 
     def _check_slo(self, now: float) -> List[dict]:
         alerts = []
         engine = self._slo_engine()
-        scopes = [("local", engine.evaluate() if engine else {})]
+        local = engine.evaluate() if engine else {}
+        scopes = [("local", local)]
         fed = self._fed()
         if fed is not None:
             try:
@@ -180,6 +192,61 @@ class DoctorEngine:
                     "suspect": {"objective": name, "scope": scope},
                     "match": {"slow_ms": config.SLO_LATENCY_MS.get()},
                 })
+        alerts.extend(self._check_slo_trend(now, local))
+        return alerts
+
+    def _check_slo_trend(self, now: float, results: dict) -> List[dict]:
+        """slo_trend: the PREDICTIVE page. Every evaluation feeds each
+        objective's 5m burn rate into the engine's retained series; a
+        positive fitted slope whose projection crosses the page bar
+        within DOCTOR_TREND_LEAD_S fires while the current burn is still
+        under it — the trend page leads the slo_burn page by design
+        (proven by the ramped-handicap drill in obs/trenddrill.py). An
+        objective already at page status stays slo_burn's: prediction
+        never shadows the fact."""
+        trend_on = bool(config.DOCTOR_TREND.get())
+        window = float(config.DOCTOR_WINDOW_S.get())
+        lead = float(config.DOCTOR_TREND_LEAD_S.get())
+        min_pts = max(2, int(config.DOCTOR_TREND_MIN_POINTS.get()))
+        from geomesa_tpu.obs.slo import PAGE_BURN
+        alerts: List[dict] = []
+        for name, obj in sorted((results or {}).items()):
+            if not isinstance(obj, dict):
+                continue
+            burn = (obj.get("burn_rates") or {}).get("5m")
+            if burn is None:
+                continue            # no traffic in the window: no signal
+            key = f"slo.burn5m.{name}"
+            # the series samples every tick (not just near the bar) so
+            # the fit has a baseline by the time a ramp starts
+            self.history.observe(key, float(burn), now, window_s=window)
+            if not trend_on:
+                continue
+            current = float(burn)
+            if current >= PAGE_BURN or obj.get("status") == "page":
+                continue
+            if self.history.points(key, now, window) < min_pts:
+                continue
+            slope = self.history.slope(key, now, window)
+            if slope <= 0.0:
+                continue
+            projected = current + slope * lead
+            if projected < PAGE_BURN:
+                continue
+            eta_s = (PAGE_BURN - current) / slope
+            alerts.append({
+                "rule": "slo_trend", "severity": "page",
+                "cause": f"trend-slo:{name}",
+                "detail": {"burn_5m": round(current, 3),
+                           "slope_per_s": round(slope, 5),
+                           "projected": round(projected, 3),
+                           "page_bar": PAGE_BURN,
+                           "lead_s": lead,
+                           "eta_s": round(eta_s, 1)},
+                "suspect": {"objective": name,
+                            "page_projected_in_s": round(eta_s, 1)},
+                "match": {"slow_ms": config.SLO_LATENCY_MS.get()},
+            })
         return alerts
 
     def _check_replication(self, now: float, gauges: dict) -> List[dict]:
@@ -465,6 +532,62 @@ class DoctorEngine:
             })
         return alerts
 
+    def _check_capacity_trend(self, now: float) -> List[dict]:
+        """capacity_trend: the leading signal the split/merge loop will
+        consume. Every evaluation feeds each type's GUARANTEED
+        max-over-mean shard-load ratio (the shardwatch ledger's honest
+        lower bound) into the retained series; a positive slope whose
+        projected bar-crossing lands within DOCTOR_CAPACITY_LEAD_S opens
+        a predictive ticket naming the hot shard and the projected
+        time-to-imbalance. A type already over the bar stays
+        shard_imbalance's."""
+        trend_on = bool(config.DOCTOR_TREND.get())
+        try:
+            rep = self._sw().balance()
+        except Exception:
+            return []
+        if not rep.get("active"):
+            return []
+        window = float(config.DOCTOR_WINDOW_S.get())
+        lead = float(config.DOCTOR_CAPACITY_LEAD_S.get())
+        min_pts = max(2, int(config.DOCTOR_TREND_MIN_POINTS.get()))
+        alerts: List[dict] = []
+        for tname, tr in sorted((rep.get("types") or {}).items()):
+            sc = tr.get("score") or {}
+            mom = sc.get("max_over_mean")
+            bar = sc.get("bar")
+            if mom is None or bar is None:
+                continue
+            key = f"shard.mom.{tname}"
+            self.history.observe(key, float(mom), now, window_s=window)
+            if not trend_on or sc.get("over_bar"):
+                continue
+            if self.history.points(key, now, window) < min_pts:
+                continue
+            slope = self.history.slope(key, now, window)
+            if slope <= 0.0:
+                continue
+            eta_s = (float(bar) - float(mom)) / slope
+            if eta_s > lead:
+                continue
+            hot = sc.get("hot_shard")
+            hot_row = (tr.get("shards") or {}).get(hot) or {}
+            alerts.append({
+                "rule": "capacity_trend", "severity": "ticket",
+                "cause": f"trend-shard:{tname}",
+                "detail": {"type": tname,
+                           "max_over_mean": round(float(mom), 3),
+                           "slope_per_s": round(slope, 6),
+                           "bar": float(bar),
+                           "lead_s": lead,
+                           "eta_s": round(eta_s, 1)},
+                "suspect": {"type": tname, "shard": hot,
+                            "load_share": hot_row.get("load_share"),
+                            "imbalance_projected_in_s": round(eta_s, 1)},
+                "match": {},
+            })
+        return alerts
+
     def attach_router(self, router) -> None:
         """Bind the shard-aware router whose topology the shard_dark
         detector should watch (RouterApi does this on startup)."""
@@ -593,6 +716,7 @@ class DoctorEngine:
                           lambda: self._check_reindex(now, counters),
                           lambda: self._check_skew(now),
                           lambda: self._check_shard_imbalance(now),
+                          lambda: self._check_capacity_trend(now),
                           lambda: self._check_shard_dark(now),
                           lambda: self._check_straggler(now, counters)):
                 try:
@@ -610,7 +734,18 @@ class DoctorEngine:
                 if key not in {(i["rule"], i["cause"])
                                for i in self.store.active()}:
                     timeline = self._timeline(a, counters)
-                self.store.open_or_update(a, timeline, now)
+                inc = self.store.open_or_update(a, timeline, now)
+                if timeline is not None:
+                    # newly opened: freeze the forensic bundle (history
+                    # slices, matching events, replication/workload
+                    # state) before the system can recover past it
+                    fstore = None
+                    try:
+                        fstore = self._fstore()
+                    except Exception:
+                        pass
+                    if fstore is not None:
+                        fstore.capture(inc)
             resolved = []
             if tick:
                 resolved = self.store.sweep(
@@ -638,7 +773,7 @@ class DoctorEngine:
     def reset(self) -> None:
         """Forget rate-detector history and all incidents (tests)."""
         with self._lock:
-            self._rates.clear()
+            self.history.clear()
             self.store.clear()
 
 

@@ -99,9 +99,11 @@ def _local_fetch() -> Tuple[dict, dict]:
     from geomesa_tpu.obs import workload as _workload
     hz = {"status": "ok",
           "node": {"id": _trace.node_id(), "role": _trace.node_role()}}
+    from geomesa_tpu.obs import history as _history
     state = _metrics.export_state()
     state["workload"] = _workload.WORKLOAD.export_state()
     state["shardwatch"] = _shardwatch.WATCH.export_state()
+    state["history"] = _history.HISTORY.export_state()
     return hz, state
 
 
@@ -349,6 +351,33 @@ class Federator:
                 "hot_set": merged.hot_set(),
                 "tenants": merged.top_tenants(),
                 "rollups": merged.rollups()}
+
+    def fleet_history(self) -> dict:
+        """Fleet timelines: every node's retained history rings (riding
+        the same /metrics?format=state scrape) merged per equal tier —
+        counter rates and gauges sum at aligned slots, timer slots sum
+        bucket counts losslessly — with honest per-node gap markers: a
+        node whose scrape is pinned or whose sampler skipped a tick is
+        NAMED in the slots it misses instead of silently deflating the
+        fleet sum (see history.merge_states)."""
+        from geomesa_tpu.obs import history as _history
+        states, names, nodes = [], [], {}
+        for name, s in sorted(self.refresh().items()):
+            if not (s.ok and s.state):
+                nodes[name] = {"ok": False, "error": s.error}
+                continue
+            hst = s.state.get("history") or {}
+            states.append(hst)
+            names.append(name)
+            n_series = len({sn for t in hst.get("tiers", [])
+                            for sn in (t.get("series") or {})})
+            nodes[name] = {"ok": True, "node_id": s.node_id,
+                           "series": n_series}
+        merged = _history.merge_states(states, node_names=names)
+        missing = self.missing_nodes()
+        return {"nodes": nodes,
+                "partial": bool(missing), "missing": missing,
+                "merged": merged}
 
     def fleet_balance(self) -> dict:
         """Fleet-wide shard balance: every node's shardwatch + workload

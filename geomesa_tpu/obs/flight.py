@@ -60,7 +60,8 @@ def tenant_label(tenant=None, auths=None) -> str:
 
 def matches(rec: dict, slow_ms: Optional[float] = None,
             errors: bool = False, kind: Optional[str] = None,
-            type_name: Optional[str] = None) -> bool:
+            type_name: Optional[str] = None,
+            since_ms: Optional[float] = None) -> bool:
     """The shared filter predicate over wide events AND trace dicts.
 
     slow_ms    keep records at least this slow (duration_ms)
@@ -68,8 +69,14 @@ def matches(rec: dict, slow_ms: Optional[float] = None,
     kind       match the record kind / trace name, or a span kind present
                in its ``stages_ms`` breakdown
     type_name  match the feature type
+    since_ms   keep records stamped at/after this wall time — the slice
+               filter shared by ``GET /events``, ``debug events`` and the
+               forensic-bundle capture path, so flight events line up
+               with a history ``range(name, since_ms)`` window
     """
     if slow_ms is not None and float(rec.get("duration_ms") or 0.0) < slow_ms:
+        return False
+    if since_ms is not None and float(rec.get("ts_ms") or 0.0) < since_ms:
         return False
     if errors and not (rec.get("error") or rec.get("cancelled")
                        or rec.get("shed")):
@@ -141,8 +148,12 @@ class FlightRecorder:
                 from geomesa_tpu.durability.rotation import rotate
                 self._fh.close()
                 self._fh = None
-                rotate(path, keep=1,
-                       on_drop=lambda p: _metrics.inc("obs.jsonl_dropped"))
+                def _dropped(p):
+                    _metrics.inc("obs.jsonl_dropped")
+                    _metrics.inc("journal.gc")
+                rotate(path,
+                       keep=max(1, int(config.JOURNAL_KEEP.get())),
+                       on_drop=_dropped)
         except OSError:
             # a failing sink must never fail the request (dropwizard rule)
             _metrics.inc("obs.jsonl_errors")
@@ -203,7 +214,8 @@ class FlightRecorder:
     def recent(self, limit: Optional[int] = None,
                slow_ms: Optional[float] = None, errors: bool = False,
                kind: Optional[str] = None,
-               type_name: Optional[str] = None) -> List[dict]:
+               type_name: Optional[str] = None,
+               since_ms: Optional[float] = None) -> List[dict]:
         """Most-recent-first events passing the shared filter predicate."""
         from geomesa_tpu.obs.sampling import SAMPLER
         SAMPLER.drain()  # settle retention before resolving lazy entries
@@ -216,7 +228,7 @@ class FlightRecorder:
                 e = event_from_trace(
                     e, retained=SAMPLER.is_retained(e.trace_id))
             if matches(e, slow_ms=slow_ms, errors=errors, kind=kind,
-                       type_name=type_name):
+                       type_name=type_name, since_ms=since_ms):
                 out.append(e)
         if limit is not None:
             out = out[: max(0, int(limit))]

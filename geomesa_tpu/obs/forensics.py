@@ -1,0 +1,249 @@
+"""Forensic bundles: the evidence capsule the doctor captures at open.
+
+An incident's timeline snapshot (obs/incidents.py) answers *what fired*;
+a forensic bundle answers *what the minutes around it looked like*: the
+telemetry-history slices covering the firing window, the flight events
+matching the incident sliced to the same ``since_ms``, the retained
+trace gids, the replication/cell registry state, the workload hot_set
+and the shardwatch balance verdict — everything an operator replays
+after the page, frozen at capture time so a recovered system can't
+retroactively exonerate itself.
+
+Bundles live in a bounded in-memory ring (fetchable at
+``GET /incidents/{id}/bundle`` and via ``geomesa-tpu forensics``), and —
+when ``GEOMESA_TPU_FORENSICS_DIR`` is set — are installed durably via
+the shared tmp+rename discipline (``durability/rotation.atomic_install``,
+so a crash mid-capture leaves no torn bundle) with keep-N GC
+(``rotation.keep_newest``; ``forensics.gc`` counts the drops).
+
+A failing capture never fails a doctor evaluation (dropwizard rule);
+``forensics.errors`` counts the swallows.
+
+Import discipline (obs/__init__ rule): config/metrics/trace/obs.* +
+durability/rotation only; heavier collaborators bind lazily at capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from geomesa_tpu import config
+from geomesa_tpu import trace as _trace
+from geomesa_tpu.metrics import REGISTRY as _metrics
+
+_MEM_KEEP = 32  # in-memory bundle ring (independent of the disk keep knob)
+
+
+class ForensicStore:
+    """Capture + fetch surface for forensic bundles. Injectable for
+    tests (registry, clock, history, dir); the global ``FORENSICS``
+    late-binds to the process globals and reads the knobs per capture
+    so runtime reconfiguration applies."""
+
+    def __init__(self, dir_path: Optional[str] = None,
+                 keep: Optional[int] = None, registry=None,
+                 history=None, clock: Callable[[], float] = time.time):
+        self._dir = dir_path
+        self._keep = keep
+        self._reg = registry if registry is not None else _metrics
+        self._history = history
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._bundles: deque = deque(maxlen=_MEM_KEEP)
+
+    # -- lazy collaborators ----------------------------------------------
+
+    def _hist(self):
+        if self._history is not None:
+            return self._history
+        from geomesa_tpu.obs import history as _history
+        return _history.HISTORY
+
+    def _dir_path(self) -> Optional[str]:
+        if self._dir is not None:
+            return self._dir or None
+        return str(config.FORENSICS_DIR.get() or "") or None
+
+    def _keep_n(self) -> int:
+        if self._keep is not None:
+            return int(self._keep)
+        return max(1, int(config.FORENSICS_KEEP.get()))
+
+    # -- capture ---------------------------------------------------------
+
+    def capture(self, incident: dict, now: Optional[float] = None) -> Optional[dict]:
+        """Build + retain the bundle for a newly-opened incident. Never
+        raises — the doctor's evaluation must survive a failing disk,
+        a half-wired collaborator, or an injected crash."""
+        if not config.FORENSICS_ENABLED.get():
+            return None
+        try:
+            bundle = self._build(incident, now)
+        except Exception:
+            self._reg.inc("forensics.errors")
+            return None
+        with self._lock:
+            self._bundles.append(bundle)
+        self._reg.inc("forensics.captured")
+        try:
+            self._install(bundle)
+        except BaseException:
+            # InjectedCrash is a BaseException: surface it to the test
+            # harness AFTER accounting, so atomicity is still provable.
+            self._reg.inc("forensics.errors")
+            raise
+        return bundle
+
+    def _build(self, incident: dict, now: Optional[float]) -> dict:
+        if now is None:
+            now = self._clock()
+        now_ms = int(now * 1000)
+        opened_ms = int(incident.get("opened_ms") or now_ms)
+        slice_ms = max(0.0, float(config.HISTORY_SLICE_S.get())) * 1000.0
+        # anchor at the EARLIER of the incident's wall open and the
+        # store's clock, so an injected test clock still yields a slice
+        # that covers the firing window
+        since_ms = int(min(opened_ms, now_ms) - slice_ms)
+        hist = self._hist()
+        history_slice = {"since_ms": since_ms, "series": {}}
+        try:
+            tier = None
+            for name in hist.series_names():
+                history_slice["series"][name] = hist.range(
+                    name, since_ms=since_ms, tier=tier)
+        except Exception:
+            history_slice["error"] = "history unavailable"
+
+        timeline = incident.get("timeline") or {}
+        match = {}
+        events: List[dict] = []
+        try:
+            from geomesa_tpu.obs.flight import RECORDER
+            cap = max(0, int(config.DOCTOR_TIMELINE_EVENTS.get()))
+            events = RECORDER.recent(limit=cap, since_ms=since_ms,
+                                     **match) if cap else []
+        except Exception:
+            pass
+
+        state = {}
+        try:
+            snap = self._reg.snapshot_prefixed(
+                "replication.", "cell.", "cluster.", "shard.")
+            state = {k: v for k, v in snap.items() if v}
+        except Exception:
+            pass
+
+        hot_set = None
+        try:
+            from geomesa_tpu.obs import workload as _wl
+            hot_set = _wl.WORKLOAD.hot_set()
+        except Exception:
+            pass
+        balance = None
+        try:
+            from geomesa_tpu.obs import shardwatch as _sw
+            balance = _sw.WATCH.balance()
+        except Exception:
+            pass
+
+        return {
+            "incident_id": incident.get("id"),
+            "rule": incident.get("rule"),
+            "cause": incident.get("cause"),
+            "severity": incident.get("severity"),
+            "node": incident.get("node") or _trace.node_id(),
+            "opened_ms": opened_ms,
+            "captured_ms": int(now * 1000),
+            "history": history_slice,
+            "events": events,
+            "trace_gids": list(timeline.get("trace_gids") or []),
+            "router_demotions": timeline.get("router_demotions") or {},
+            "replication_state": state,
+            "workload_hot_set": hot_set,
+            "shard_balance": balance,
+        }
+
+    def _install(self, bundle: dict) -> None:
+        """Durable half: tmp + atomic rename + keep-N GC. No-op without
+        a configured directory."""
+        d = self._dir_path()
+        if not d:
+            return
+        from geomesa_tpu.durability import rotation
+        os.makedirs(d, exist_ok=True)
+        name = f"bundle-{bundle['captured_ms']}-{bundle['incident_id']}.json"
+        final = os.path.join(d, name)
+        tmp = final + ".tmp"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(bundle, fh, default=str)
+                fh.flush()
+                os.fsync(fh.fileno())
+            rotation.atomic_install(tmp, final)
+        except OSError:
+            self._reg.inc("forensics.errors")
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return
+        finally:
+            if os.path.exists(tmp) and os.path.exists(final):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        kept = sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.startswith("bundle-") and f.endswith(".json"))
+        rotation.keep_newest(
+            kept, self._keep_n(),
+            on_drop=lambda p: self._reg.inc("forensics.gc"))
+
+    # -- fetch -----------------------------------------------------------
+
+    def get(self, incident_id: str) -> Optional[dict]:
+        """Newest bundle for an incident id — memory first, then the
+        durable directory (a restart keeps bundles fetchable)."""
+        with self._lock:
+            for bundle in reversed(self._bundles):
+                if bundle.get("incident_id") == incident_id:
+                    return bundle
+        d = self._dir_path()
+        if not d or not os.path.isdir(d):
+            return None
+        suffix = f"-{incident_id}.json"
+        candidates = sorted(f for f in os.listdir(d)
+                            if f.startswith("bundle-")
+                            and f.endswith(suffix))
+        for name in reversed(candidates):
+            try:
+                with open(os.path.join(d, name)) as fh:
+                    return json.load(fh)
+            except (OSError, ValueError):
+                continue
+        return None
+
+    def list(self) -> List[dict]:
+        """Bundle index, oldest first: id/rule/captured_ms per bundle."""
+        with self._lock:
+            return [{"incident_id": b.get("incident_id"),
+                     "rule": b.get("rule"),
+                     "cause": b.get("cause"),
+                     "captured_ms": b.get("captured_ms"),
+                     "events": len(b.get("events") or ()),
+                     "series": len((b.get("history") or {})
+                                   .get("series") or ())}
+                    for b in self._bundles]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._bundles.clear()
+
+
+FORENSICS = ForensicStore()

@@ -1,0 +1,613 @@
+"""Telemetry history plane: retained, queryable metric timelines.
+
+Every other observability surface reports the *instantaneous* state —
+cumulative counters, current gauges, ad-hoc windowed deltas recomputed
+per doctor detector. This module retains timelines: a per-node sampler
+snapshots selected registry series into wall-clock-aligned fixed-interval
+ring tiers (Monarch-style coarse/fine retention, e.g. 2s x 10m and
+30s x 2h), driven off the registry pre-drain hook so *producers pay
+nothing* — a sample is taken at most once per finest-tier interval, and
+only when somebody reads the registry anyway.
+
+Stored values are chosen for lossless fleet merging (the workload /
+metrics-federation idiom):
+
+* counters  -> per-second RATE over the inter-sample gap (rates are
+  additive, so the fleet timeline at a slot is the sum of node rates);
+  the first sighting records a baseline only, mirroring the doctor's
+  first-sighting immunity — history never fabricates a spike from a
+  preexisting total.
+* gauges    -> the level (merged by summing: fleet lag is the sum of
+  per-node lag the same way ``/fleet/metrics`` sums gauges).
+* timers    -> sparse log-bucket DELTAS per slot over the shared
+  BUCKET_BOUNDS geometry; p50/p99 are derived at read time, and a
+  fleet merge sums bucket counts, so merged percentiles are exactly
+  what one process observing everything would report.
+
+``merge_states`` builds the fleet timeline with *honest gap markers*:
+a node that reports a series but is missing a slot after its own first
+sample (a pinned scrape, a restart, a dropped tick) is named in that
+slot's ``gap_nodes`` instead of being silently averaged away.
+
+``SeriesStore`` is the doctor-facing half: raw (ts, value) series with
+the exact windowed-delta semantics the doctor's detectors historically
+kept in ad-hoc ``_delta`` state, plus the slope/projection helpers the
+predictive ``slo_trend``/``capacity_trend`` rules consume.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from geomesa_tpu import config
+from geomesa_tpu import metrics as _metrics
+
+# Series the sampler tracks out of the box: the dials the doctor and the
+# runbooks actually read. Extras ride GEOMESA_TPU_HISTORY_SERIES.
+DEFAULT_COUNTERS = (
+    "scheduler.queries",
+    "admission.shed",
+    "kernels.recompiles",
+    "scheduler.deadline_cancelled",
+    "wal.fsync_errors",
+    "breaker.open",
+)
+DEFAULT_GAUGES = (
+    "replication.lag_ms",
+    "incident.active",
+)
+DEFAULT_TIMERS = (
+    "query.count",
+)
+
+SPARK_CHARS = " ▁▂▃▄▅▆▇█"
+
+
+def parse_tiers(spec: str) -> List[Tuple[int, int]]:
+    """``"2:300,30:240"`` -> [(2, 300), (30, 240)] (interval_s, slots),
+    sorted finest first; malformed entries are dropped rather than
+    taking the sampler down with them."""
+    tiers: List[Tuple[int, int]] = []
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            interval_s, slots = part.split(":")
+            interval, n = max(1, int(interval_s)), max(2, int(slots))
+        except (ValueError, TypeError):
+            continue
+        tiers.append((interval, n))
+    tiers.sort()
+    return tiers or [(2, 300), (30, 240)]
+
+
+def sparkline(values: List[Optional[float]]) -> str:
+    """ASCII sparkline; None (a gap) renders as '.' so a fleet timeline's
+    holes stay visible in the terminal."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return "." * len(values)
+    lo, hi = min(present), max(present)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append(".")
+        else:
+            idx = int((v - lo) / span * (len(SPARK_CHARS) - 1))
+            out.append(SPARK_CHARS[max(0, min(idx, len(SPARK_CHARS) - 1))])
+    return "".join(out)
+
+
+def render_timeline(name: str, samples: List[dict],
+                    field: str = "p99_ms") -> str:
+    """One terminal line for a series: sparkline + last value + span —
+    the ``debug timeline`` CLI row. Timer samples render their ``field``
+    (p99 by default); merged fleet samples with ``gap_nodes`` render the
+    slot as a gap when NO node contributed."""
+    values: List[Optional[float]] = []
+    for s in samples:
+        v = s.get("value")
+        if isinstance(v, dict):
+            v = v.get(field)
+        if v is None or (s.get("nodes") == 0):
+            values.append(None)
+            continue
+        try:
+            values.append(float(v))
+        except (TypeError, ValueError):
+            values.append(None)
+    present = [v for v in values if v is not None]
+    last = f"{present[-1]:.4g}" if present else "-"
+    lo = f"{min(present):.4g}" if present else "-"
+    hi = f"{max(present):.4g}" if present else "-"
+    span_s = 0
+    if len(samples) >= 2:
+        span_s = int((samples[-1]["ts_ms"] - samples[0]["ts_ms"]) / 1000)
+    gaps = sum(1 for s in samples if s.get("gap_nodes"))
+    gap_note = f" gaps={gaps}" if gaps else ""
+    return (f"{name:<36} {sparkline(values)} "
+            f"last={last} min={lo} max={hi} span={span_s}s{gap_note}")
+
+
+def _fit_slope(points: List[Tuple[float, float]]) -> float:
+    """Least-squares slope (value units per second) over (ts_s, value)
+    points; 0.0 when the fit is degenerate."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    mean_t = sum(p[0] for p in points) / n
+    mean_v = sum(p[1] for p in points) / n
+    num = sum((t - mean_t) * (v - mean_v) for t, v in points)
+    den = sum((t - mean_t) ** 2 for t, _ in points)
+    if den <= 0.0:
+        return 0.0
+    return num / den
+
+
+def _timer_view(value: dict) -> dict:
+    """Derived read-side view of a stored timer slot delta (p50/p99 from
+    the shared bucket geometry, deterministic upper-bound percentiles)."""
+    n = int(value.get("n", 0))
+    total = float(value.get("total", 0.0))
+    buckets = value.get("buckets") or {}
+    view = {"n": n,
+            "mean_ms": round(total / n * 1000, 3) if n else 0.0}
+    for q, key in ((0.50, "p50_ms"), (0.99, "p99_ms")):
+        if n <= 0:
+            view[key] = 0.0
+            continue
+        rank = max(1, -(-int(q * n * 1000) // 1000))  # ceil without math
+        rank = max(1, min(n, rank))
+        cum = 0
+        p = 0.0
+        for bi in sorted(int(i) for i in buckets):
+            cum += int(buckets[str(bi)] if str(bi) in buckets
+                       else buckets[bi])
+            if cum >= rank:
+                p = _metrics.BUCKET_BOUNDS[
+                    min(bi, len(_metrics.BUCKET_BOUNDS) - 1)]
+                break
+        else:
+            p = _metrics.BUCKET_BOUNDS[-1]
+        view[key] = round(p * 1000, 3)
+    return view
+
+
+def _merge_timer(a: dict, b: dict) -> dict:
+    buckets = dict(a.get("buckets") or {})
+    for bi, c in (b.get("buckets") or {}).items():
+        key = str(bi)
+        buckets[key] = buckets.get(key, 0) + int(c)
+    return {"n": int(a.get("n", 0)) + int(b.get("n", 0)),
+            "total": float(a.get("total", 0.0)) + float(b.get("total", 0.0)),
+            "buckets": buckets}
+
+
+class _Tier:
+    """One retention tier: wall-clock-aligned slots at a fixed interval,
+    at most one sample per slot per series, newest ``slots`` kept."""
+
+    __slots__ = ("interval", "slots", "series", "kinds", "last_slot",
+                 "_prev")
+
+    def __init__(self, interval: int, slots: int):
+        self.interval = int(interval)
+        self.slots = int(slots)
+        # name -> deque of [slot_start_s, value]
+        self.series: Dict[str, deque] = {}
+        self.kinds: Dict[str, str] = {}
+        self.last_slot = -1
+        # counter/timer cumulative baselines: name -> (ts_s, cumulative)
+        self._prev: Dict[str, Tuple[float, object]] = {}
+
+    def _push(self, name: str, kind: str, slot: int, value) -> None:
+        ring = self.series.get(name)
+        if ring is None:
+            ring = self.series[name] = deque(maxlen=self.slots)
+            self.kinds[name] = kind
+        if ring and ring[-1][0] == slot:
+            ring[-1][1] = value     # same slot resampled: last write wins
+        else:
+            ring.append([slot, value])
+
+    def record(self, now: float, counters: Dict[str, float],
+               gauges: Dict[str, float], timers: Dict[str, dict]) -> bool:
+        slot = (int(now) // self.interval) * self.interval
+        if slot == self.last_slot:
+            return False
+        self.last_slot = slot
+        for name, cur in counters.items():
+            prev = self._prev.get(name)
+            self._prev[name] = (now, float(cur))
+            if prev is None:
+                continue            # first sighting: baseline only
+            dt = now - prev[0]
+            if dt <= 0.0:
+                continue
+            rate = max(0.0, (float(cur) - float(prev[1]))) / dt
+            self._push(name, "counter", slot, rate)
+        for name, cur in gauges.items():
+            try:
+                self._push(name, "gauge", slot, float(cur))
+            except (TypeError, ValueError):
+                continue
+        for name, st in timers.items():
+            prev = self._prev.get("t:" + name)
+            cum_buckets = {str(k): int(v)
+                           for k, v in (st.get("buckets") or {}).items()}
+            cum = (int(st.get("count", 0)), float(st.get("total", 0.0)),
+                   cum_buckets)
+            self._prev["t:" + name] = (now, cum)
+            if prev is None:
+                continue
+            _, (pc, pt, pb) = prev
+            dn = cum[0] - pc
+            if dn < 0:              # registry reset: re-baseline
+                continue
+            dbuckets = {}
+            for bi, c in cum_buckets.items():
+                d = c - pb.get(bi, 0)
+                if d > 0:
+                    dbuckets[bi] = d
+            self._push(name, "timer", slot,
+                       {"n": dn, "total": max(0.0, cum[1] - pt),
+                        "buckets": dbuckets})
+        return True
+
+
+class TelemetryHistory:
+    """The per-node history sampler + query surface. One global instance
+    (``HISTORY``) rides the obs pre-drain chain; tests build their own
+    with an injected clock."""
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 tiers: Optional[List[Tuple[int, int]]] = None,
+                 registry=None):
+        self._clock = clock
+        self._reg = registry if registry is not None else _metrics.REGISTRY
+        self._tiers = [_Tier(i, n) for i, n in
+                       (tiers if tiers is not None
+                        else parse_tiers(config.HISTORY_TIERS.get()))]
+        self._lock = threading.Lock()
+        self._sampling = threading.local()
+        self._next_sample = 0.0
+        self.samples_taken = 0
+        self.series_dropped = 0
+
+    # -- series selection ------------------------------------------------
+
+    def _extra_names(self) -> List[str]:
+        return [p.strip() for p in
+                str(config.HISTORY_SERIES.get() or "").split(",")
+                if p.strip()]
+
+    def _select(self, state: dict):
+        """Pick the tracked (counters, gauges, timers) out of a registry
+        export_state payload, honoring the HISTORY_MAX_SERIES bound."""
+        extras = self._extra_names()
+        cap = max(1, int(config.HISTORY_MAX_SERIES.get()))
+        counters, gauges, timers = {}, {}, {}
+        budget = [cap]
+
+        def _take(out, pool, name):
+            if name in out or name not in pool:
+                return
+            if budget[0] <= 0:
+                self.series_dropped += 1
+                return
+            budget[0] -= 1
+            out[name] = pool[name]
+
+        c_pool = state.get("counters") or {}
+        g_pool = state.get("gauges") or {}
+        t_pool = state.get("timers") or {}
+        for name in DEFAULT_COUNTERS:
+            _take(counters, c_pool, name)
+        for name in DEFAULT_GAUGES:
+            _take(gauges, g_pool, name)
+        for name in DEFAULT_TIMERS:
+            _take(timers, t_pool, name)
+        for pat in extras:
+            if pat.endswith("."):
+                for pool, out in ((c_pool, counters), (g_pool, gauges),
+                                  (t_pool, timers)):
+                    for name in sorted(pool):
+                        if name.startswith(pat):
+                            _take(out, pool, name)
+            else:
+                for pool, out in ((c_pool, counters), (g_pool, gauges),
+                                  (t_pool, timers)):
+                    _take(out, pool, pat)
+        return counters, gauges, timers
+
+    # -- sampling --------------------------------------------------------
+
+    def maybe_sample(self) -> bool:
+        """Pre-drain hook entry: self-throttles to the finest tier
+        interval with a bare clock compare, so the common drain path
+        pays one float comparison. Reentrancy-guarded — taking a sample
+        reads the registry, which re-enters the pre-drain chain."""
+        if not config.HISTORY_ENABLED.get():
+            return False
+        if getattr(self._sampling, "busy", False):
+            return False
+        now = self._clock()
+        if now < self._next_sample:
+            return False
+        return self.sample_now(now)
+
+    def sample_now(self, now: Optional[float] = None) -> bool:
+        if getattr(self._sampling, "busy", False):
+            return False
+        self._sampling.busy = True
+        try:
+            if now is None:
+                now = self._clock()
+            state = self._reg.export_state()
+            counters, gauges, timers = self._select(state)
+            took = False
+            with self._lock:
+                finest = self._tiers[0].interval if self._tiers else 2
+                self._next_sample = (int(now) // finest + 1) * finest
+                for tier in self._tiers:
+                    if tier.record(now, counters, gauges, timers):
+                        took = True
+                if took:
+                    self.samples_taken += 1
+            return took
+        finally:
+            self._sampling.busy = False
+
+    # -- queries ---------------------------------------------------------
+
+    def _pick_tier(self, tier_s: Optional[int]) -> Optional[_Tier]:
+        if not self._tiers:
+            return None
+        if tier_s is None:
+            return self._tiers[0]
+        for t in self._tiers:
+            if t.interval == int(tier_s):
+                return t
+        return min(self._tiers, key=lambda t: abs(t.interval - int(tier_s)))
+
+    def range(self, name: str, since_ms: float = 0,
+              tier: Optional[int] = None) -> List[dict]:
+        """Retained samples for a series at/after ``since_ms`` wall time,
+        oldest first: [{"ts_ms", "value"}]; timer values carry the
+        derived n/mean/p50/p99 view."""
+        t = self._pick_tier(tier)
+        if t is None:
+            return []
+        with self._lock:
+            ring = list(t.series.get(name) or ())
+            kind = t.kinds.get(name, "gauge")
+        floor_s = float(since_ms) / 1000.0
+        out = []
+        for slot, value in ring:
+            if slot < floor_s:
+                continue
+            if kind == "timer":
+                value = _timer_view(value)
+            out.append({"ts_ms": int(slot * 1000), "value": value})
+        return out
+
+    def slope(self, name: str, since_ms: float = 0,
+              tier: Optional[int] = None,
+              field: Optional[str] = None) -> float:
+        """Least-squares trend of a series (value units per second) over
+        the retained window; ``field`` picks a component of a timer view
+        (e.g. ``p99_ms``)."""
+        pts = []
+        for sample in self.range(name, since_ms=since_ms, tier=tier):
+            v = sample["value"]
+            if isinstance(v, dict):
+                v = v.get(field or "p99_ms", 0.0)
+            try:
+                pts.append((sample["ts_ms"] / 1000.0, float(v)))
+            except (TypeError, ValueError):
+                continue
+        return _fit_slope(pts)
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            names = set()
+            for t in self._tiers:
+                names.update(t.series)
+        return sorted(names)
+
+    def memory_bytes(self) -> int:
+        """Honest bookkeeping estimate of retained-sample memory: ~64B
+        per scalar sample, plus 32B per sparse timer bucket. The bound
+        cfg17 reports and the knob table documents."""
+        total = 0
+        with self._lock:
+            for t in self._tiers:
+                for name, ring in t.series.items():
+                    for _, value in ring:
+                        if isinstance(value, dict):
+                            total += 64 + 32 * len(value.get("buckets") or ())
+                        else:
+                            total += 64
+        return total
+
+    def summary(self) -> dict:
+        with self._lock:
+            tiers = [{"interval_s": t.interval, "slots": t.slots,
+                      "series": len(t.series)} for t in self._tiers]
+        return {"enabled": bool(config.HISTORY_ENABLED.get()),
+                "tiers": tiers,
+                "series": self.series_names(),
+                "samples_taken": self.samples_taken,
+                "series_dropped": self.series_dropped,
+                "memory_bytes": self.memory_bytes()}
+
+    def export_state(self) -> dict:
+        """Mergeable history state for the ``/metrics?format=state``
+        scrape — equal-tier rings merge across nodes in the federator."""
+        out = []
+        with self._lock:
+            for t in self._tiers:
+                series = {}
+                for name, ring in t.series.items():
+                    series[name] = {"kind": t.kinds.get(name, "gauge"),
+                                    "samples": [[slot, value]
+                                                for slot, value in ring]}
+                out.append({"interval_s": t.interval, "slots": t.slots,
+                            "series": series})
+        return {"tiers": out}
+
+    def reset(self) -> None:
+        with self._lock:
+            for t in self._tiers:
+                t.series.clear()
+                t.kinds.clear()
+                t._prev.clear()
+                t.last_slot = -1
+            self.samples_taken = 0
+            self.series_dropped = 0
+            self._next_sample = 0.0
+
+
+def merge_states(states: List[dict],
+                 node_names: Optional[List[str]] = None) -> dict:
+    """Merge equal-tier history states from several nodes into fleet
+    timelines with honest per-node gap markers.
+
+    For each tier (matched by interval) and series, the merged ring holds
+    one entry per slot any node reported. ``nodes`` counts contributors;
+    ``gap_nodes`` names nodes that track the series (they have at least
+    one sample at/before the slot) but are missing this one — a pinned
+    scrape or dropped tick shows up as named gaps on the newest slots
+    instead of silently deflating the fleet sum."""
+    if node_names is None:
+        node_names = [f"node{i}" for i in range(len(states))]
+    tiers: Dict[int, dict] = {}
+    for node, state in zip(node_names, states):
+        for tstate in (state or {}).get("tiers", []):
+            try:
+                interval = int(tstate.get("interval_s", 0))
+            except (TypeError, ValueError):
+                continue
+            if interval <= 0:
+                continue
+            agg = tiers.setdefault(interval, {
+                "interval_s": interval,
+                "slots": int(tstate.get("slots", 0)),
+                "series": {}})
+            agg["slots"] = max(agg["slots"], int(tstate.get("slots", 0)))
+            for name, sdata in (tstate.get("series") or {}).items():
+                samples = sdata.get("samples") or []
+                if not samples:
+                    continue
+                dst = agg["series"].setdefault(
+                    name, {"kind": sdata.get("kind", "gauge"),
+                           "per_node": {}})
+                dst["per_node"][node] = {
+                    float(s[0]): s[1] for s in samples if len(s) == 2}
+    merged_tiers = []
+    for interval in sorted(tiers):
+        agg = tiers[interval]
+        series_out = {}
+        for name, dst in agg["series"].items():
+            kind = dst["kind"]
+            per_node = dst["per_node"]
+            all_slots = sorted({s for m in per_node.values() for s in m})
+            first_seen = {node: min(m) for node, m in per_node.items()}
+            merged = []
+            for slot in all_slots:
+                value = None
+                contributing = 0
+                gap_nodes = []
+                for node, m in per_node.items():
+                    if slot in m:
+                        contributing += 1
+                        v = m[slot]
+                        if value is None:
+                            value = (dict(v) if isinstance(v, dict)
+                                     else float(v))
+                        elif kind == "timer":
+                            value = _merge_timer(value, v)
+                        else:
+                            value = float(value) + float(v)
+                    elif first_seen[node] <= slot:
+                        gap_nodes.append(node)
+                if kind == "timer" and isinstance(value, dict):
+                    value = _timer_view(value)
+                merged.append({"ts_ms": int(slot * 1000), "value": value,
+                               "nodes": contributing,
+                               "gap_nodes": sorted(gap_nodes)})
+            series_out[name] = {"kind": kind, "samples": merged}
+        merged_tiers.append({"interval_s": interval,
+                             "slots": agg["slots"],
+                             "series": series_out})
+    return {"tiers": merged_tiers}
+
+
+class SeriesStore:
+    """Raw (ts, value) series with the doctor's windowed-delta semantics
+    — the migration target for the ad-hoc ``_delta`` deques every
+    windowed detector used to keep, plus the slope/projection helpers
+    the predictive rules consume. Each DoctorEngine owns ONE (test
+    isolation: a shared global would fire fresh doctors on preexisting
+    totals)."""
+
+    def __init__(self, maxlen: int = 512):
+        self._maxlen = maxlen
+        self._series: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, name: str, value: float, now: float,
+                window_s: float = 3600.0) -> None:
+        with self._lock:
+            samples = self._series.setdefault(
+                name, deque(maxlen=self._maxlen))
+            samples.append((float(now), float(value)))
+            while samples and now - samples[0][0] > window_s:
+                samples.popleft()
+
+    def _window(self, name: str, now: float,
+                window_s: float) -> List[Tuple[float, float]]:
+        with self._lock:
+            samples = self._series.get(name)
+            if not samples:
+                return []
+            return [(t, v) for t, v in samples if now - t <= window_s]
+
+    def window(self, name: str, now: float,
+               window_s: float) -> Tuple[float, float]:
+        """(per-minute rate, absolute delta) over the trailing window.
+        Fewer than two samples -> (0, 0): the first sighting of a
+        counter contributes no delta, so a fresh doctor never fires on
+        preexisting totals."""
+        pts = self._window(name, now, window_s)
+        if len(pts) < 2:
+            return 0.0, 0.0
+        dt = pts[-1][0] - pts[0][0]
+        dv = pts[-1][1] - pts[0][1]
+        if dt <= 0.0:
+            return 0.0, dv
+        return dv * 60.0 / dt, dv
+
+    def slope(self, name: str, now: float, window_s: float) -> float:
+        """Least-squares trend (units per second) over the window."""
+        return _fit_slope(self._window(name, now, window_s))
+
+    def points(self, name: str, now: float, window_s: float) -> int:
+        return len(self._window(name, now, window_s))
+
+    def last(self, name: str) -> Optional[float]:
+        with self._lock:
+            samples = self._series.get(name)
+            return samples[-1][1] if samples else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+HISTORY = TelemetryHistory()

@@ -89,9 +89,12 @@ class IncidentStore:
                 from geomesa_tpu.durability.rotation import rotate
                 self._fh.close()
                 self._fh = None
-                rotate(path, keep=1,
-                       on_drop=lambda p: self._reg.inc(
-                           "incident.journal_dropped"))
+                def _dropped(p):
+                    self._reg.inc("incident.journal_dropped")
+                    self._reg.inc("journal.gc")
+                rotate(path,
+                       keep=max(1, int(config.JOURNAL_KEEP.get())),
+                       on_drop=_dropped)
         except OSError:
             # a failing journal must never fail an evaluation
             self._reg.inc("incident.journal_errors")
@@ -202,10 +205,13 @@ class IncidentStore:
 
 
 def replay_journal(path: str) -> List[dict]:
-    """Read the incident journal back, rotated predecessor first — the
-    replay surface for post-mortems and the rotation test."""
+    """Read the incident journal back, oldest rotated generation first
+    (``path.N`` .. ``path.1``, then the live file) — the replay surface
+    for post-mortems and the rotation/retention tests."""
     out: List[dict] = []
-    for p in (f"{path}.1", path):
+    keep = max(1, int(config.JOURNAL_KEEP.get()))
+    generations = [f"{path}.{k}" for k in range(keep, 0, -1)]
+    for p in generations + [path]:
         try:
             with open(p, "rb") as fh:
                 for line in fh:

@@ -142,6 +142,19 @@ _OVERRIDES = {
     "cfg16_clean_incidents": "exact",
     "cfg16_shard_dark_fired": "exact",
     "cfg16_partial_envelope_seen": "exact",
+    # telemetry history plane (cfg17): the sampler tick, the amortized
+    # scrape-cadence overhead and the bundle freeze are costs that must
+    # only ever erode DOWN — retention creeping into the hot path is
+    # exactly what the <5% obs-overhead guard exists to catch, and the
+    # bench pins the trend early. Ring memory is structure-shaped (it
+    # tracks whatever series the registry happens to hold), so it is
+    # informational, not gated.
+    "cfg17_history_tick_us": "lower",
+    "cfg17_history_overhead_pct": "lower",
+    "cfg17_history_cost_us_per_query": "lower",
+    "cfg17_bundle_capture_ms": "lower",
+    "cfg17_ring_memory_bytes": "skip",
+    "cfg17_wall_s": "skip",
 }
 
 

@@ -1,0 +1,240 @@
+"""Ramped-handicap predictive drill: the trend page leads the burn page.
+
+The doctor's predictive claim (``slo_trend``, obs/doctor.py) is that a
+ramping burn rate opens an incident BEFORE the classic multi-window
+``slo_burn`` pages — prediction buys lead time, not noise. This drill
+proves both halves deterministically, the soak discipline
+(obs/soak.py) applied to a ramp:
+
+* **faulted half** — a real store serves real counts on a shared fake
+  clock (SLO windows elapse instantly; query durations stay real). A
+  kernel handicap (``profiling.arm_kernel_handicap``) is armed before a
+  fresh type's count kernels compile, so that type's counts are slow;
+  the drill then RAMPS the slow:fast traffic ratio step by step — a
+  monotone controlled burn ramp. Asserts: ``slo_trend`` opens strictly
+  before the first ``slo_burn`` page fires, and every opened incident
+  carries a fetchable forensic bundle whose history slice covers the
+  firing window.
+* **clean half** — the same traffic shape with no handicap and trend
+  rules ENABLED must open ZERO incidents (the false-positive guard a
+  predictive rule must clear before anyone trusts its pages).
+
+Determinism notes (the soak's, inherited):
+  * the latency objective threshold is calibrated off the measured warm
+    count, so the drill passes on a fast laptop and a loaded CI runner;
+    the handicap factor is derived from the same measurement so a "bad"
+    count lands ~3x over the threshold without minutes of sleeping
+  * skew/recompile bars go out of reach: single-plan synthetic traffic
+    IS skewed and fresh kernels DO compile — correct firings, not the
+    cause under test
+  * DOCTOR_CLEAR_TICKS goes out of reach so nothing auto-resolves
+    mid-ramp and the final bundle audit sees every incident
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from geomesa_tpu import config
+from geomesa_tpu.metrics import REGISTRY as _metrics
+from geomesa_tpu.obs.doctor import DoctorEngine
+from geomesa_tpu.obs.forensics import ForensicStore
+from geomesa_tpu.obs.history import TelemetryHistory
+
+_BOX = "BBOX(geom, -5, -5, 5, 5)"
+_STEP_S = 30.0          # fake seconds per ramp step
+_PER_STEP = 12          # counts per step (bad + good)
+_MAX_STEPS = 24
+
+
+class _Clock:
+    """Shared fake clock: SLO windows, doctor windows, history slots and
+    forensic anchors all advance together, instantly."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _bad_counts(step: int) -> int:
+    """The ramp: three clean baseline steps, then one more slow count
+    per step (a monotone controlled burn ramp, capped at all-slow)."""
+    return min(_PER_STEP, max(0, step - 2))
+
+
+def run(artifact: Optional[str] = None,
+        bundle_artifact: Optional[str] = None) -> dict:
+    """Run both halves; returns the scoreboard (``ok`` = both passed)."""
+    report: dict = {"ok": False, "halves": {}}
+    for half in ("faulted", "clean"):
+        report["halves"][half] = _run_half(faulted=half == "faulted")
+    f, c = report["halves"]["faulted"], report["halves"]["clean"]
+    report["ok"] = bool(f.get("ok") and c.get("ok"))
+    if artifact:
+        with open(artifact, "w") as fh:
+            json.dump(report, fh, indent=2, default=str)
+    if bundle_artifact and f.get("bundle"):
+        with open(bundle_artifact, "w") as fh:
+            json.dump(f["bundle"], fh, indent=2, default=str)
+        f.pop("bundle", None)
+    else:
+        f.pop("bundle", None)
+    return report
+
+
+def _run_half(faulted: bool) -> dict:
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.obs import profiling as _prof
+    from geomesa_tpu.obs import slo as _slo
+    from geomesa_tpu.replication.drills import SPEC, make_batch
+
+    _prof.reset_kernel_handicap()
+    knobs = [(config.DOCTOR_WINDOW_S, 300.0),
+             (config.DOCTOR_TREND, True),
+             (config.DOCTOR_TREND_LEAD_S, 180.0),
+             (config.DOCTOR_TREND_MIN_POINTS, 5),
+             (config.DOCTOR_RECOMPILES_PER_MIN, 10.0 ** 9),
+             (config.DOCTOR_SHED_PER_MIN, 10.0 ** 9),
+             (config.DOCTOR_SKEW_MIN, 10 ** 9),
+             (config.DOCTOR_CLEAR_TICKS, 10 ** 6),
+             (config.FORENSICS_ENABLED, True),
+             (config.HISTORY_ENABLED, True)]
+    saved = [(p, p._override) for p, _ in knobs]
+    for p, v in knobs:
+        p.set(v)
+    half: dict = {"faulted": faulted, "ok": False}
+    ds = None
+    try:
+        clock = _Clock()
+        ds = TpuDataStore()
+        ds.create_schema("t", SPEC)
+        ds.load("t", make_batch(ds.schemas["t"], 1))
+
+        # calibrate: threshold off the measured warm path, handicap off
+        # the threshold (a slow count lands ~3x over the bar)
+        for _ in range(4):
+            ds.count("t", _BOX)
+        t0 = time.perf_counter()
+        for _ in range(4):
+            ds.count("t", _BOX)
+        warm_ms = (time.perf_counter() - t0) * 250.0  # mean of 4, in ms
+        threshold_ms = max(60.0, 20.0 * warm_ms)
+        # the stretch multiplies the KERNEL dispatch time (a fraction of
+        # a count), so the factor is the soak's proven 2000x — a
+        # handicapped count lands hundreds of ms over a >=60ms bar
+        factor = 2000.0
+        half["threshold_ms"] = round(threshold_ms, 1)
+        half["handicap_factor"] = factor
+
+        if faulted:
+            # kernels compiled AFTER arming carry the stretch — the
+            # fresh type's count kernels compile inside the handicap
+            _prof.arm_kernel_handicap("count.", factor)
+        ds.create_schema("h", SPEC)
+        ds.load("h", make_batch(ds.schemas["h"], 2))
+
+        engine = _slo.SloEngine(registry=_metrics, clock=clock)
+        engine.add(_slo.Objective(
+            name="count_latency", kind="latency", target=0.99,
+            timer="query.count", threshold_ms=threshold_ms))
+        hist = TelemetryHistory(clock=clock, tiers=[(int(_STEP_S), 64)],
+                                registry=_metrics)
+        fstore = ForensicStore(registry=_metrics, history=hist,
+                               clock=clock)
+        doctor = DoctorEngine(registry=_metrics, clock=clock,
+                              slo_engine=engine, journal_path="",
+                              federator=False, forensics=fstore)
+        doctor.evaluate()   # the windows' baseline sample
+        hist.sample_now(clock())
+
+        t_trend = t_page = None
+        start = clock()
+        for step in range(_MAX_STEPS):
+            bad = _bad_counts(step) if faulted else 0
+            for _ in range(bad):
+                ds.count("h", _BOX)
+            for _ in range(_PER_STEP - bad):
+                ds.count("t", _BOX)
+            res = doctor.evaluate()
+            hist.sample_now(clock())
+            elapsed = clock() - start
+            for a in res.get("alerts", []):
+                if a["rule"] == "slo_trend" and t_trend is None:
+                    t_trend = elapsed
+                if a["rule"] == "slo_burn" and a["severity"] == "page" \
+                        and t_page is None:
+                    t_page = elapsed
+            if not faulted and step >= 12:
+                break
+            if t_page is not None:
+                break
+            clock.advance(_STEP_S)
+        _prof.reset_kernel_handicap()
+
+        half["t_trend_s"] = t_trend
+        half["t_page_s"] = t_page
+        half["opened_total"] = doctor.store.stats()["opened_total"]
+        half["incidents"] = [
+            {"id": i["id"], "rule": i["rule"], "cause": i["cause"]}
+            for i in doctor.store.all()]
+
+        if faulted:
+            bundles_ok = True
+            audit = []
+            for inc in doctor.store.all():
+                b = fstore.get(inc["id"])
+                entry = {"id": inc["id"], "bundle": b is not None}
+                if b is None:
+                    bundles_ok = False
+                else:
+                    # the slice must cover the firing window: it starts
+                    # at/before the (clock-anchored) open and holds at
+                    # least one retained sample inside it
+                    anchor = min(int(inc.get("opened_ms") or 0),
+                                 b["captured_ms"])
+                    covered = b["history"]["since_ms"] <= anchor
+                    sampled = any(
+                        b["history"]["since_ms"] <= s["ts_ms"]
+                        <= b["captured_ms"]
+                        for ss in b["history"]["series"].values()
+                        for s in ss)
+                    entry["covers_window"] = bool(covered and sampled)
+                    bundles_ok = bundles_ok and covered and sampled
+                audit.append(entry)
+            half["bundle_audit"] = audit
+            first = doctor.store.all()
+            half["bundle"] = fstore.get(first[0]["id"]) if first else None
+            half["ok"] = (t_trend is not None and t_page is not None
+                          and t_trend < t_page and bundles_ok)
+        else:
+            half["ok"] = half["opened_total"] == 0
+        return half
+    finally:
+        _prof.reset_kernel_handicap()
+        for p, v in saved:
+            if v is None:
+                p.unset()
+            else:
+                p.set(v)
+        if ds is not None:
+            ds.close()
+
+
+def main() -> int:
+    artifact = os.environ.get("GEOMESA_TPU_DRILL_ARTIFACT")
+    bundle = os.environ.get("GEOMESA_TPU_BUNDLE_ARTIFACT")
+    report = run(artifact=artifact, bundle_artifact=bundle)
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["ok"] else 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -354,8 +354,51 @@ def cmd_debug(args):
                                          slow_ms=args.slow,
                                          errors=args.errors,
                                          kind=args.kind,
-                                         type_name=args.feature)}
+                                         type_name=args.feature,
+                                         since_ms=args.since_ms)}
         print(json.dumps(out, indent=2, default=str))
+    elif args.what == "timeline":
+        # retained metric timelines as ASCII sparklines — this process's
+        # history rings, or a RUNNING node's GET /history via --addr
+        # (one row per series; --name narrows, --since-ms/--tier slice)
+        from geomesa_tpu.obs import history as _history
+        if args.addr:
+            import urllib.parse
+            import urllib.request
+            for addr in args.addr:
+                base = addr if addr.startswith("http") else f"http://{addr}"
+                prefix = f"{addr} " if len(args.addr) > 1 else ""
+                try:
+                    with urllib.request.urlopen(base + "/history",
+                                                timeout=5) as r:
+                        summary = json.loads(r.read().decode())["history"]
+                    names = summary.get("series") or []
+                    if args.name:
+                        names = [n for n in names if n == args.name]
+                    for n in names:
+                        q = f"/history?name={urllib.parse.quote(n)}"
+                        if args.since_ms is not None:
+                            q += f"&since_ms={args.since_ms}"
+                        if args.tier is not None:
+                            q += f"&tier={args.tier}"
+                        with urllib.request.urlopen(base + q,
+                                                    timeout=5) as r:
+                            samples = json.loads(
+                                r.read().decode())["samples"]
+                        print(prefix + _history.render_timeline(n, samples))
+                except OSError as e:
+                    print(f"{addr}: UNREACHABLE ({e})")
+        else:
+            h = _history.HISTORY
+            h.maybe_sample()    # a fresh CLI read still shows this tick
+            names = [args.name] if args.name else h.series_names()
+            if not names:
+                print("timeline: no retained series yet "
+                      "(GEOMESA_TPU_HISTORY off, or nothing sampled)")
+            for n in names:
+                print(_history.render_timeline(
+                    n, h.range(n, since_ms=args.since_ms or 0,
+                               tier=args.tier)))
     elif args.what == "replication":
         # fleet runbook surface: role/lag/ship state (from a RUNNING node
         # via --addr, since replication state lives in the serving
@@ -817,6 +860,53 @@ def cmd_doctor(args):
         print("doctor: no incidents — all detectors clear")
 
 
+def cmd_forensics(args):
+    """Forensic bundles the doctor froze at incident open: history
+    slices around the firing, matching flight events, retained trace
+    gids, replication/cell state, workload hot_set. Without --id, lists
+    the captured bundles; with --id, prints that incident's bundle.
+    --addr reads a RUNNING node's GET /incidents/{id}/bundle instead."""
+    if args.addr:
+        import urllib.request
+        out = {}
+        for addr in args.addr:
+            base = addr if addr.startswith("http") else f"http://{addr}"
+            if not args.id:
+                raise SystemExit("forensics --addr requires --id "
+                                 "INCIDENT_ID (list ids with "
+                                 "`geomesa-tpu doctor --addr ...`)")
+            try:
+                with urllib.request.urlopen(
+                        base + f"/incidents/{args.id}/bundle",
+                        timeout=5) as r:
+                    node = json.loads(r.read().decode())
+            except OSError as e:
+                node = {"error": str(e)}
+            if len(args.addr) == 1:
+                out.update(node)
+            else:
+                out.setdefault("nodes", {})[addr] = node
+        print(json.dumps(out, indent=2, default=str))
+        return
+    from geomesa_tpu.obs.forensics import FORENSICS
+    if args.id:
+        bundle = FORENSICS.get(args.id)
+        if bundle is None:
+            raise SystemExit(f"no forensic bundle for {args.id}")
+        print(json.dumps(bundle, indent=2, default=str))
+        return
+    bundles = FORENSICS.list()
+    if not bundles:
+        print("forensics: no bundles captured "
+              "(the doctor opens them with incidents)")
+        return
+    for b in bundles:
+        print(f"{b['incident_id']:<10} {b.get('rule', '?'):<20} "
+              f"captured_ms={b.get('captured_ms')} "
+              f"events={b.get('events')} series={b.get('series')} "
+              f"cause={b.get('cause')}")
+
+
 def cmd_remove_schema(args):
     store = _load(args.store, must_exist=True)
     store.remove_schema(args.feature)
@@ -939,7 +1029,7 @@ def build_parser() -> argparse.ArgumentParser:
                                      "slo", "kernels", "scheduler", "cache",
                                      "admission", "wal", "replication",
                                      "workload", "incidents", "cluster",
-                                     "balance"))
+                                     "balance", "timeline"))
     sp.add_argument("-s", "--store", help="store to exercise first (optional)")
     sp.add_argument("-f", "--feature", help="feature type for the warm query "
                                             "(also the type filter for "
@@ -957,6 +1047,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--kind", default=None,
                     help="match record kind / trace name / a span kind "
                          "present in the stage breakdown")
+    sp.add_argument("--since-ms", type=float, default=None, dest="since_ms",
+                    metavar="EPOCH_MS",
+                    help="`debug events`/`debug timeline`: only records/"
+                         "samples stamped at/after this wall time — the "
+                         "same slice filter a forensic bundle uses")
+    sp.add_argument("--name", default=None,
+                    help="for `debug timeline`: only this history series")
+    sp.add_argument("--tier", type=int, default=None, metavar="SECONDS",
+                    help="for `debug timeline`: pick the ring tier by "
+                         "interval (default: the finest)")
     sp.add_argument("--addr", action="append", default=None,
                     metavar="HOST:PORT",
                     help="a RUNNING node to query (repeatable). "
@@ -1041,6 +1141,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="print the raw incident JSON instead of verdicts")
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser(
+        "forensics",
+        help="forensic bundles the doctor froze at incident open "
+             "(history slices, matching events, trace gids, workload "
+             "hot_set): list bundles, or print one with --id; --addr "
+             "reads a running node's /incidents/{id}/bundle")
+    sp.add_argument("--id", default=None, metavar="INCIDENT_ID",
+                    help="print this incident's bundle (e.g. inc-3)")
+    sp.add_argument("--addr", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="a RUNNING node's REST address (repeatable); "
+                         "requires --id")
+    sp.set_defaults(fn=cmd_forensics)
 
     sp = sub.add_parser(
         "fleet",

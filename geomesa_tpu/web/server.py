@@ -228,11 +228,13 @@ class GeoJsonApi:
                 # (lossless cross-node histogram merge), tagged with this
                 # node's fleet identity; workload rollup/sketch state rides
                 # the same payload so one scrape carries both
+                from geomesa_tpu.obs.history import HISTORY
                 from geomesa_tpu.obs.shardwatch import WATCH
                 from geomesa_tpu.obs.workload import WORKLOAD
                 state = REGISTRY.export_state()
                 state["workload"] = WORKLOAD.export_state()
                 state["shardwatch"] = WATCH.export_state()
+                state["history"] = HISTORY.export_state()
                 return 200, {"node": self._node_meta(), "state": state}
             return 200, REGISTRY.snapshot()
         if parts == ["traces"]:
@@ -256,13 +258,15 @@ class GeoJsonApi:
             # flight recorder: wide events filtered by the shared predicate
             from geomesa_tpu.obs.flight import RECORDER
             slow = query.get("slow_ms", [None])[0]
+            since = query.get("since_ms", [None])[0]
             return 200, {"events": RECORDER.recent(
                 limit=int(query.get("limit", [100])[0]),
                 slow_ms=float(slow) if slow is not None else None,
                 errors=query.get("error", [None])[0]
                 not in (None, "0", "false"),
                 kind=query.get("kind", [None])[0],
-                type_name=query.get("type", [None])[0]),
+                type_name=query.get("type", [None])[0],
+                since_ms=float(since) if since is not None else None),
                 "recorder": RECORDER.stats()}
         if parts == ["slo"]:
             from geomesa_tpu.obs.slo import ENGINE
@@ -277,6 +281,31 @@ class GeoJsonApi:
             active = query.get("active", [None])[0] \
                 not in (None, "0", "false")
             return 200, DOCTOR.incidents(active_only=active)
+        if len(parts) == 3 and parts[0] == "incidents" \
+                and parts[2] == "bundle":
+            # the forensic bundle frozen when the doctor opened this
+            # incident: history slices around the firing, matching flight
+            # events, trace gids, replication/cell state, workload hot_set
+            from geomesa_tpu.obs.forensics import FORENSICS
+            bundle = FORENSICS.get(parts[1])
+            if bundle is None:
+                return 404, {"error": f"no forensic bundle for "
+                                      f"{parts[1]}"}
+            return 200, bundle
+        if parts == ["history"]:
+            # retained metric timelines: ?name=series&since_ms=&tier= for
+            # a range; without ?name=, the sampler summary + series index
+            from geomesa_tpu.obs.history import HISTORY
+            name = query.get("name", [None])[0]
+            if name is None:
+                return 200, {"history": HISTORY.summary()}
+            since = float(query.get("since_ms", [0])[0])
+            tier = query.get("tier", [None])[0]
+            return 200, {"name": name, "since_ms": since,
+                         "samples": HISTORY.range(
+                             name, since_ms=since,
+                             tier=int(tier) if tier is not None
+                             else None)}
         if parts == ["workload"]:
             # streaming workload analytics: windowed rollups, heavy-hitter
             # plan hashes / tenants, hot spatial cells (query LOAD, not data)
@@ -360,6 +389,10 @@ class GeoJsonApi:
                 # fleet-wide shard balance: merged shardwatch + workload
                 # states joined through the same ledger a node runs
                 return 200, fed.fleet_balance()
+            if parts == ["fleet", "history"]:
+                # fleet timelines: equal-tier rings merged at aligned
+                # slots with honest per-node gap markers
+                return 200, fed.fleet_history()
             return 404, {"error": f"no route {method} {path}"}
         if parts == ["cluster", "balance"]:
             # the shard balance observatory: per-shard load shares joined

@@ -341,7 +341,7 @@ def test_verdict_is_one_line_with_suspect_and_trace():
                           "shed_storm", "breaker_flapping",
                           "wal_fsync_stall", "hot_skew", "reindex_churn",
                           "shard_imbalance", "collective_straggler",
-                          "shard_dark"}
+                          "shard_dark", "slo_trend", "capacity_trend"}
 
 
 # -- journal: rotation + replay (satellite) -----------------------------------

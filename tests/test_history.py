@@ -1,0 +1,719 @@
+"""Telemetry history plane + forensic bundles (obs/history.py,
+obs/forensics.py) and the trend-driven doctor rules they feed.
+
+Covers the ISSUE 20 acceptance tests: ring conservation under 8
+concurrent producer threads, fleet merge == single-process oracle on
+equal-start rings, gap honesty when one node's scrape is pinned,
+forensic-bundle atomicity under an injected crash mid-capture — plus
+the since_ms event slice, journal keep-N retention, and fast unit
+tests for the predictive slo_trend / capacity_trend rules (the full
+ramped-handicap drill is the slow-marked test at the bottom).
+"""
+
+import contextlib
+import json
+import os
+import threading
+
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.durability import faults
+from geomesa_tpu.metrics import MetricsRegistry
+from geomesa_tpu.obs.doctor import DoctorEngine
+from geomesa_tpu.obs.forensics import ForensicStore
+from geomesa_tpu.obs.history import (SeriesStore, TelemetryHistory,
+                                     merge_states, parse_tiers,
+                                     render_timeline, sparkline)
+from geomesa_tpu.obs.incidents import IncidentStore, replay_journal
+from geomesa_tpu.obs.slo import PAGE_BURN
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@contextlib.contextmanager
+def _knobs(*pairs):
+    saved = [(p, p._override) for p, _ in pairs]
+    try:
+        for p, v in pairs:
+            p.set(v)
+        yield
+    finally:
+        for p, v in saved:
+            if v is None:
+                p.unset()
+            else:
+                p.set(v)
+
+
+# -- tier parsing / rendering -------------------------------------------------
+
+
+def test_parse_tiers_sorted_and_fallback():
+    assert parse_tiers("30:240,2:300") == [(2, 300), (30, 240)]
+    assert parse_tiers("garbage,5:xx") == [(2, 300), (30, 240)]
+    assert parse_tiers("") == [(2, 300), (30, 240)]
+    # bounds clamp: interval >= 1, slots >= 2
+    assert parse_tiers("0:1") == [(1, 2)]
+
+
+def test_sparkline_renders_gaps_as_dots():
+    line = sparkline([0.0, 5.0, None, 10.0])
+    assert len(line) == 4
+    assert line[2] == "."
+    assert line[3] == "█"
+    assert sparkline([None, None]) == ".."
+
+
+def test_render_timeline_counts_gaps_and_span():
+    samples = [
+        {"ts_ms": 1000_000, "value": 1.0},
+        {"ts_ms": 1002_000, "value": None, "nodes": 0,
+         "gap_nodes": ["n2"]},
+        {"ts_ms": 1004_000, "value": 3.0, "gap_nodes": ["n2"]},
+    ]
+    row = render_timeline("scheduler.queries", samples)
+    assert "scheduler.queries" in row
+    assert "gaps=2" in row
+    assert "span=4s" in row
+
+
+# -- sampling semantics -------------------------------------------------------
+
+
+def test_counter_first_sighting_is_baseline_only():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    h = TelemetryHistory(clock=clock, tiers=[(2, 16)], registry=reg)
+    reg.inc("scheduler.queries", 100)     # preexisting total
+    h.sample_now(clock())
+    assert h.range("scheduler.queries") == []   # baseline, no fabricated spike
+    clock.advance(2.0)
+    reg.inc("scheduler.queries", 8)
+    h.sample_now(clock())
+    samples = h.range("scheduler.queries")
+    assert len(samples) == 1
+    assert samples[0]["value"] == pytest.approx(4.0)   # 8 over 2s
+
+
+def test_gauge_and_timer_slot_views():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    h = TelemetryHistory(clock=clock, tiers=[(2, 16)], registry=reg)
+    reg.set_gauge("replication.lag_ms", 12.5)
+    reg.observe("query.count", 0.003)     # timer baseline for the deltas
+    h.sample_now(clock())
+    clock.advance(2.0)
+    reg.set_gauge("replication.lag_ms", 17.5)
+    for _ in range(90):
+        reg.observe("query.count", 0.001)
+    for _ in range(10):
+        reg.observe("query.count", 0.5)
+    h.sample_now(clock())
+    gauges = h.range("replication.lag_ms")
+    assert [s["value"] for s in gauges] == [12.5, 17.5]
+    timers = h.range("query.count")
+    assert len(timers) == 1
+    view = timers[0]["value"]
+    assert view["n"] == 100
+    # p50 lands on the 1ms bucket bound, p99 at/above the 0.5s outlier
+    assert 0.5 <= view["p50_ms"] <= 2.0
+    assert view["p99_ms"] >= 400.0
+
+
+def test_since_ms_floor_and_tier_pick():
+    reg = MetricsRegistry()
+    clock = FakeClock(1000.0)
+    h = TelemetryHistory(clock=clock, tiers=[(2, 32), (10, 8)],
+                         registry=reg)
+    for _ in range(6):
+        reg.set_gauge("incident.active", clock())
+        h.sample_now(clock())
+        clock.advance(2.0)
+    full = h.range("incident.active")
+    late = h.range("incident.active", since_ms=full[3]["ts_ms"])
+    assert len(late) == len(full) - 3
+    assert late[0]["ts_ms"] == full[3]["ts_ms"]
+    coarse = h.range("incident.active", tier=10)
+    assert len(coarse) >= 1
+    assert all(s["ts_ms"] % 10_000 == 0 for s in coarse)
+
+
+def test_max_series_cap_drops_and_counts():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    for name in ("scheduler.queries", "admission.shed",
+                 "kernels.recompiles", "breaker.open"):
+        reg.inc(name, 3)
+    with _knobs((config.HISTORY_MAX_SERIES, 2)):
+        h = TelemetryHistory(clock=clock, tiers=[(2, 8)], registry=reg)
+        h.sample_now(clock())
+        clock.advance(2.0)
+        h.sample_now(clock())
+        assert len(h.series_names()) <= 2
+        assert h.series_dropped > 0
+        assert h.summary()["series_dropped"] == h.series_dropped
+
+
+def test_extra_series_prefix_selector():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    reg.inc("custom.alpha", 1)
+    reg.inc("custom.beta", 1)
+    reg.inc("other.gamma", 1)
+    with _knobs((config.HISTORY_SERIES, "custom.")):
+        h = TelemetryHistory(clock=clock, tiers=[(2, 8)], registry=reg)
+        h.sample_now(clock())
+        clock.advance(2.0)
+        reg.inc("custom.alpha", 4)
+        reg.inc("other.gamma", 4)
+        h.sample_now(clock())
+        names = h.series_names()
+    assert "custom.alpha" in names
+    assert "custom.beta" in names
+    assert "other.gamma" not in names
+
+
+def test_pre_drain_hook_samples_global_history():
+    """Reading the global registry drives the global sampler (the
+    producers-pay-nothing wiring in obs/__init__)."""
+    import geomesa_tpu.obs  # noqa: F401  (installs the pre-drain chain)
+    from geomesa_tpu.metrics import REGISTRY
+    from geomesa_tpu.obs.history import HISTORY
+    before = HISTORY.samples_taken
+    try:
+        HISTORY._next_sample = 0.0
+        REGISTRY.inc("scheduler.queries", 1)
+        REGISTRY.snapshot()
+        assert HISTORY.samples_taken >= before  # no recursion, no raise
+    finally:
+        HISTORY.reset()
+
+
+# -- ring conservation under concurrency --------------------------------------
+
+
+def test_ring_conservation_under_8_producer_threads():
+    reg = MetricsRegistry()
+    lock = threading.Lock()
+    state = {"t": 1000.0}
+
+    def clock():
+        with lock:
+            state["t"] += 0.26
+            return state["t"]
+
+    h = TelemetryHistory(clock=clock, tiers=[(1, 8), (5, 4)], registry=reg)
+    errors = []
+
+    def worker(i):
+        try:
+            for k in range(50):
+                reg.inc("scheduler.queries", 1)
+                reg.observe("query.count", 0.001 * (i + 1))
+                reg.set_gauge("replication.lag_ms", float(i * 50 + k))
+                h.sample_now()
+                if k % 10 == 0:
+                    h.range("scheduler.queries")
+                    h.export_state()
+                    h.memory_bytes()
+        except Exception as e:   # pragma: no cover - failure detail
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    state_out = h.export_state()
+    assert h.samples_taken > 0
+    for tier in state_out["tiers"]:
+        interval = tier["interval_s"]
+        for name, sdata in tier["series"].items():
+            samples = sdata["samples"]
+            assert len(samples) <= tier["slots"]
+            slots = [s[0] for s in samples]
+            # wall-aligned, strictly increasing: no torn/duplicate slots
+            assert slots == sorted(slots)
+            assert len(set(slots)) == len(slots)
+            assert all(int(s) % interval == 0 for s in slots)
+            for _, value in samples:
+                if sdata["kind"] == "timer":
+                    assert value["n"] >= 0
+                    assert all(int(c) > 0
+                               for c in value["buckets"].values())
+                else:
+                    assert float(value) >= 0.0
+
+
+def test_series_store_safe_under_threads():
+    store = SeriesStore(maxlen=64)
+    errors = []
+
+    def worker(i):
+        try:
+            for k in range(200):
+                now = 1000.0 + k
+                store.observe(f"s{i % 2}", float(k), now)
+                store.window(f"s{i % 2}", now, 60.0)
+                store.slope(f"s{i % 2}", now, 60.0)
+        except Exception as e:   # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert store.points("s0", 1200.0, 10_000.0) <= 64
+
+
+# -- fleet merge --------------------------------------------------------------
+
+
+def _sample_all(histories, ts):
+    for h in histories:
+        h.sample_now(ts)
+
+
+def test_fleet_merge_matches_single_process_oracle():
+    """Two nodes' merged timeline must equal what ONE process observing
+    all the traffic would have retained — rates sum, gauge levels sum,
+    timer bucket deltas sum into identical derived percentiles."""
+    r1, r2, r0 = MetricsRegistry(), MetricsRegistry(), MetricsRegistry()
+    mk = lambda reg: TelemetryHistory(clock=lambda: 0.0,
+                                      tiers=[(2, 32)], registry=reg)
+    h1, h2, h0 = mk(r1), mk(r2), mk(r0)
+    ts = 1000.0
+    for step in range(6):
+        a, b = 3 + step, 7 + 2 * step
+        r1.inc("scheduler.queries", a)
+        r2.inc("scheduler.queries", b)
+        r0.inc("scheduler.queries", a + b)
+        for d in (0.001 * (step + 1), 0.05):
+            r1.observe("query.count", d)
+            r0.observe("query.count", d)
+        r2.observe("query.count", 0.2)
+        r0.observe("query.count", 0.2)
+        r1.set_gauge("replication.lag_ms", 10.0 + step)
+        r2.set_gauge("replication.lag_ms", 20.0 + step)
+        r0.set_gauge("replication.lag_ms", 30.0 + 2 * step)
+        _sample_all((h1, h2, h0), ts)
+        ts += 2.0
+
+    merged = merge_states([h1.export_state(), h2.export_state()],
+                          node_names=["n1", "n2"])
+    assert len(merged["tiers"]) == 1
+    mseries = merged["tiers"][0]["series"]
+    oracle = {name: h0.range(name)
+              for name in ("scheduler.queries", "replication.lag_ms",
+                           "query.count")}
+    for name in oracle:
+        ms = mseries[name]["samples"]
+        os_ = oracle[name]
+        assert [s["ts_ms"] for s in ms] == [s["ts_ms"] for s in os_]
+        assert all(s["nodes"] == 2 and not s["gap_nodes"] for s in ms)
+        for got, want in zip(ms, os_):
+            if isinstance(want["value"], dict):   # timer view
+                assert got["value"]["n"] == want["value"]["n"]
+                assert got["value"]["p50_ms"] == want["value"]["p50_ms"]
+                assert got["value"]["p99_ms"] == want["value"]["p99_ms"]
+                assert got["value"]["mean_ms"] == pytest.approx(
+                    want["value"]["mean_ms"], abs=1e-6)
+            else:
+                assert got["value"] == pytest.approx(want["value"])
+
+
+def test_merge_names_gaps_for_pinned_node():
+    """A node whose scrape is pinned (its ring stops advancing) is named
+    in gap_nodes on the newest slots instead of silently deflating the
+    fleet sum; slots before a node's first sample are NOT its gaps."""
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    h1 = TelemetryHistory(clock=lambda: 0.0, tiers=[(2, 32)], registry=r1)
+    h2 = TelemetryHistory(clock=lambda: 0.0, tiers=[(2, 32)], registry=r2)
+    ts = 1000.0
+    for step in range(10):
+        r1.set_gauge("incident.active", 1.0)
+        h1.sample_now(ts)
+        if 2 <= step < 5:       # n2 joins late, then its scrape pins
+            r2.set_gauge("incident.active", 1.0)
+            h2.sample_now(ts)
+        ts += 2.0
+    merged = merge_states([h1.export_state(), h2.export_state()],
+                          node_names=["n1", "n2"])
+    samples = merged["tiers"][0]["series"]["incident.active"]["samples"]
+    assert len(samples) == 10
+    # before n2's first sample: not a gap (it didn't exist yet)
+    for s in samples[:2]:
+        assert s["nodes"] == 1 and s["gap_nodes"] == []
+        assert s["value"] == pytest.approx(1.0)
+    # overlap: both contribute, gauge levels sum
+    for s in samples[2:5]:
+        assert s["nodes"] == 2 and s["gap_nodes"] == []
+        assert s["value"] == pytest.approx(2.0)
+    # pinned: every newer slot names n2 as the hole
+    for s in samples[5:]:
+        assert s["nodes"] == 1 and s["gap_nodes"] == ["n2"]
+        assert s["value"] == pytest.approx(1.0)
+
+
+# -- flight since_ms slice ----------------------------------------------------
+
+
+def test_flight_recent_since_ms_slice():
+    from geomesa_tpu.obs.flight import FlightRecorder
+    rec = FlightRecorder(keep=16)
+    for ts in (100, 200, 300):
+        rec.record({"type": "query.slow", "ts_ms": ts, "gid": f"g{ts}"})
+    assert len(rec.recent()) == 3
+    sliced = rec.recent(since_ms=150)
+    assert [e["ts_ms"] for e in sliced] == [300, 200]   # newest first
+    assert rec.recent(since_ms=301) == []
+
+
+# -- journal keep-N retention -------------------------------------------------
+
+
+def test_journal_keep_n_gc_and_replay_order(tmp_path):
+    path = str(tmp_path / "incidents.jsonl")
+    reg = MetricsRegistry()
+    with _knobs((config.JOURNAL_KEEP, 2)):
+        store = IncidentStore(journal_path=path, registry=reg,
+                              max_bytes=1)   # rotate on every record
+        for i in range(6):
+            store.open_or_update(
+                {"rule": "shed_storm", "severity": "page",
+                 "cause": f"c{i}", "detail": {}, "suspect": {},
+                 "match": {}}, {"trace_gids": []}, 1000.0 + i)
+        assert os.path.exists(path + ".1")
+        assert os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")   # keep-N bound holds
+        assert reg.snapshot()["counters"]["journal.gc"] >= 1
+        assert reg.snapshot()["counters"]["incident.journal_dropped"] >= 1
+        records = replay_journal(path)
+    # oldest surviving generation first, strictly newer toward the tail
+    causes = [r.get("cause") for r in records if r.get("cause")]
+    assert causes == sorted(causes)
+    assert causes[-1] == "c5"
+
+
+# -- forensic bundles ---------------------------------------------------------
+
+
+def _mk_forensics(tmp_path, keep=4):
+    reg = MetricsRegistry()
+    clock = FakeClock(2000.0)
+    hist = TelemetryHistory(clock=clock, tiers=[(2, 32)], registry=reg)
+    reg.inc("scheduler.queries", 5)
+    hist.sample_now(clock())
+    clock.advance(2.0)
+    reg.inc("scheduler.queries", 5)
+    hist.sample_now(clock())
+    fstore = ForensicStore(dir_path=str(tmp_path), keep=keep,
+                           registry=reg, history=hist, clock=clock)
+    return reg, clock, hist, fstore
+
+
+def _incident(clock, n=1):
+    return {"id": f"inc-{n}", "rule": "slo_burn", "cause": f"cause-{n}",
+            "severity": "page", "opened_ms": int(clock() * 1000),
+            "timeline": {"trace_gids": ["g1"]}}
+
+
+def test_bundle_atomic_under_injected_crash(tmp_path):
+    reg, clock, hist, fstore = _mk_forensics(tmp_path)
+    faults.arm("snapshot.written")
+    try:
+        with pytest.raises(faults.InjectedCrash):
+            fstore.capture(_incident(clock, 1))
+    finally:
+        faults.reset()
+    # the crash landed BEFORE the rename: no torn final bundle exists
+    finals = [f for f in os.listdir(str(tmp_path))
+              if f.startswith("bundle-") and f.endswith(".json")]
+    assert finals == []
+    # recovery: the same capture path installs a complete bundle
+    bundle = fstore.capture(_incident(clock, 1))
+    assert bundle is not None
+    finals = [f for f in os.listdir(str(tmp_path))
+              if f.startswith("bundle-") and f.endswith(".json")]
+    assert len(finals) == 1
+    with open(os.path.join(str(tmp_path), finals[0])) as fh:
+        on_disk = json.load(fh)     # parses: never half-written
+    assert on_disk["incident_id"] == "inc-1"
+    assert on_disk["history"]["series"]["scheduler.queries"]
+    counters = reg.snapshot()["counters"]
+    assert counters.get("forensics.errors", 0) >= 1
+    assert counters.get("forensics.captured", 0) >= 1
+
+
+def test_bundle_slice_covers_firing_window(tmp_path):
+    reg, clock, hist, fstore = _mk_forensics(tmp_path)
+    inc = _incident(clock, 7)
+    bundle = fstore.capture(inc)
+    assert bundle["history"]["since_ms"] <= inc["opened_ms"]
+    samples = bundle["history"]["series"]["scheduler.queries"]
+    assert any(bundle["history"]["since_ms"] <= s["ts_ms"]
+               <= bundle["captured_ms"] for s in samples)
+    # fetch surface: memory hit and (cleared) durable-dir fallback
+    assert fstore.get("inc-7")["incident_id"] == "inc-7"
+    fstore.clear()
+    assert fstore.get("inc-7")["incident_id"] == "inc-7"   # from disk
+    assert fstore.get("inc-missing") is None
+
+
+def test_bundle_keep_n_gc(tmp_path):
+    reg, clock, hist, fstore = _mk_forensics(tmp_path, keep=2)
+    for n in range(1, 5):
+        fstore.capture(_incident(clock, n))
+        clock.advance(1.0)
+    finals = sorted(f for f in os.listdir(str(tmp_path))
+                    if f.startswith("bundle-") and f.endswith(".json"))
+    assert len(finals) == 2
+    assert finals[-1].endswith("-inc-4.json")
+    assert reg.snapshot()["counters"].get("forensics.gc", 0) >= 2
+    assert len(fstore.list()) == 4      # memory ring keeps its own bound
+
+
+def test_forensics_disabled_knob(tmp_path):
+    reg, clock, hist, fstore = _mk_forensics(tmp_path)
+    with _knobs((config.FORENSICS_ENABLED, False)):
+        assert fstore.capture(_incident(clock, 9)) is None
+    assert fstore.get("inc-9") is None
+
+
+# -- trend-driven doctor rules ------------------------------------------------
+
+
+class _NoWorkload:
+    def hot_set(self, k=None):
+        return {"total": 0, "plans": [], "cells": []}
+
+    def top_tenants(self, k=10):
+        return []
+
+
+class _RampSlo:
+    """Scripted SLO engine: the doctor sees whatever burn we set."""
+
+    def __init__(self):
+        self.burn = 0.0
+        self.status = "ok"
+
+    def evaluate(self):
+        return {"lat": {"status": self.status,
+                        "burn_rates": {"5m": self.burn, "1h": self.burn},
+                        "compliance": 1.0, "error_budget": 0.01}}
+
+
+class _RampShard:
+    def __init__(self):
+        self.mom = 1.0
+        self.over = False
+        self.active = True
+
+    def balance(self):
+        return {"active": self.active, "types": {"pts": {
+            "score": {"max_over_mean": self.mom, "bar": 2.0,
+                      "over_bar": self.over, "hot_shard": "3",
+                      "guaranteed_total": 100.0},
+            "shards": {"3": {"load_share": 0.5,
+                             "key_range": [0, 10]}}}}}
+
+
+_TREND_KNOBS = ((config.DOCTOR_TREND, True),
+                (config.DOCTOR_TREND_LEAD_S, 120.0),
+                (config.DOCTOR_TREND_MIN_POINTS, 3),
+                (config.DOCTOR_WINDOW_S, 600.0),
+                (config.DOCTOR_CAPACITY_LEAD_S, 600.0))
+
+
+def _mk_doctor(reg, clock, slo, shard=None, forensics=False):
+    return DoctorEngine(
+        registry=reg, clock=clock, slo_engine=slo, federator=False,
+        workload=_NoWorkload(), shardwatch=shard or _RampShard(),
+        store=IncidentStore(journal_path="", registry=reg),
+        forensics=forensics)
+
+
+def test_slo_trend_fires_on_ramp_before_the_bar():
+    reg, clock, slo = MetricsRegistry(), FakeClock(), _RampSlo()
+    doc = _mk_doctor(reg, clock, slo)
+    with _knobs(*_TREND_KNOBS):
+        fired = []
+        for burn in (1.0, 3.0, 5.0, 7.0):
+            slo.burn = burn
+            res = doc.evaluate()
+            fired.append([a for a in res["alerts"]
+                          if a["rule"] == "slo_trend"])
+            clock.advance(30.0)
+        # slope 2/30 per s: projection crosses 14.4 only at burn=7
+        assert fired[0] == [] and fired[1] == [] and fired[2] == []
+        assert len(fired[3]) == 1
+        a = fired[3][0]
+        assert a["severity"] == "page"
+        assert a["cause"] == "trend-slo:lat"
+        assert a["detail"]["burn_5m"] < PAGE_BURN
+        assert a["detail"]["projected"] >= PAGE_BURN
+        assert a["detail"]["eta_s"] > 0
+        assert a["suspect"]["page_projected_in_s"] == a["detail"]["eta_s"]
+
+
+def test_slo_trend_never_shadows_the_actual_page():
+    reg, clock, slo = MetricsRegistry(), FakeClock(), _RampSlo()
+    doc = _mk_doctor(reg, clock, slo)
+    with _knobs(*_TREND_KNOBS):
+        for burn in (5.0, 10.0):
+            slo.burn = burn
+            doc.evaluate()
+            clock.advance(30.0)
+        slo.burn, slo.status = 20.0, "page"
+        res = doc.evaluate()
+        rules = [a["rule"] for a in res["alerts"]]
+        assert "slo_burn" in rules
+        assert "slo_trend" not in rules
+
+
+def test_slo_trend_silent_on_flat_burn_and_when_disabled():
+    reg, clock, slo = MetricsRegistry(), FakeClock(), _RampSlo()
+    doc = _mk_doctor(reg, clock, slo)
+    with _knobs(*_TREND_KNOBS):
+        for _ in range(5):                   # flat: slope 0, no page coming
+            slo.burn = 5.0
+            res = doc.evaluate()
+            assert [a for a in res["alerts"]
+                    if a["rule"] == "slo_trend"] == []
+            clock.advance(30.0)
+    reg2, clock2, slo2 = MetricsRegistry(), FakeClock(), _RampSlo()
+    doc2 = _mk_doctor(reg2, clock2, slo2)
+    with _knobs(*(_TREND_KNOBS[1:] + ((config.DOCTOR_TREND, False),))):
+        for burn in (1.0, 4.0, 7.0, 10.0):   # steep ramp, rules off
+            slo2.burn = burn
+            res = doc2.evaluate()
+            assert [a for a in res["alerts"]
+                    if a["rule"] == "slo_trend"] == []
+            clock2.advance(30.0)
+
+
+def test_capacity_trend_projects_time_to_imbalance():
+    reg, clock = MetricsRegistry(), FakeClock()
+    shard = _RampShard()
+    doc = _mk_doctor(reg, clock, _RampSlo(), shard=shard)
+    with _knobs(*_TREND_KNOBS):
+        alerts = []
+        for mom in (1.0, 1.2, 1.4, 1.6):
+            shard.mom = mom
+            res = doc.evaluate()
+            alerts.extend(a for a in res["alerts"]
+                          if a["rule"] == "capacity_trend")
+            clock.advance(60.0)
+        assert alerts, "ramping max-over-mean must open a predictive ticket"
+        a = alerts[-1]
+        assert a["severity"] == "ticket"
+        assert a["cause"] == "trend-shard:pts"
+        assert a["suspect"]["shard"] == "3"
+        assert 0 < a["detail"]["eta_s"] <= 600.0
+        assert a["detail"]["max_over_mean"] < a["detail"]["bar"]
+
+
+def test_capacity_trend_yields_to_shard_imbalance_over_bar():
+    reg, clock = MetricsRegistry(), FakeClock()
+    shard = _RampShard()
+    doc = _mk_doctor(reg, clock, _RampSlo(), shard=shard)
+    with _knobs(*_TREND_KNOBS):
+        for mom in (1.0, 1.5, 2.0, 2.5):
+            shard.mom = mom
+            shard.over = mom >= 2.0
+            res = doc.evaluate()
+            if shard.over:
+                assert [a for a in res["alerts"]
+                        if a["rule"] == "capacity_trend"] == []
+            clock.advance(60.0)
+
+
+def test_capacity_trend_silent_on_flat_load():
+    reg, clock = MetricsRegistry(), FakeClock()
+    shard = _RampShard()
+    doc = _mk_doctor(reg, clock, _RampSlo(), shard=shard)
+    with _knobs(*_TREND_KNOBS):
+        for _ in range(5):
+            res = doc.evaluate()
+            assert [a for a in res["alerts"]
+                    if a["rule"] == "capacity_trend"] == []
+            clock.advance(60.0)
+
+
+def test_doctor_open_captures_a_fetchable_bundle(tmp_path):
+    """Every doctor-opened incident carries a bundle (the acceptance
+    wiring: evaluate -> open -> ForensicStore.capture), deduped bumps
+    do not re-capture."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    hist = TelemetryHistory(clock=clock, tiers=[(2, 32)], registry=reg)
+    fstore = ForensicStore(dir_path=str(tmp_path), keep=4, registry=reg,
+                           history=hist, clock=clock)
+    doc = _mk_doctor(reg, clock, _RampSlo(), forensics=fstore)
+    with _knobs(*_TREND_KNOBS, (config.FORENSICS_ENABLED, True)):
+        doc.evaluate()                        # counter baselines
+        clock.advance(30.0)
+        reg.inc("wal.fsync_errors", 1)        # new fsync errors page
+        hist.sample_now(clock())
+        res = doc.evaluate()
+        assert any(a["rule"] == "wal_fsync_stall"
+                   for a in res["alerts"])
+        incidents = doc.store.all()
+        assert incidents
+        for inc in incidents:
+            bundle = fstore.get(inc["id"])
+            assert bundle is not None
+            assert bundle["rule"] == inc["rule"]
+        captured = reg.snapshot()["counters"].get("forensics.captured", 0)
+        clock.advance(30.0)
+        reg.inc("wal.fsync_errors", 1)       # same incident, deduped
+        doc.evaluate()
+        assert reg.snapshot()["counters"].get(
+            "forensics.captured", 0) == captured
+
+
+def test_series_store_window_and_slope_semantics():
+    s = SeriesStore()
+    assert s.window("x", 100.0, 60.0) == (0.0, 0.0)   # first sighting
+    s.observe("x", 10.0, 100.0)
+    assert s.window("x", 100.0, 60.0) == (0.0, 0.0)
+    s.observe("x", 40.0, 130.0)
+    rate, delta = s.window("x", 130.0, 60.0)
+    assert rate == pytest.approx(60.0)   # 30 over 30s -> 60/min
+    assert delta == pytest.approx(30.0)
+    for i in range(5):
+        s.observe("lin", 2.0 * i, 200.0 + i)
+    assert s.slope("lin", 204.0, 60.0) == pytest.approx(2.0)
+    assert s.points("lin", 204.0, 60.0) == 5
+    assert s.last("lin") == pytest.approx(8.0)
+    s.clear()
+    assert s.points("lin", 204.0, 60.0) == 0
+
+
+# -- the full predictive drill (slow) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_trend_drill_end_to_end():
+    from geomesa_tpu.obs import trenddrill
+    report = trenddrill.run()
+    assert report["ok"], report
+    f = report["halves"]["faulted"]
+    assert f["t_trend_s"] < f["t_page_s"]
+    assert all(e["bundle"] and e["covers_window"]
+               for e in f["bundle_audit"])
+    assert report["halves"]["clean"]["opened_total"] == 0
